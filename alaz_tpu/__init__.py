@@ -1,0 +1,30 @@
+"""alaz_tpu — a TPU-native service-map observability + graph-learning framework.
+
+A ground-up re-design of the capabilities of getanteon/alaz (eBPF Kubernetes
+service-map agent, see /root/reference) around a columnar streaming data plane
+and a JAX/XLA/Pallas graph-learning backend:
+
+- ``alaz_tpu.events``     — columnar event schemas (the ebpf/ consumer analog)
+- ``alaz_tpu.protocols``  — L7 protocol classifiers/parsers (the ebpf/c analog)
+- ``alaz_tpu.aggregator`` — vectorized stream join: events × sockets × k8s → edges
+- ``alaz_tpu.datastore``  — pluggable sinks (DataStore interface analog)
+- ``alaz_tpu.replay``     — simulator / trace replay harness (test plane)
+- ``alaz_tpu.graph``      — windowed COO graph batching for the device
+- ``alaz_tpu.ops``        — segment/gather ops incl. Pallas TPU kernels
+- ``alaz_tpu.models``     — GraphSAGE / GAT / temporal GNN anomaly scorers
+- ``alaz_tpu.parallel``   — mesh, sharding, collectives, halo exchange
+- ``alaz_tpu.train``      — objectives, train/eval steps, checkpointing
+- ``alaz_tpu.runtime``    — the end-to-end streaming service loop
+
+Design principle: everything hot is a fixed-dtype array batch. Strings are
+interned to int32 ids at the edge of the system; joins are vectorized numpy
+on the host and everything on-device is static-shape, bf16-friendly XLA.
+
+The package intentionally does NOT import jax at the top level: the data
+plane (events/aggregator/datastore/replay) is importable and usable without
+any accelerator present.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["__version__"]

@@ -1,0 +1,547 @@
+"""The aggregator engine — the aggregator/data.go join core (G9), columnar.
+
+Responsibilities, mapped to the reference:
+
+- ``process_tcp``  : TCP state events → socket-line opens/closes
+  (processTcpConnect, data.go:404-476) + optional AliveConnection emits.
+- ``process_l7``   : L7 event batches → attributed ``REQUEST_DTYPE`` edges
+  (processL7 → per-protocol handlers, data.go:1364-1383,1208-1272) with
+  socket-line fallback join for events without embedded addresses
+  (findRelatedSocket, data.go:1407-1429) and a bounded retry queue for
+  events that raced their TCP state (signal-and-requeue, data.go:404-437;
+  attemptLimit 3 / 20ms, data.go:105-110).
+- ``process_proc`` : proc exit → socket-line teardown (zombie reaper analog,
+  data.go:192-219).
+- ``process_k8s``  : informer messages → cluster IP maps + datastore
+  forwarding (processk8s, data.go:239-263; persist.go).
+
+Everything hot is vectorized over the batch; per-event Python happens only
+for low-rate protocols (SQL/Mongo/Kafka/AMQP payload parsing) and is
+amortized by unique-payload grouping.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.h2 import Http2Assembler
+from alaz_tpu.aggregator.sockline import SockInfo, SocketLineStore
+from alaz_tpu.config import RuntimeConfig
+from alaz_tpu.datastore.dto import (
+    ALIVE_CONNECTION_DTYPE,
+    EP_OUTBOUND,
+    EP_POD,
+    KAFKA_CONSUME,
+    KAFKA_EVENT_DTYPE,
+    KAFKA_PUBLISH,
+    REQUEST_DTYPE,
+    reverse_direction,
+)
+from alaz_tpu.datastore.interface import DataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import K8sResourceMessage
+from alaz_tpu.events.net import u32_to_ip
+from alaz_tpu.events.schema import (
+    AmqpMethod,
+    Http2Method,
+    L7Protocol,
+    MongoMethod,
+    ProcEventType,
+    RedisMethod,
+    TcpEventType,
+)
+from alaz_tpu.logging import get_logger
+from alaz_tpu.protocols import http as http_proto
+from alaz_tpu.protocols import kafka as kafka_proto
+from alaz_tpu.protocols import mongo as mongo_proto
+from alaz_tpu.protocols import mysql as mysql_proto
+from alaz_tpu.protocols import postgres as postgres_proto
+
+log = get_logger("alaz_tpu.aggregator")
+
+RETRY_ATTEMPT_LIMIT = 3  # data.go:109 attemptLimit
+RETRY_INTERVAL_NS = 20_000_000  # data.go:108 retryInterval (20ms)
+
+_PATH_WINDOW = 128  # unique-payload grouping window for path extraction
+
+
+def _conn_keys(pid: np.ndarray, fd: np.ndarray) -> np.ndarray:
+    """(pid, fd) → mixed u64 grouping key (collision odds are 2^-64-ish;
+    used only to group rows that share a socket line)."""
+    with np.errstate(over="ignore"):
+        return (pid.astype(np.uint64) << np.uint64(32)) ^ (
+            fd * np.uint64(0x9E3779B97F4A7C15)
+        )
+
+
+class AggregatorStats:
+    def __init__(self) -> None:
+        self.l7_in = 0
+        self.l7_joined = 0
+        self.l7_dropped_no_socket = 0
+        self.l7_dropped_not_pod = 0
+        self.l7_requeued = 0
+        self.tcp_in = 0
+        self.proc_in = 0
+        self.k8s_in = 0
+        self.edges_out = 0
+        self.kafka_out = 0
+
+    def as_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class Aggregator:
+    def __init__(
+        self,
+        ds: DataStore,
+        interner: Optional[Interner] = None,
+        config: Optional[RuntimeConfig] = None,
+        cluster: Optional[ClusterInfo] = None,
+    ):
+        self.ds = ds
+        self.interner = interner if interner is not None else Interner()
+        self.config = config if config is not None else RuntimeConfig()
+        self.cluster = cluster if cluster is not None else ClusterInfo(self.interner)
+        self.socket_lines = SocketLineStore()
+        self.h2 = Http2Assembler()
+        self.stats = AggregatorStats()
+        self.live_pids: set[int] = set()
+        # prepared-statement caches (pgStmts / mySqlStmts analogs)
+        self.pg_stmts: dict[tuple[int, int, str], str] = {}
+        self.mysql_stmts: dict[tuple[int, int, int], str] = {}
+        # retry queue of (l7 rows, attempts, not_before_ns)
+        self._retries: deque[tuple[np.ndarray, int, int]] = deque()
+        # payload-hash → interned path id, per protocol (cross-batch cache)
+        self._path_cache: dict[int, dict[int, int]] = {}
+
+    # ------------------------------------------------------------------
+    # TCP events
+    # ------------------------------------------------------------------
+
+    def process_tcp(self, events: np.ndarray, now_ns: int | None = None) -> None:
+        """Fold a TCP_EVENT_DTYPE batch into socket lines."""
+        self.stats.tcp_in += events.shape[0]
+        interesting = (events["type"] == TcpEventType.ESTABLISHED) | (
+            events["type"] == TcpEventType.CLOSED
+        )
+        events = events[interesting]
+        if events.shape[0] == 0:
+            return
+        _, starts, inverse = np.unique(
+            _conn_keys(events["pid"], events["fd"]), return_index=True, return_inverse=True
+        )
+        alive_rows = []
+        for g, start in enumerate(starts):
+            rows = events[inverse == g]
+            pid = int(rows["pid"][0])
+            fd = int(rows["fd"][0])
+            line = self.socket_lines.get_or_create(pid, fd)
+            self.live_pids.add(pid)
+            for r in rows:
+                if r["type"] == TcpEventType.ESTABLISHED:
+                    line.add_value(
+                        int(r["timestamp_ns"]),
+                        SockInfo(
+                            pid=pid,
+                            fd=fd,
+                            saddr=int(r["saddr"]),
+                            sport=int(r["sport"]),
+                            daddr=int(r["daddr"]),
+                            dport=int(r["dport"]),
+                        ),
+                    )
+                    alive_rows.append(r)
+                else:
+                    line.add_value(int(r["timestamp_ns"]), None)
+        if self.config.send_alive_tcp_connections and alive_rows:
+            self._persist_alive(np.array(alive_rows, dtype=events.dtype))
+
+    def _persist_alive(self, rows: np.ndarray) -> None:
+        out = np.zeros(rows.shape[0], dtype=ALIVE_CONNECTION_DTYPE)
+        out["check_time_ms"] = rows["timestamp_ns"] // 1_000_000
+        out["from_ip"] = rows["saddr"]
+        out["from_port"] = rows["sport"]
+        out["to_ip"] = rows["daddr"]
+        out["to_port"] = rows["dport"]
+        ft, fu = self.cluster.attribute(rows["saddr"])
+        tt, tu = self.cluster.attribute(rows["daddr"])
+        out["from_type"], out["from_uid"] = ft, fu
+        out["to_type"], out["to_uid"] = tt, tu
+        self.ds.persist_alive_connections(out)
+
+    # ------------------------------------------------------------------
+    # Proc events
+    # ------------------------------------------------------------------
+
+    def process_proc(self, events: np.ndarray) -> None:
+        self.stats.proc_in += events.shape[0]
+        for r in events:
+            pid = int(r["pid"])
+            if r["type"] == ProcEventType.EXIT:
+                self.live_pids.discard(pid)
+                self.socket_lines.remove_pid(pid)
+            elif r["type"] == ProcEventType.EXEC:
+                self.live_pids.add(pid)
+
+    # ------------------------------------------------------------------
+    # K8s events
+    # ------------------------------------------------------------------
+
+    def process_k8s(self, msg: K8sResourceMessage) -> None:
+        self.stats.k8s_in += 1
+        self.cluster.handle_msg(msg)
+        self.ds.persist_resource(msg.resource_type, msg.event_type, msg.object)
+
+    # ------------------------------------------------------------------
+    # L7 events
+    # ------------------------------------------------------------------
+
+    def process_l7(self, events: np.ndarray, now_ns: int | None = None) -> np.ndarray:
+        """Join + attribute an L7_EVENT_DTYPE batch. Returns the emitted
+        REQUEST_DTYPE rows (also persisted to the datastore)."""
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        self.stats.l7_in += events.shape[0]
+        emitted = self._process_l7_inner(events, attempts=0, now_ns=now_ns)
+        retried = self.flush_retries(now_ns)
+        if retried is not None and retried.shape[0]:
+            emitted = np.concatenate([emitted, retried])
+        return emitted
+
+    def flush_retries(self, now_ns: int) -> np.ndarray | None:
+        """Re-run due retry entries (the signal-and-requeue path)."""
+        out = []
+        pending = len(self._retries)
+        for _ in range(pending):
+            rows, attempts, not_before = self._retries.popleft()
+            if not_before > now_ns:
+                self._retries.append((rows, attempts, not_before))
+                continue
+            out.append(self._process_l7_inner(rows, attempts, now_ns))
+        if not out:
+            return None
+        return np.concatenate(out) if len(out) > 1 else out[0]
+
+    def _process_l7_inner(
+        self, events: np.ndarray, attempts: int, now_ns: int
+    ) -> np.ndarray:
+        n = events.shape[0]
+        if n == 0:
+            return np.zeros(0, dtype=REQUEST_DTYPE)
+
+        saddr = events["saddr"].copy()
+        sport = events["sport"].copy()
+        daddr = events["daddr"].copy()
+        dport = events["dport"].copy()
+
+        # V1 fallback: rows without embedded addresses join via socket lines
+        # keyed (pid, fd) at the write timestamp (findRelatedSocket).
+        need_join = daddr == 0
+        matched = ~need_join
+        if need_join.any():
+            j_idx = np.flatnonzero(need_join)
+            sub = events[j_idx]
+            _, starts, inverse = np.unique(
+                _conn_keys(sub["pid"], sub["fd"]), return_index=True, return_inverse=True
+            )
+            for g, start in enumerate(starts):
+                sel = j_idx[inverse == g]
+                pid = int(events["pid"][sel[0]])
+                fd = int(events["fd"][sel[0]])
+                line = self.socket_lines.get(pid, fd)
+                if line is None or len(line) == 0:
+                    continue
+                found, s_a, s_p, d_a, d_p = line.get_values(
+                    events["write_time_ns"][sel], now_ns
+                )
+                hit = sel[found]
+                saddr[hit] = s_a[found]
+                sport[hit] = s_p[found]
+                daddr[hit] = d_a[found]
+                dport[hit] = d_p[found]
+                matched[hit] = True
+
+        # requeue unmatched rows (socket state may lag the L7 event)
+        unmatched = ~matched
+        if unmatched.any():
+            if attempts + 1 < RETRY_ATTEMPT_LIMIT:
+                rows = events[unmatched].copy()
+                backoff = RETRY_INTERVAL_NS * (1 << attempts)  # 20ms, 40ms
+                self._retries.append((rows, attempts + 1, now_ns + backoff))
+                self.stats.l7_requeued += rows.shape[0]
+            else:
+                self.stats.l7_dropped_no_socket += int(unmatched.sum())
+            events = events[matched]
+            saddr, sport = saddr[matched], sport[matched]
+            daddr, dport = daddr[matched], dport[matched]
+            if events.shape[0] == 0:
+                return np.zeros(0, dtype=REQUEST_DTYPE)
+
+        # attribution: From must be a pod, else drop (setFromToV2 contract)
+        from_type, from_uid = self.cluster.attribute(saddr)
+        is_pod = from_type == EP_POD
+        if not is_pod.all():
+            self.stats.l7_dropped_not_pod += int((~is_pod).sum())
+            events = events[is_pod]
+            if events.shape[0] == 0:
+                return np.zeros(0, dtype=REQUEST_DTYPE)
+            saddr, sport = saddr[is_pod], sport[is_pod]
+            daddr, dport = daddr[is_pod], dport[is_pod]
+            from_type, from_uid = from_type[is_pod], from_uid[is_pod]
+        to_type, to_uid = self.cluster.attribute(daddr)
+
+        out = np.zeros(events.shape[0], dtype=REQUEST_DTYPE)
+        out["start_time_ms"] = (events["write_time_ns"] // 1_000_000).astype(np.int64)
+        out["latency_ns"] = events["duration_ns"]
+        out["from_ip"] = saddr
+        out["from_type"] = from_type
+        out["from_uid"] = from_uid
+        out["from_port"] = sport
+        out["to_ip"] = daddr
+        out["to_type"] = to_type
+        out["to_uid"] = to_uid
+        out["to_port"] = dport
+        out["protocol"] = events["protocol"]
+        out["tls"] = events["tls"]
+        out["completed"] = True
+        out["status_code"] = events["status"]
+        out["method"] = events["method"]
+
+        # outbound destinations fall back to the IP string as UID
+        # (setFromToV2 reverse-DNS fallback; DNS itself is gated off here)
+        outbound = to_type == np.uint8(EP_OUTBOUND)
+        if outbound.any():
+            for i in np.flatnonzero(outbound):
+                out["to_uid"][i] = self.interner.intern(u32_to_ip(int(daddr[i])))
+
+        # per-protocol payload enrichment
+        self._enrich_paths(events, out)
+
+        # consume-side direction flips (AMQP DELIVER / Redis PUSHED_EVENT)
+        flip = (
+            (events["protocol"] == L7Protocol.AMQP)
+            & (events["method"] == AmqpMethod.DELIVER)
+        ) | (
+            (events["protocol"] == L7Protocol.REDIS)
+            & (events["method"] == RedisMethod.PUSHED_EVENT)
+        )
+        if flip.any():
+            reverse_direction(out, flip)
+
+        # HTTP2 frames & Kafka payloads detour through their assemblers
+        h2_mask = events["protocol"] == L7Protocol.HTTP2
+        kafka_mask = events["protocol"] == L7Protocol.KAFKA
+        plain = ~h2_mask & ~kafka_mask
+        if h2_mask.any():
+            h2_out = self._process_h2(events[h2_mask], out[h2_mask])
+            if h2_out is not None and h2_out.shape[0]:
+                self.ds.persist_requests(h2_out)
+                self.stats.edges_out += h2_out.shape[0]
+        if kafka_mask.any():
+            self._process_kafka(events[kafka_mask], out[kafka_mask])
+
+        result = out[plain]
+        if result.shape[0]:
+            self.ds.persist_requests(result)
+            self.stats.edges_out += result.shape[0]
+            self.stats.l7_joined += result.shape[0]
+        return result
+
+    # -- payload enrichment -------------------------------------------------
+
+    def _enrich_paths(self, events: np.ndarray, out: np.ndarray) -> None:
+        """Fill ``out['path']`` per protocol. Amortized by payload hashing:
+        identical payload prefixes parse once *ever* (cross-batch cache)."""
+        protocol = events["protocol"]
+        http_mask = protocol == L7Protocol.HTTP
+        if http_mask.any():
+            idx = np.flatnonzero(http_mask)
+            self._hashed_parse(events, out, idx, int(L7Protocol.HTTP), self._parse_http_row)
+        for proto, parser in (
+            (L7Protocol.POSTGRES, self._parse_pg_row),
+            (L7Protocol.MYSQL, self._parse_mysql_row),
+            (L7Protocol.MONGO, self._parse_mongo_row),
+            (L7Protocol.REDIS, self._parse_redis_row),
+        ):
+            mask = protocol == proto
+            if mask.any():
+                idx = np.flatnonzero(mask)
+                if proto in (L7Protocol.POSTGRES, L7Protocol.MYSQL):
+                    # stateful (stmt caches) — parse per row
+                    for i in idx:
+                        out["path"][i] = parser(events[i])
+                else:
+                    self._hashed_parse(events, out, idx, int(proto), parser)
+
+    @staticmethod
+    def _payload_hashes(window: np.ndarray) -> np.ndarray:
+        """Cheap 64-bit mix over the payload window (FNV-ish, vectorized).
+
+        The window is [N, _PATH_WINDOW] uint8 viewed as uint64 lanes; each
+        lane is multiplied by a distinct odd constant and xor-folded, so
+        identical prefixes collide on purpose and different ones don't in
+        any practical batch."""
+        lanes = window.view(np.uint64).reshape(window.shape[0], -1)
+        mult = (
+            np.uint64(0x9E3779B97F4A7C15)
+            * (np.arange(1, lanes.shape[1] + 1, dtype=np.uint64) | np.uint64(1))
+        )
+        with np.errstate(over="ignore"):
+            mixed = lanes * mult[None, :]
+            h = np.bitwise_xor.reduce(mixed, axis=1)
+            h ^= h >> np.uint64(33)
+            h *= np.uint64(0xFF51AFD7ED558CCD)
+            h ^= h >> np.uint64(33)
+        return h
+
+    def _hashed_parse(self, events, out, idx, proto_key: int, row_parser) -> None:
+        cache = self._path_cache.setdefault(proto_key, {})
+        window = np.ascontiguousarray(events["payload"][idx, :_PATH_WINDOW])
+        hashes = self._payload_hashes(window)
+        uniq, starts, inverse = np.unique(hashes, return_index=True, return_inverse=True)
+        path_ids = np.zeros(uniq.shape[0], dtype=np.int32)
+        for u in range(uniq.shape[0]):
+            key = int(uniq[u])
+            pid_cached = cache.get(key)
+            if pid_cached is None:
+                pid_cached = row_parser(events[idx[starts[u]]])
+                cache[key] = pid_cached
+            path_ids[u] = pid_cached
+        out["path"][idx] = path_ids[inverse]
+
+    def _payload_bytes(self, row) -> bytes:
+        size = int(row["payload_size"])
+        return bytes(row["payload"][: min(size, row["payload"].shape[0])])
+
+    def _parse_http_row(self, row) -> int:
+        _, path, _, _host = http_proto.parse_payload(self._payload_bytes(row))
+        return self.interner.intern(path)
+
+    def _parse_pg_row(self, row) -> int:
+        cmd = postgres_proto.parse_command(
+            self._payload_bytes(row),
+            int(row["method"]),
+            self.pg_stmts,
+            int(row["pid"]),
+            int(row["fd"]),
+        )
+        return self.interner.intern(cmd or "")
+
+    def _parse_mysql_row(self, row) -> int:
+        cmd = mysql_proto.parse_command(
+            self._payload_bytes(row),
+            int(row["method"]),
+            self.mysql_stmts,
+            int(row["pid"]),
+            int(row["fd"]),
+            int(row["mysql_prep_stmt_id"]),
+        )
+        return self.interner.intern(cmd or "")
+
+    def _parse_mongo_row(self, row) -> int:
+        summary = mongo_proto.parse_summary(self._payload_bytes(row))
+        return self.interner.intern(summary or "")
+
+    def _parse_redis_row(self, row) -> int:
+        # raw payload is the query (processRedisEvent, data.go:1120-1160)
+        return self.interner.intern(
+            self._payload_bytes(row).decode("latin-1", "replace")
+        )
+
+    # -- HTTP/2 -------------------------------------------------------------
+
+    def _process_h2(self, events: np.ndarray, out_rows: np.ndarray) -> np.ndarray | None:
+        done = []
+        for i, row in enumerate(events):
+            completed = self.h2.feed(
+                pid=int(row["pid"]),
+                fd=int(row["fd"]),
+                is_client=int(row["method"]) == Http2Method.CLIENT_FRAME,
+                payload=self._payload_bytes(row),
+                write_time_ns=int(row["write_time_ns"]),
+                tls=bool(row["tls"]),
+            )
+            for c in completed:
+                r = out_rows[i : i + 1].copy()
+                r["start_time_ms"] = c.start_time_ns // 1_000_000
+                r["latency_ns"] = c.latency_ns
+                r["status_code"] = c.grpc_status if c.is_grpc and c.grpc_status is not None else c.status
+                r["path"] = self.interner.intern(c.path)
+                r["completed"] = True
+                done.append(r)
+        if not done:
+            return None
+        return np.concatenate(done)
+
+    # -- Kafka --------------------------------------------------------------
+
+    def _process_kafka(self, events: np.ndarray, out_rows: np.ndarray) -> None:
+        """Decode Kafka payloads → KAFKA_EVENT_DTYPE batch
+        (processKafkaEvent, data.go:929-1017 + aggregator/kafka)."""
+        from alaz_tpu.events.schema import KafkaMethod
+
+        rows = []
+        for i, row in enumerate(events):
+            payload = self._payload_bytes(row)
+            method = int(row["method"])
+            msgs: list[kafka_proto.KafkaMessage] = []
+            # dispatch on the kernel-assigned method like the reference
+            # (data.go:953,975); the payload is often truncated to the
+            # capture window so the kernel's exact-size check can't re-run
+            try:
+                if method == KafkaMethod.PRODUCE_REQUEST:
+                    _, api_version, _, body = kafka_proto.split_request_header(payload)
+                    msgs = kafka_proto.decode_produce_request(body, api_version)
+                elif method == KafkaMethod.FETCH_RESPONSE:
+                    api_version = int(row["kafka_api_version"])
+                    if len(payload) >= 8:
+                        msgs = kafka_proto.decode_fetch_response(payload[8:], api_version)
+                else:
+                    # unclassified: sniff a request header, else try fetch
+                    ok, _corr, api_key, api_version = kafka_proto.parse_request_header(payload)
+                    if ok and api_key == kafka_proto.API_KEY_PRODUCE:
+                        _, _, _, body = kafka_proto.split_request_header(payload)
+                        msgs = kafka_proto.decode_produce_request(body, api_version)
+                    elif len(payload) >= 8:
+                        msgs = kafka_proto.decode_fetch_response(
+                            payload[8:], int(row["kafka_api_version"])
+                        )
+            except Exception:
+                msgs = []
+            for m in msgs:
+                kv = np.zeros(1, dtype=KAFKA_EVENT_DTYPE)
+                o = out_rows[i]
+                kv["start_time_ms"] = o["start_time_ms"]
+                kv["latency_ns"] = o["latency_ns"]
+                kv["from_ip"], kv["from_type"], kv["from_uid"], kv["from_port"] = (
+                    o["from_ip"], o["from_type"], o["from_uid"], o["from_port"],
+                )
+                kv["to_ip"], kv["to_type"], kv["to_uid"], kv["to_port"] = (
+                    o["to_ip"], o["to_type"], o["to_uid"], o["to_port"],
+                )
+                kv["topic"] = self.interner.intern(m.topic)
+                kv["partition"] = m.partition
+                kv["key"] = self.interner.intern(m.key)
+                kv["value"] = self.interner.intern(m.value)
+                kv["type"] = KAFKA_PUBLISH if m.type == kafka_proto.PUBLISH else KAFKA_CONSUME
+                kv["tls"] = o["tls"]
+                if m.type == kafka_proto.CONSUME:
+                    reverse_direction(kv)
+                rows.append(kv)
+        if rows:
+            batch = np.concatenate(rows)
+            self.ds.persist_kafka_events(batch)
+            self.stats.kafka_out += batch.shape[0]
+
+    # ------------------------------------------------------------------
+
+    def gc(self, now_ns: int | None = None) -> None:
+        """Periodic housekeeping: socket-line GC + h2 stream reaping
+        (the 10-worker sockline GC loop, data.go:1688; reaper 551-571)."""
+        self.socket_lines.gc()
+        self.h2.reap(now_ns if now_ns is not None else time.time_ns())

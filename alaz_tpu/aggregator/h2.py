@@ -1,0 +1,162 @@
+"""HTTP/2-gRPC request/response assembly — the G13 analog
+(aggregator/data.go:533-810).
+
+L7 events for HTTP2 carry raw frame bytes (the kernel forwards them
+unparsed, l7.c:335-379,687-730). Per connection (pid, fd) we keep client-
+and server-side HPACK decoders (data.go:93-103) and a stream table pairing
+client HEADERS (:method, :path, :authority, content-type→gRPC) with server
+HEADERS (:status, grpc-status) (data.go:705-800). Latency is server frame
+write time − client frame write time (data.go:586,702). Half-arrived pairs
+are reaped after one minute (data.go:551-571).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from alaz_tpu.protocols import hpack, http2
+
+ONE_MINUTE_NS = 60_000_000_000
+
+
+@dataclass
+class _StreamState:
+    stream_id: int
+    method: str = ""
+    path: str = ""
+    authority: str = ""
+    content_type: str = ""
+    client_time_ns: int = 0
+    status: int = 0
+    grpc_status: int | None = None
+    server_time_ns: int = 0
+    has_client: bool = False
+    has_server: bool = False
+
+
+@dataclass
+class _ConnState:
+    client_decoder: hpack.Decoder = field(default_factory=hpack.Decoder)
+    server_decoder: hpack.Decoder = field(default_factory=hpack.Decoder)
+    streams: dict[int, _StreamState] = field(default_factory=dict)
+    client_buffer: bytes = b""
+    server_buffer: bytes = b""
+
+
+@dataclass
+class CompletedH2Request:
+    pid: int
+    fd: int
+    stream_id: int
+    method: str
+    path: str
+    authority: str
+    is_grpc: bool
+    status: int
+    grpc_status: int | None
+    start_time_ns: int
+    latency_ns: int
+    tls: bool
+
+
+class Http2Assembler:
+    def __init__(self) -> None:
+        self._conns: dict[tuple[int, int], _ConnState] = {}
+
+    def _conn(self, pid: int, fd: int) -> _ConnState:
+        key = (pid, fd)
+        st = self._conns.get(key)
+        if st is None:
+            st = _ConnState()
+            self._conns[key] = st
+        return st
+
+    def feed(
+        self,
+        pid: int,
+        fd: int,
+        is_client: bool,
+        payload: bytes,
+        write_time_ns: int,
+        tls: bool = False,
+    ) -> list[CompletedH2Request]:
+        """Feed one captured frame buffer; returns any completed requests."""
+        conn = self._conn(pid, fd)
+        done: list[CompletedH2Request] = []
+        for frame in http2.iter_frames(payload):
+            if frame.type != http2.FRAME_HEADERS:
+                continue
+            if len(frame.payload) < frame.length:
+                continue  # truncated by the capture window
+            block = http2.headers_block(frame)
+            decoder = conn.client_decoder if is_client else conn.server_decoder
+            try:
+                headers = decoder.decode(block)
+            except hpack.HpackError:
+                continue
+            stream = conn.streams.get(frame.stream_id)
+            if stream is None:
+                stream = _StreamState(frame.stream_id)
+                conn.streams[frame.stream_id] = stream
+            if is_client:
+                stream.has_client = True
+                stream.client_time_ns = write_time_ns
+                for name, value in headers:
+                    if name == ":method":
+                        stream.method = value
+                    elif name == ":path":
+                        stream.path = value
+                    elif name == ":authority":
+                        stream.authority = value
+                    elif name == "content-type":
+                        stream.content_type = value
+            else:
+                # any server HEADERS frame completes the server side, even
+                # without a decodable :status — the reference flags
+                # ServerHeadersFrameArrived unconditionally (data.go:775-777)
+                stream.has_server = True
+                stream.server_time_ns = write_time_ns
+                for name, value in headers:
+                    if name == ":status":
+                        try:
+                            stream.status = int(value)
+                        except ValueError:
+                            pass
+                    elif name == "grpc-status":
+                        try:
+                            stream.grpc_status = int(value)
+                        except ValueError:
+                            pass
+            if stream.has_client and stream.has_server:
+                done.append(
+                    CompletedH2Request(
+                        pid=pid,
+                        fd=fd,
+                        stream_id=stream.stream_id,
+                        method=stream.method,
+                        path=stream.path,
+                        authority=stream.authority,
+                        is_grpc=stream.content_type.startswith("application/grpc"),
+                        status=stream.status,
+                        grpc_status=stream.grpc_status,
+                        start_time_ns=stream.client_time_ns,
+                        latency_ns=max(0, stream.server_time_ns - stream.client_time_ns),
+                        tls=tls,
+                    )
+                )
+                del conn.streams[frame.stream_id]
+        return done
+
+    def reap(self, now_ns: int) -> int:
+        """Drop half-arrived pairs older than a minute (data.go:551-571)."""
+        dropped = 0
+        for conn in self._conns.values():
+            doomed = [
+                sid
+                for sid, s in conn.streams.items()
+                if max(s.client_time_ns, s.server_time_ns) + ONE_MINUTE_NS < now_ns
+            ]
+            for sid in doomed:
+                del conn.streams[sid]
+                dropped += 1
+        return dropped

@@ -1,0 +1,229 @@
+"""Environment-driven configuration.
+
+The reference configures everything through environment variables read in
+``main.go:28-188`` and tiny structs in ``config/`` (SURVEY §2.2 G1/G24).
+We keep that contract — every knob has an ``ALAZ_TPU_*`` env var — but
+centralize it in typed dataclasses so programmatic use (tests, replay
+configs) doesn't go through the environment at all.
+
+Simulation configs are JSON files with the same keys as the reference's
+``testconfig/config1.json`` (camelCase accepted verbatim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+_PREFIX = "ALAZ_TPU_"
+
+
+def _env(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(_PREFIX + name, os.environ.get(name, default))
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = _env(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name: str, default: int) -> int:
+    v = _env(name)
+    return default if v is None else int(v)
+
+
+def env_float(name: str, default: float) -> float:
+    v = _env(name)
+    return default if v is None else float(v)
+
+
+def env_str(name: str, default: str) -> str:
+    v = _env(name)
+    return default if v is None else v
+
+
+@dataclass
+class QueueConfig:
+    """Bounded-queue capacities, mirroring the reference's channel sizes
+    (ebpf/collector.go:79-81, main.go:82-90): drop-not-block at the source
+    boundary, exactly like l7.go:764-770."""
+
+    l7_events: int = 200_000
+    tcp_events: int = 100_000
+    proc_events: int = 20_000
+    kube_events: int = 1_000
+    ds_requests: int = 40_000
+    ds_connections: int = 1_000
+    ds_kafka: int = 2_000
+
+    @classmethod
+    def from_env(cls) -> "QueueConfig":
+        return cls(
+            l7_events=env_int("EVENTS_BUFFER_SIZE", 200_000),
+            tcp_events=env_int("EBPF_TCP_EVENTS_BUFFER_SIZE", 100_000),
+            proc_events=env_int("EBPF_PROC_EVENTS_BUFFER_SIZE", 20_000),
+            kube_events=env_int("KUBE_EVENTS_BUFFER_SIZE", 1_000),
+            ds_requests=env_int("DS_REQ_BUFFER_SIZE", 40_000),
+            ds_connections=env_int("DS_CONN_BUFFER_SIZE", 1_000),
+            ds_kafka=env_int("DS_KAFKA_BUFFER_SIZE", 2_000),
+        )
+
+
+@dataclass
+class BackendConfig:
+    """Batching/export cadence of the datastore backend
+    (datastore/backend.go:280-338,591-765 and the HTTP client 210-278)."""
+
+    host: str = ""
+    monitoring_id: str = "test"
+    node_id: str = "node-0"
+    batch_size: int = 1_000
+    req_flush_interval_s: float = 5.0
+    conn_flush_interval_s: float = 30.0
+    conn_batch_size: int = 500
+    kafka_flush_interval_s: float = 5.0
+    kafka_batch_size: int = 500
+    resource_flush_interval_s: float = 5.0
+    max_retries: int = 2
+    backoff_min_s: float = 1.0
+    backoff_max_s: float = 5.0
+    timeout_s: float = 10.0
+    metrics_export: bool = False
+    metrics_export_interval_s: float = 10.0
+
+    @classmethod
+    def from_env(cls) -> "BackendConfig":
+        return cls(
+            host=env_str("BACKEND_HOST", ""),
+            monitoring_id=env_str("MONITORING_ID", "test"),
+            node_id=env_str("NODE_NAME", "node-0"),
+            batch_size=env_int("BATCH_SIZE", 1_000),
+            metrics_export=env_bool("METRICS_ENABLED", False),
+        )
+
+
+@dataclass
+class SimulationConfig:
+    """Replay-harness knobs; JSON-compatible with testconfig/config1.json
+    (main_benchmark_test.go:40-80)."""
+
+    test_duration_s: float = 15.0
+    mem_prof_interval_s: float = 5.0
+    pod_count: int = 100
+    service_count: int = 50
+    edge_count: int = 20
+    edge_rate: int = 10_000  # events/sec/edge
+    chunk_size: int = 8_192  # events per columnar batch emitted by the simulator
+    seed: int = 0
+    protocol_mix: Mapping[str, float] = field(default_factory=lambda: {"HTTP": 1.0})
+    ds_req_buffer_size: int = 150_000
+    mock_backend_min_latency_ms: float = 5.0
+    mock_backend_max_latency_ms: float = 20.0
+
+    @classmethod
+    def from_json(cls, path_or_dict: str | Mapping[str, Any]) -> "SimulationConfig":
+        if isinstance(path_or_dict, (str, os.PathLike)):
+            with open(path_or_dict) as f:
+                raw = json.load(f)
+        else:
+            raw = dict(path_or_dict)
+        camel = {
+            "testDuration": "test_duration_s",
+            "memProfInterval": "mem_prof_interval_s",
+            "podCount": "pod_count",
+            "serviceCount": "service_count",
+            "edgeCount": "edge_count",
+            "edgeRate": "edge_rate",
+            "dsReqBufferSize": "ds_req_buffer_size",
+            "mockBackendMinLatency": "mock_backend_min_latency_ms",
+            "mockBackendMaxLatency": "mock_backend_max_latency_ms",
+            "chunkSize": "chunk_size",
+            "seed": "seed",
+            "protocolMix": "protocol_mix",
+        }
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs: dict[str, Any] = {}
+        for k, v in raw.items():
+            key = camel.get(k, k)
+            if key in known:
+                kwargs[key] = v
+        return cls(**kwargs)
+
+
+@dataclass
+class ModelConfig:
+    """Flagship model hyperparameters (BASELINE.json configs 2-4)."""
+
+    model: str = "graphsage"  # graphsage | gat | tgn
+    hidden_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 4  # gat only
+    num_edge_types: int = 9  # one per L7 protocol enum slot
+    node_feature_dim: int = 32
+    edge_feature_dim: int = 16
+    dropout: float = 0.1
+    dtype: str = "bfloat16"
+    use_pallas: bool = True
+
+    @classmethod
+    def from_env(cls) -> "ModelConfig":
+        return cls(
+            model=env_str("MODEL", "graphsage"),
+            hidden_dim=env_int("HIDDEN_DIM", 128),
+            num_layers=env_int("NUM_LAYERS", 2),
+            use_pallas=env_bool("USE_PALLAS", True),
+        )
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh axes for the sharded model (SURVEY §2.3 P1-P7).
+
+    Axis sizes of 1 collapse; the product must divide the device count.
+    """
+
+    dp: int = 1  # data parallel: edge-batch shards
+    tp: int = 1  # tensor parallel: feature-dim shards
+    ep: int = 1  # expert parallel: per-edge-type experts
+    sp: int = 1  # sequence/temporal parallel: time-window shards
+
+    @classmethod
+    def from_env(cls) -> "MeshConfig":
+        return cls(
+            dp=env_int("MESH_DP", 1),
+            tp=env_int("MESH_TP", 1),
+            ep=env_int("MESH_EP", 1),
+            sp=env_int("MESH_SP", 1),
+        )
+
+
+@dataclass
+class RuntimeConfig:
+    """Top-level wiring config — the main.go:28-188 analog."""
+
+    queues: QueueConfig = field(default_factory=QueueConfig)
+    backend: BackendConfig = field(default_factory=BackendConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    window_s: float = 1.0  # graph snapshot window
+    k8s_enabled: bool = True
+    exclude_namespaces: str = ""
+    send_alive_tcp_connections: bool = False
+
+    @classmethod
+    def from_env(cls) -> "RuntimeConfig":
+        return cls(
+            queues=QueueConfig.from_env(),
+            backend=BackendConfig.from_env(),
+            model=ModelConfig.from_env(),
+            mesh=MeshConfig.from_env(),
+            window_s=env_float("WINDOW_S", 1.0),
+            k8s_enabled=env_bool("K8S_COLLECTOR_ENABLED", True),
+            exclude_namespaces=env_str("EXCLUDE_NAMESPACES", ""),
+            send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
+        )

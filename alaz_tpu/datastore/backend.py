@@ -1,0 +1,248 @@
+"""Batching export backend — the datastore/backend.go analog (G17).
+
+Buffers columnar batches per stream and flushes on batch-size or cadence
+(reqs ≤1000/5s, conns ≤500/30s, kafka ≤500/5s, resources ≤1000/5s;
+backend.go:280-338,591-765) through a pluggable ``Transport`` with retries
+and exponential backoff (2 retries, 1-5s, retry on 400/429/5xx;
+backend.go:210-278). Every flush carries ``Metadata`` with a fresh
+idempotency key (payload.go:3-8).
+
+The Transport is the process boundary: an HTTP client in production, an
+in-process recorder in tests, or the TPU scoring service's feed queue.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from alaz_tpu import __version__
+from alaz_tpu.config import BackendConfig
+from alaz_tpu.datastore.dto import _EP_NAMES, request_rows_to_payload
+from alaz_tpu.events.net import u32_to_ip
+from alaz_tpu.datastore.interface import BaseDataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import EventType, ResourceType
+from alaz_tpu.logging import get_logger
+
+log = get_logger("alaz_tpu.datastore")
+
+# endpoint paths mirror backend.go:171-187
+EP_REQUESTS = "/requests/"
+EP_CONNECTIONS = "/connections/"
+EP_KAFKA = "/events/kafka/"
+EP_HEALTHCHECK = "/healthcheck/"
+_RESOURCE_EP = {
+    ResourceType.POD: "/pod/",
+    ResourceType.SERVICE: "/svc/",
+    ResourceType.REPLICASET: "/rs/",
+    ResourceType.DEPLOYMENT: "/deployment/",
+    ResourceType.ENDPOINTS: "/endpoint/",
+    ResourceType.CONTAINER: "/container/",
+    ResourceType.DAEMONSET: "/daemonset/",
+    ResourceType.STATEFULSET: "/statefulset/",
+}
+
+Transport = Callable[[str, dict], int]
+"""(endpoint, json-able payload) -> HTTP-like status code."""
+
+
+@dataclass
+class _Stream:
+    name: str
+    endpoint: str
+    batch_size: int
+    interval_s: float
+    pending: List[Any] = field(default_factory=list)
+    last_flush: float = 0.0
+    sent: int = 0
+    failed: int = 0
+
+
+class BatchingBackend(BaseDataStore):
+    """Thread-safe; ``pump()`` drives cadence (call from a runtime loop or
+    use ``start()`` for a daemon thread)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        interner: Interner,
+        config: Optional[BackendConfig] = None,
+        time_fn: Callable[[], float] = time.monotonic,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        cfg = config if config is not None else BackendConfig()
+        self.cfg = cfg
+        self.transport = transport
+        self.interner = interner
+        self.time_fn = time_fn
+        self.sleep_fn = sleep_fn
+        now = time_fn()
+        self._streams = {
+            "requests": _Stream("requests", EP_REQUESTS, cfg.batch_size, cfg.req_flush_interval_s, last_flush=now),
+            "connections": _Stream("connections", EP_CONNECTIONS, cfg.conn_batch_size, cfg.conn_flush_interval_s, last_flush=now),
+            "kafka": _Stream("kafka", EP_KAFKA, cfg.kafka_batch_size, cfg.kafka_flush_interval_s, last_flush=now),
+        }
+        self._resource_streams: dict[ResourceType, _Stream] = {
+            rt: _Stream(rt.value, ep, cfg.batch_size, cfg.resource_flush_interval_s, last_flush=now)
+            for rt, ep in _RESOURCE_EP.items()
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- DataStore surface -------------------------------------------------
+
+    def persist_requests(self, batch: np.ndarray) -> None:
+        rows = request_rows_to_payload(batch, self.interner)
+        self._append("requests", rows)
+
+    def persist_kafka_events(self, batch: np.ndarray) -> None:
+        """KafkaEventInfo[16] arity (payload.go:163-180): StartTime, Latency,
+        SrcIP, SrcType, SrcID, SrcPort, DstIP, DstType, DstID, DstPort,
+        Topic, Partition, Key, Value, Type, Tls."""
+        lookup = self.interner.lookup
+        rows = [
+            [
+                int(r["start_time_ms"]), int(r["latency_ns"]),
+                u32_to_ip(int(r["from_ip"])) if r["from_ip"] else "",
+                _EP_NAMES[int(r["from_type"])], lookup(int(r["from_uid"])),
+                int(r["from_port"]),
+                u32_to_ip(int(r["to_ip"])) if r["to_ip"] else "",
+                _EP_NAMES[int(r["to_type"])], lookup(int(r["to_uid"])),
+                int(r["to_port"]),
+                lookup(int(r["topic"])), int(r["partition"]),
+                lookup(int(r["key"])), lookup(int(r["value"])),
+                "PUBLISH" if int(r["type"]) == 1 else "CONSUME", bool(r["tls"]),
+            ]
+            for r in batch
+        ]
+        self._append("kafka", rows)
+
+    def persist_alive_connections(self, batch: np.ndarray) -> None:
+        """ConnInfo[9] arity (payload.go:137-150): CheckTime, SrcIP, SrcType,
+        SrcID, SrcPort, DstIP, DstType, DstID, DstPort."""
+        lookup = self.interner.lookup
+        rows = [
+            [
+                int(r["check_time_ms"]),
+                u32_to_ip(int(r["from_ip"])) if r["from_ip"] else "",
+                _EP_NAMES[int(r["from_type"])], lookup(int(r["from_uid"])),
+                int(r["from_port"]),
+                u32_to_ip(int(r["to_ip"])) if r["to_ip"] else "",
+                _EP_NAMES[int(r["to_type"])], lookup(int(r["to_uid"])),
+                int(r["to_port"]),
+            ]
+            for r in batch
+        ]
+        self._append("connections", rows)
+
+    def persist_resource(self, rtype: ResourceType, event: EventType, obj: Any) -> None:
+        stream = self._resource_streams[rtype]
+        body = dict(obj.__dict__) if hasattr(obj, "__dict__") else obj
+        with self._lock:
+            stream.pending.append({"event": event.value, "body": _jsonable(body)})
+
+    # -- batching ----------------------------------------------------------
+
+    def _append(self, name: str, rows: List[Any]) -> None:
+        stream = self._streams[name]
+        with self._lock:
+            stream.pending.extend(rows)
+
+    def pump(self, force: bool = False) -> None:
+        """Flush every stream that hit its batch size or cadence."""
+        now = self.time_fn()
+        for stream in list(self._streams.values()) + list(self._resource_streams.values()):
+            with self._lock:
+                due = (
+                    force
+                    or len(stream.pending) >= stream.batch_size
+                    or (stream.pending and now - stream.last_flush >= stream.interval_s)
+                )
+                if not due or not stream.pending:
+                    if due:
+                        stream.last_flush = now
+                    continue
+                todo = stream.pending
+                stream.pending = []
+                stream.last_flush = now
+            # send outside the lock, chunked to batch_size
+            for i in range(0, len(todo), stream.batch_size):
+                chunk = todo[i : i + stream.batch_size]
+                ok = self._send(stream.endpoint, chunk)
+                if ok:
+                    stream.sent += len(chunk)
+                else:
+                    stream.failed += len(chunk)
+
+    def _send(self, endpoint: str, rows: List[Any]) -> bool:
+        payload = {
+            "metadata": {
+                "monitoring_id": self.cfg.monitoring_id,
+                "idempotency_key": str(uuid.uuid4()),
+                "node_id": self.cfg.node_id,
+                "alaz_version": __version__,
+            },
+            "data": rows,
+        }
+        backoff = self.cfg.backoff_min_s
+        for attempt in range(self.cfg.max_retries + 1):
+            try:
+                status = self.transport(endpoint, payload)
+            except Exception as exc:  # transport failure == retryable
+                log.warning(f"transport error on {endpoint}: {exc}")
+                status = 599
+            if status < 400:
+                return True
+            if status not in (400, 429) and status < 500:
+                return False  # non-retryable 4xx
+            if attempt < self.cfg.max_retries:
+                self.sleep_fn(min(backoff + random.random() * 0.1, self.cfg.backoff_max_s))
+                backoff *= 2
+        return False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, poll_interval_s: float = 0.5) -> None:
+        """Daemon flusher thread (sendReqsInBatch-style tickers)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run() -> None:
+            while not self._stop.is_set():
+                self.pump()
+                self._stop.wait(poll_interval_s)
+
+        self._thread = threading.Thread(target=run, name="alaz-backend-pump", daemon=True)
+        self._thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if flush:
+            self.pump(force=True)
+
+    def stats(self) -> dict:
+        out = {}
+        for s in list(self._streams.values()) + list(self._resource_streams.values()):
+            out[s.name] = {"pending": len(s.pending), "sent": s.sent, "failed": s.failed}
+        return out
+
+
+def _jsonable(obj: Any) -> Any:
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return json.loads(json.dumps(obj, default=lambda o: getattr(o, "__dict__", str(o))))

@@ -1,0 +1,59 @@
+"""String interning: the boundary between string-land and array-land.
+
+The reference carries strings (UIDs, pod names, paths, topics) through its
+whole pipeline and pays for it in GC pressure — it mitigates with object
+pools (datastore/backend.go:767-797). We instead intern every string to a
+dense int32 id the moment it enters the system; everything downstream is
+integer arrays, and ids become embedding-table rows on device for free.
+
+Id 0 is always the empty string, so zero-initialized arrays mean "no value".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List
+
+import numpy as np
+
+
+class Interner:
+    """Thread-safe append-only string <-> int32 table."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._to_id: dict[str, int] = {"": 0}
+        self._strings: List[str] = [""]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def intern(self, s: str) -> int:
+        sid = self._to_id.get(s)
+        if sid is not None:
+            return sid
+        with self._lock:
+            sid = self._to_id.get(s)
+            if sid is None:
+                sid = len(self._strings)
+                self._strings.append(s)
+                self._to_id[s] = sid
+            return sid
+
+    def intern_many(self, strings: Iterable[str]) -> np.ndarray:
+        return np.fromiter((self.intern(s) for s in strings), dtype=np.int32)
+
+    def lookup(self, sid: int) -> str:
+        return self._strings[sid]
+
+    def lookup_many(self, ids: np.ndarray) -> List[str]:
+        strings = self._strings
+        return [strings[i] for i in ids]
+
+    def get(self, s: str) -> int | None:
+        """Id if already interned, else None (no allocation)."""
+        return self._to_id.get(s)
+
+    def snapshot(self) -> List[str]:
+        with self._lock:
+            return list(self._strings)

@@ -1,0 +1,14 @@
+"""Shared utilities: bounded queues, clocks, rate limiting."""
+
+from alaz_tpu.utils.queues import BatchQueue, QueueClosed
+from alaz_tpu.utils.clock import Clock, VirtualClock, WallClock
+from alaz_tpu.utils.ratelimit import TokenBucket
+
+__all__ = [
+    "BatchQueue",
+    "QueueClosed",
+    "Clock",
+    "VirtualClock",
+    "WallClock",
+    "TokenBucket",
+]

@@ -1,0 +1,135 @@
+"""Bounded batch queues — the channel plane.
+
+The reference moves single events through bounded Go channels and prefers
+dropping to blocking at the kernel boundary (ebpf/l7_req/l7.go:764-770,
+dropped-count logging l7.go:681-687). Here the queue element is a columnar
+*batch* and the capacity is counted in **events**, not batches, so config
+maps one-to-one to the reference's channel sizes (collector.go:79-81).
+
+``put_nowait_drop`` implements drop-not-block with a running drop counter;
+``put`` blocks (used between internal stages where backpressure is safe).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Optional
+
+
+class QueueClosed(Exception):
+    pass
+
+
+class BatchQueue:
+    """Bounded MPMC queue of (batch, aux) items; capacity in events."""
+
+    def __init__(self, capacity_events: int, name: str = "queue"):
+        self.name = name
+        self.capacity = int(capacity_events)
+        self._items: collections.deque = collections.deque()
+        self._events = 0
+        self._dropped = 0
+        self._put_total = 0
+        self._closed = False
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def pending_events(self) -> int:
+        return self._events
+
+    @property
+    def dropped(self) -> int:
+        """Total events dropped at the mouth of the queue (l7.go:764-770)."""
+        return self._dropped
+
+    @property
+    def put_total(self) -> int:
+        return self._put_total
+
+    def _size_of(self, batch: Any) -> int:
+        try:
+            return len(batch)
+        except TypeError:
+            return 1
+
+    def put_nowait_drop(self, batch: Any) -> bool:
+        """Enqueue unless full; on full, count the events as dropped and
+        return False. Never blocks — the kernel-boundary contract."""
+        n = self._size_of(batch)
+        with self._lock:
+            if self._closed:
+                raise QueueClosed(self.name)
+            if self._events + n > self.capacity:
+                self._dropped += n
+                return False
+            self._items.append(batch)
+            self._events += n
+            self._put_total += n
+            self._not_empty.notify()
+            return True
+
+    def put(self, batch: Any, timeout: Optional[float] = None) -> bool:
+        """Blocking enqueue for interior stages."""
+        n = self._size_of(batch)
+        with self._not_full:
+            while not self._closed and self._events + n > self.capacity and self._events > 0:
+                if not self._not_full.wait(timeout):
+                    return False
+            if self._closed:
+                raise QueueClosed(self.name)
+            self._items.append(batch)
+            self._events += n
+            self._put_total += n
+            self._not_empty.notify()
+            return True
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Blocking dequeue; returns None on timeout or when closed+drained."""
+        with self._not_empty:
+            while not self._items:
+                if self._closed:
+                    return None
+                if not self._not_empty.wait(timeout):
+                    return None
+            batch = self._items.popleft()
+            self._events -= self._size_of(batch)
+            self._not_full.notify()
+            return batch
+
+    def drain(self) -> list:
+        """Grab everything currently queued (for batch-oriented consumers)."""
+        with self._lock:
+            items = list(self._items)
+            self._items.clear()
+            self._events = 0
+            self._not_full.notify_all()
+            return items
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        """Lag/drop gauges, the data.go:177-186 channel-lag log analog."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "pending_events": self._events,
+                "pending_batches": len(self._items),
+                "capacity": self.capacity,
+                "dropped": self._dropped,
+                "put_total": self._put_total,
+            }

@@ -1,0 +1,30 @@
+"""Token-bucket rate limiting.
+
+The reference rate-limits per-pid event processing at 100 events/s with a
+burst of 1000 (aggregator/data.go:339-353, golang.org/x/time/rate). This is
+a vectorized variant: one call admits/charges a whole batch.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class TokenBucket:
+    def __init__(self, rate_per_s: float, burst: float, now_s: float = 0.0):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._last = float(now_s)
+        self._lock = threading.Lock()
+
+    def admit(self, n: int, now_s: float) -> int:
+        """Admit up to n units at time now_s; returns how many were admitted
+        (the rest should be dropped, mirroring rate.Limiter.Allow)."""
+        with self._lock:
+            elapsed = max(0.0, now_s - self._last)
+            self._last = now_s
+            self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+            take = min(float(n), self._tokens)
+            self._tokens -= take
+            return int(take)

@@ -1,0 +1,9 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh before any import
+(the multi-host story SURVEY §4 notes the reference lacks)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,381 @@
+"""Aggregator engine: cluster attribution, L7 join, retries, h2, kafka."""
+
+import struct
+
+import numpy as np
+
+from alaz_tpu.aggregator import Aggregator, ClusterInfo
+from alaz_tpu.datastore.dto import EP_OUTBOUND, EP_POD, EP_SERVICE
+from alaz_tpu.datastore.inmem import InMemDataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import EventType, K8sResourceMessage, Pod, ResourceType, Service
+from alaz_tpu.events.net import ip_to_u32
+from alaz_tpu.events.schema import (
+    Http2Method,
+    HttpMethod,
+    L7Protocol,
+    TcpEventType,
+    make_l7_events,
+    make_tcp_events,
+    set_payloads,
+)
+from alaz_tpu.protocols import hpack, http2
+
+
+def make_cluster(interner):
+    cluster = ClusterInfo(interner)
+    cluster.handle_msg(
+        K8sResourceMessage(
+            ResourceType.POD, EventType.ADD, Pod(uid="pod-a", name="a", ip="10.0.0.1")
+        )
+    )
+    cluster.handle_msg(
+        K8sResourceMessage(
+            ResourceType.POD, EventType.ADD, Pod(uid="pod-b", name="b", ip="10.0.0.2")
+        )
+    )
+    cluster.handle_msg(
+        K8sResourceMessage(
+            ResourceType.SERVICE,
+            EventType.ADD,
+            Service(uid="svc-x", name="x", cluster_ip="10.96.0.1"),
+        )
+    )
+    return cluster
+
+
+class TestClusterInfo:
+    def test_attribute_order_pod_service_outbound(self):
+        interner = Interner()
+        c = make_cluster(interner)
+        ips = np.array(
+            [ip_to_u32("10.0.0.1"), ip_to_u32("10.96.0.1"), ip_to_u32("8.8.8.8")],
+            dtype=np.uint32,
+        )
+        types, uids = c.attribute(ips)
+        assert list(types) == [EP_POD, EP_SERVICE, EP_OUTBOUND]
+        assert interner.lookup(int(uids[0])) == "pod-a"
+        assert interner.lookup(int(uids[1])) == "svc-x"
+
+    def test_pod_ip_update_and_delete(self):
+        interner = Interner()
+        c = make_cluster(interner)
+        # pod-a moves IP
+        c.handle_msg(
+            K8sResourceMessage(
+                ResourceType.POD, EventType.UPDATE, Pod(uid="pod-a", ip="10.0.0.9")
+            )
+        )
+        t, _ = c.attribute(np.array([ip_to_u32("10.0.0.1")], dtype=np.uint32))
+        assert t[0] == EP_OUTBOUND  # old ip unmapped
+        t, _ = c.attribute(np.array([ip_to_u32("10.0.0.9")], dtype=np.uint32))
+        assert t[0] == EP_POD
+        c.handle_msg(
+            K8sResourceMessage(ResourceType.POD, EventType.DELETE, Pod(uid="pod-a"))
+        )
+        t, _ = c.attribute(np.array([ip_to_u32("10.0.0.9")], dtype=np.uint32))
+        assert t[0] == EP_OUTBOUND
+
+
+def _establish(agg, pid=100, fd=7, saddr="10.0.0.1", daddr="10.96.0.1", ts=1_000):
+    tcp = make_tcp_events(1)
+    tcp["pid"], tcp["fd"], tcp["timestamp_ns"] = pid, fd, ts
+    tcp["type"] = TcpEventType.ESTABLISHED
+    tcp["saddr"], tcp["sport"] = ip_to_u32(saddr), 4000
+    tcp["daddr"], tcp["dport"] = ip_to_u32(daddr), 80
+    agg.process_tcp(tcp)
+
+
+def _http_events(n, pid=100, fd=7, ts0=2_000, payload=b"GET /user HTTP/1.1\r\nHost: h\r\n\r\n"):
+    ev = make_l7_events(n)
+    ev["pid"], ev["fd"] = pid, fd
+    ev["write_time_ns"] = ts0 + np.arange(n)
+    ev["duration_ns"] = 50
+    ev["protocol"] = L7Protocol.HTTP
+    ev["method"] = HttpMethod.GET
+    ev["status"] = 200
+    set_payloads(ev, payload)
+    return ev
+
+
+class TestL7Join:
+    def test_socketline_join_and_attribution(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg)
+        out = agg.process_l7(_http_events(10), now_ns=10_000)
+        assert out.shape[0] == 10
+        assert ds.request_count == 10
+        rows = ds.all_requests()
+        assert (rows["from_type"] == EP_POD).all()
+        assert (rows["to_type"] == EP_SERVICE).all()
+        assert interner.lookup(int(rows["from_uid"][0])) == "pod-a"
+        assert interner.lookup(int(rows["to_uid"][0])) == "svc-x"
+        assert interner.lookup(int(rows["path"][0])) == "/user"
+        assert (rows["status_code"] == 200).all()
+        assert (rows["latency_ns"] == 50).all()
+
+    def test_v2_embedded_addresses_skip_join(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        ev = _http_events(5)
+        ev["saddr"] = ip_to_u32("10.0.0.2")
+        ev["sport"] = 555
+        ev["daddr"] = ip_to_u32("10.0.0.1")  # pod→pod
+        ev["dport"] = 8080
+        out = agg.process_l7(ev, now_ns=10_000)
+        assert out.shape[0] == 5
+        rows = ds.all_requests()
+        assert (rows["to_type"] == EP_POD).all()
+        assert interner.lookup(int(rows["from_uid"][0])) == "pod-b"
+
+    def test_unmatched_requeues_then_drops(self):
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        # no establish → no socket line
+        out = agg.process_l7(_http_events(4), now_ns=1_000_000)
+        assert out.shape[0] == 0
+        assert agg.stats.l7_requeued == 4
+        # retries exhaust (attemptLimit 3) after enough virtual time
+        agg.flush_retries(now_ns=10_000_000_000)
+        agg.flush_retries(now_ns=20_000_000_000)
+        assert agg.stats.l7_dropped_no_socket == 4
+
+    def test_retry_succeeds_after_tcp_arrives(self):
+        # the signal-and-requeue race: L7 before TCP state (data.go:404-437)
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.process_l7(_http_events(4), now_ns=1_000)
+        assert ds.request_count == 0
+        _establish(agg)
+        emitted = agg.flush_retries(now_ns=100_000_000)
+        assert emitted is not None and emitted.shape[0] == 4
+        assert ds.request_count == 4
+
+    def test_non_pod_source_dropped(self):
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg, saddr="172.16.0.1")  # not a pod IP
+        agg.process_l7(_http_events(3), now_ns=10_000)
+        assert ds.request_count == 0
+        assert agg.stats.l7_dropped_not_pod == 3
+
+    def test_outbound_destination_gets_ip_uid(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg, daddr="93.184.216.34")
+        agg.process_l7(_http_events(2), now_ns=10_000)
+        rows = ds.all_requests()
+        assert (rows["to_type"] == EP_OUTBOUND).all()
+        assert interner.lookup(int(rows["to_uid"][0])) == "93.184.216.34"
+
+    def test_tls_flag_carried(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg)
+        ev = _http_events(2)
+        ev["tls"] = True
+        agg.process_l7(ev, now_ns=10_000)
+        rows = ds.all_requests()
+        assert rows["tls"].all()
+        # export view renders HTTPS (processHttpEvent data.go:1240-1242)
+        from alaz_tpu.datastore.dto import iter_request_views
+
+        views = list(iter_request_views(rows, interner))
+        assert views[0].protocol == "HTTP"  # enum name; HTTPS at payload layer
+
+
+class TestH2:
+    def test_grpc_pair_assembly(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg)
+
+        enc_c = hpack.Encoder()
+        enc_s = hpack.Encoder()
+        req_block = enc_c.encode(
+            [
+                (":method", "POST"),
+                (":path", "/pkg.Svc/Do"),
+                (":authority", "svc"),
+                ("content-type", "application/grpc"),
+            ]
+        )
+        resp_block = enc_s.encode([(":status", "200"), ("grpc-status", "0")])
+
+        def frame(block, stream_id):
+            return (
+                len(block).to_bytes(3, "big")
+                + bytes([http2.FRAME_HEADERS, http2.FLAG_END_HEADERS])
+                + stream_id.to_bytes(4, "big")
+                + block
+            )
+
+        ev = make_l7_events(2)
+        ev["pid"], ev["fd"] = 100, 7
+        ev["protocol"] = L7Protocol.HTTP2
+        ev["method"][0] = Http2Method.CLIENT_FRAME
+        ev["method"][1] = Http2Method.SERVER_FRAME
+        ev["write_time_ns"][0] = 5_000
+        ev["write_time_ns"][1] = 6_500
+        for i, block in enumerate((frame(req_block, 1), frame(resp_block, 1))):
+            buf = np.frombuffer(block, dtype=np.uint8)
+            ev["payload"][i, : buf.shape[0]] = buf
+            ev["payload_size"][i] = buf.shape[0]
+
+        agg.process_l7(ev, now_ns=10_000)
+        rows = ds.all_requests()
+        assert rows.shape[0] == 1
+        assert interner.lookup(int(rows["path"][0])) == "/pkg.Svc/Do"
+        assert rows["status_code"][0] == 0  # grpc-status wins for gRPC
+        assert rows["latency_ns"][0] == 1_500
+
+
+class TestKafkaFlow:
+    def test_produce_payload_to_kafka_event(self):
+        from tests.test_protocols import TestKafka
+
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg)
+
+        wire = TestKafka()._produce_request(b"orders", b"k1", b"v1")
+        ev = make_l7_events(1)
+        ev["pid"], ev["fd"] = 100, 7
+        ev["write_time_ns"] = 5_000
+        ev["protocol"] = L7Protocol.KAFKA
+        buf = np.frombuffer(wire, dtype=np.uint8)
+        ev["payload"][0, : buf.shape[0]] = buf
+        ev["payload_size"] = buf.shape[0]
+
+        agg.process_l7(ev, now_ns=10_000)
+        assert ds.kafka_count == 1
+        kb = ds.kafka_batches[0]
+        assert interner.lookup(int(kb["topic"][0])) == "orders"
+        assert interner.lookup(int(kb["value"][0])) == "v1"
+        assert kb["type"][0] == 1  # PUBLISH
+
+
+class TestProcEvents:
+    def test_exit_removes_socket_lines(self):
+        from alaz_tpu.events.schema import ProcEventType, make_proc_events
+
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg, pid=55, fd=1)
+        _establish(agg, pid=55, fd=2)
+        assert len(agg.socket_lines) == 2
+        pe = make_proc_events(1)
+        pe["pid"], pe["type"] = 55, ProcEventType.EXIT
+        agg.process_proc(pe)
+        assert len(agg.socket_lines) == 0
+
+
+class TestCodeReviewRegressions:
+    def test_truncated_kafka_produce_still_decodes(self):
+        """Produce requests longer than the capture window must still route
+        to the produce decoder via the kernel-assigned method (the kernel's
+        exact-size check uses the full write size, but capture is capped at
+        MAX_PAYLOAD_SIZE, so userspace sees truncated produce payloads).
+        Records that fit in the window decode; the truncated tail doesn't."""
+        import struct as _struct
+
+        from alaz_tpu.events.schema import KafkaMethod
+        from alaz_tpu.protocols import kafka as kafka_proto
+        from tests.test_protocols import _zigzag
+
+        def record(key: bytes, value: bytes) -> bytes:
+            body = bytes([0]) + _zigzag(0) + _zigzag(0)
+            body += _zigzag(len(key)) + key + _zigzag(len(value)) + value + _zigzag(0)
+            return _zigzag(len(body)) + body
+
+        recs = record(b"k1", b"v1") + record(b"k2", b"v" * 300)
+        batch_tail = _struct.pack("!iBihiqqqhii", 0, 2, 0, 0, 1, 0, 0, -1, -1, -1, 2) + recs
+        batch = _struct.pack("!qi", 0, len(batch_tail)) + batch_tail
+        body = _struct.pack("!h", -1) + _struct.pack("!hi", 1, 30000)
+        body += _struct.pack("!i", 1) + _struct.pack("!h", 6) + b"orders"
+        body += _struct.pack("!i", 1) + _struct.pack("!i", 0)
+        body += _struct.pack("!i", len(batch)) + batch
+        header = _struct.pack("!hhi", kafka_proto.API_KEY_PRODUCE, 3, 123)
+        header += _struct.pack("!h", 4) + b"test"
+        wire = _struct.pack("!i", len(header + body)) + header + body
+        assert len(wire) > 256  # exceeds MAX_PAYLOAD_SIZE → truncated capture
+
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        _establish(agg)
+        ev = make_l7_events(1)
+        ev["pid"], ev["fd"] = 100, 7
+        ev["write_time_ns"] = 5_000
+        ev["protocol"] = L7Protocol.KAFKA
+        ev["method"] = KafkaMethod.PRODUCE_REQUEST
+        buf = np.frombuffer(wire[:256], dtype=np.uint8)
+        ev["payload"][0, : buf.shape[0]] = buf
+        ev["payload_size"] = 256
+        agg.process_l7(ev, now_ns=10_000)
+        assert ds.kafka_count == 1  # first record survived truncation
+        kb = ds.kafka_batches[0]
+        assert interner.lookup(int(kb["topic"][0])) == "orders"
+        assert interner.lookup(int(kb["value"][0])) == "v1"
+
+    def test_h2_server_frame_without_status_completes(self):
+        """gRPC trailers-only server HEADERS (grpc-status, no :status) must
+        still complete the pair (data.go:775-777 semantics)."""
+        from alaz_tpu.aggregator.h2 import Http2Assembler
+
+        asm = Http2Assembler()
+        enc_c, enc_s = hpack.Encoder(), hpack.Encoder()
+
+        def frame(block, sid=1):
+            return (
+                len(block).to_bytes(3, "big")
+                + bytes([http2.FRAME_HEADERS, http2.FLAG_END_HEADERS])
+                + sid.to_bytes(4, "big")
+                + block
+            )
+
+        req = enc_c.encode([(":method", "POST"), (":path", "/S/M"), ("content-type", "application/grpc")])
+        trailers = enc_s.encode([("grpc-status", "13")])
+        assert asm.feed(1, 2, True, frame(req), 100) == []
+        done = asm.feed(1, 2, False, frame(trailers), 300)
+        assert len(done) == 1
+        assert done[0].grpc_status == 13 and done[0].latency_ns == 200
+
+    def test_endpoints_learned_ip(self):
+        from alaz_tpu.events.k8s import Address, AddressIP, Endpoints
+
+        interner = Interner()
+        c = ClusterInfo(interner)
+        ep = Endpoints(
+            uid="ep1",
+            addresses=[Address(ips=[AddressIP(type="pod", id="pod-ep", ip="10.0.9.9")])],
+        )
+        c.handle_msg(K8sResourceMessage(ResourceType.ENDPOINTS, EventType.ADD, ep))
+        t, u = c.attribute(np.array([ip_to_u32("10.0.9.9")], dtype=np.uint32))
+        assert t[0] == EP_POD
+        assert interner.lookup(int(u[0])) == "pod-ep"
+        # a later pod DELETE for that uid cleans the learned IP
+        c.handle_msg(K8sResourceMessage(ResourceType.POD, EventType.DELETE, Pod(uid="pod-ep")))
+        t, _ = c.attribute(np.array([ip_to_u32("10.0.9.9")], dtype=np.uint32))
+        assert t[0] != EP_POD

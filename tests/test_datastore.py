@@ -1,0 +1,165 @@
+"""Batching backend: cadence, batch-size flush, retries, idempotency."""
+
+import numpy as np
+
+from alaz_tpu.config import BackendConfig
+from alaz_tpu.datastore.backend import BatchingBackend, EP_REQUESTS
+from alaz_tpu.datastore.dto import make_requests
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import EventType, Pod, ResourceType
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class RecordingTransport:
+    def __init__(self, statuses=None):
+        self.calls = []
+        self.statuses = list(statuses or [])
+
+    def __call__(self, endpoint, payload):
+        self.calls.append((endpoint, payload))
+        return self.statuses.pop(0) if self.statuses else 200
+
+
+def make_backend(transport, clock, **cfg_kwargs):
+    cfg = BackendConfig(**cfg_kwargs)
+    return BatchingBackend(
+        transport,
+        Interner(),
+        cfg,
+        time_fn=clock.time,
+        sleep_fn=clock.sleep,
+    )
+
+
+def test_batch_size_flush():
+    clock, tr = FakeClock(), RecordingTransport()
+    be = make_backend(tr, clock, batch_size=10, req_flush_interval_s=999)
+    be.persist_requests(make_requests(25))
+    be.pump()
+    # 25 pending ≥ 10 → flushed in chunks of 10
+    eps = [c[0] for c in tr.calls]
+    assert eps == [EP_REQUESTS] * 3
+    sizes = [len(c[1]["data"]) for c in tr.calls]
+    assert sizes == [10, 10, 5]
+
+
+def test_interval_flush():
+    clock, tr = FakeClock(), RecordingTransport()
+    be = make_backend(tr, clock, batch_size=1000, req_flush_interval_s=5.0)
+    be.persist_requests(make_requests(3))
+    be.pump()
+    assert tr.calls == []  # neither size nor cadence hit
+    clock.t += 6.0
+    be.pump()
+    assert len(tr.calls) == 1 and len(tr.calls[0][1]["data"]) == 3
+
+
+def test_metadata_and_idempotency():
+    clock, tr = FakeClock(), RecordingTransport()
+    be = make_backend(tr, clock, batch_size=1, monitoring_id="mon-1", node_id="n-7")
+    be.persist_requests(make_requests(1))
+    be.pump()
+    be.persist_requests(make_requests(1))
+    be.pump()
+    m1 = tr.calls[0][1]["metadata"]
+    m2 = tr.calls[1][1]["metadata"]
+    assert m1["monitoring_id"] == "mon-1" and m1["node_id"] == "n-7"
+    assert m1["idempotency_key"] != m2["idempotency_key"]
+
+
+def test_retry_on_5xx_then_success():
+    clock = FakeClock()
+    tr = RecordingTransport(statuses=[500, 200])
+    be = make_backend(tr, clock, batch_size=1, max_retries=2)
+    be.persist_requests(make_requests(1))
+    be.pump()
+    assert len(tr.calls) == 2
+    assert be.stats()["requests"]["sent"] == 1
+    assert len(clock.sleeps) == 1  # one backoff
+
+
+def test_retry_exhaustion_counts_failed():
+    clock = FakeClock()
+    tr = RecordingTransport(statuses=[500, 500, 500])
+    be = make_backend(tr, clock, batch_size=1, max_retries=2)
+    be.persist_requests(make_requests(1))
+    be.pump()
+    assert len(tr.calls) == 3  # initial + 2 retries (backend.go:210-278)
+    assert be.stats()["requests"]["failed"] == 1
+
+
+def test_non_retryable_4xx():
+    clock = FakeClock()
+    tr = RecordingTransport(statuses=[404])
+    be = make_backend(tr, clock, batch_size=1, max_retries=2)
+    be.persist_requests(make_requests(1))
+    be.pump()
+    assert len(tr.calls) == 1  # 404 is terminal; only 400/429/5xx retry
+
+
+def test_resource_stream_endpoints():
+    clock, tr = FakeClock(), RecordingTransport()
+    be = make_backend(tr, clock, batch_size=1)
+    be.persist_pod(Pod(uid="u1", name="p", ip="10.0.0.1"), EventType.ADD)
+    be.pump(force=True)
+    assert tr.calls[0][0] == "/pod/"
+    body = tr.calls[0][1]["data"][0]
+    assert body["event"] == "Add" and body["body"]["uid"] == "u1"
+
+
+def test_request_payload_shape():
+    clock, tr = FakeClock(), RecordingTransport()
+    interner = Interner()
+    be = BatchingBackend(tr, interner, BackendConfig(batch_size=1), time_fn=clock.time, sleep_fn=clock.sleep)
+    batch = make_requests(1)
+    batch["status_code"] = 200
+    batch["path"] = interner.intern("/x")
+    be.persist_requests(batch)
+    be.pump(force=True)
+    row = tr.calls[0][1]["data"][0]
+    assert len(row) == 16  # ReqInfo[16] arity (payload.go:109-130)
+    assert row[14] == "/x"
+
+
+def test_alive_connection_payload_arity():
+    clock, tr = FakeClock(), RecordingTransport()
+    interner = Interner()
+    be = BatchingBackend(tr, interner, BackendConfig(conn_batch_size=1), time_fn=clock.time, sleep_fn=clock.sleep)
+    from alaz_tpu.datastore.dto import ALIVE_CONNECTION_DTYPE, EP_POD
+
+    batch = np.zeros(1, dtype=ALIVE_CONNECTION_DTYPE)
+    batch["from_type"] = EP_POD
+    batch["from_uid"] = interner.intern("pod-z")
+    be.persist_alive_connections(batch)
+    be.pump(force=True)
+    row = tr.calls[0][1]["data"][0]
+    assert len(row) == 9  # ConnInfo[9] (payload.go:137-150)
+    assert row[2] == "pod" and row[3] == "pod-z"
+
+
+def test_kafka_event_payload_arity():
+    clock, tr = FakeClock(), RecordingTransport()
+    interner = Interner()
+    be = BatchingBackend(tr, interner, BackendConfig(kafka_batch_size=1), time_fn=clock.time, sleep_fn=clock.sleep)
+    from alaz_tpu.datastore.dto import KAFKA_EVENT_DTYPE
+
+    batch = np.zeros(1, dtype=KAFKA_EVENT_DTYPE)
+    batch["topic"] = interner.intern("orders")
+    batch["type"] = 1
+    be.persist_kafka_events(batch)
+    be.pump(force=True)
+    row = tr.calls[0][1]["data"][0]
+    assert len(row) == 16  # KafkaEventInfo[16] (payload.go:163-180)
+    assert row[10] == "orders" and row[14] == "PUBLISH"

@@ -1,0 +1,76 @@
+"""End-to-end replay acceptance — the TestSimulation analog
+(main_benchmark_test.go:84-147): simulator → aggregator → datastore with
+the reference's own ≥90%-processed invariant, plus a throughput floor that
+the reference imposes implicitly by running in real time (20 edges ×
+10k req/s sustained)."""
+
+import numpy as np
+import pytest
+
+from alaz_tpu.config import SimulationConfig
+from alaz_tpu.datastore.inmem import InMemDataStore
+from alaz_tpu.replay.simulator import Simulator, run_replay
+from alaz_tpu.replay.trace import load_trace, save_trace
+
+
+def test_config1_small_acceptance():
+    """Scaled-down config1: full topology, shorter run."""
+    cfg = SimulationConfig(
+        test_duration_s=1.0, pod_count=100, service_count=50, edge_count=20, edge_rate=10_000
+    )
+    res = run_replay(cfg)
+    assert res.generated == 200_000
+    assert res.processed_ratio >= 0.9, res.aggregator_stats
+    assert res.passed
+
+
+@pytest.mark.slow
+def test_config1_full_acceptance_and_throughput():
+    """Full config1 (testconfig/config1.json): 20 edges × 10k/s × 15s = 3M
+    events, ≥90% processed, ≥200k events/s sustained."""
+    cfg = SimulationConfig(
+        test_duration_s=15.0, pod_count=100, service_count=50, edge_count=20, edge_rate=10_000
+    )
+    res = run_replay(cfg)
+    assert res.generated == 3_000_000
+    assert res.processed_ratio >= 0.9
+    assert res.events_per_s >= 200_000, f"too slow: {res.events_per_s:.0f}/s"
+
+
+def test_mixed_protocol_replay():
+    cfg = SimulationConfig(
+        test_duration_s=0.5,
+        pod_count=20,
+        service_count=10,
+        edge_count=8,
+        edge_rate=1_000,
+        protocol_mix={"HTTP": 0.5, "POSTGRES": 0.2, "REDIS": 0.2, "MYSQL": 0.1},
+    )
+    ds = InMemDataStore(retain=True)
+    res = run_replay(cfg, ds=ds)
+    assert res.processed_ratio >= 0.9
+    rows = ds.all_requests()
+    protos = set(np.unique(rows["protocol"]))
+    assert len(protos) >= 2  # mixed traffic survived end to end
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    cfg = SimulationConfig(test_duration_s=0.1, pod_count=5, service_count=2, edge_count=3, edge_rate=100)
+    sim = Simulator(cfg)
+    msgs = sim.setup()
+    tcp = sim.tcp_events()
+    path = tmp_path / "trace.npz"
+    save_trace(path, msgs, tcp, sim.iter_l7_batches())
+    msgs2, tcp2, l7 = load_trace(path)
+    assert len(msgs2) == len(msgs)
+    assert tcp2.shape == tcp.shape
+    assert l7.shape[0] == sim.expected_events
+    assert (tcp2["saddr"] == tcp["saddr"]).all()
+
+
+def test_determinism_same_seed():
+    cfg = SimulationConfig(test_duration_s=0.2, pod_count=10, service_count=5, edge_count=4, edge_rate=500, seed=7)
+    a = run_replay(cfg)
+    b = run_replay(cfg)
+    assert a.generated == b.generated
+    assert a.persisted == b.persisted

@@ -1,0 +1,121 @@
+"""Event schemas, interning, queues, clocks."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from alaz_tpu.events import (
+    Interner,
+    L7Protocol,
+    TcpEventType,
+    ip_to_u32,
+    ips_to_u32,
+    make_l7_events,
+    make_tcp_events,
+    method_to_string,
+    u32_to_ip,
+)
+from alaz_tpu.events.schema import HttpMethod, set_payloads
+from alaz_tpu.utils import BatchQueue, TokenBucket, VirtualClock
+
+
+def test_ip_roundtrip():
+    for ip in ("10.0.0.1", "192.168.56.112", "255.255.255.255", "0.0.0.1"):
+        assert u32_to_ip(ip_to_u32(ip)) == ip
+    arr = ips_to_u32(["10.0.0.1", "10.0.0.2"])
+    assert arr.dtype == np.uint32
+    assert arr[1] - arr[0] == 1
+
+
+def test_interner_basics():
+    it = Interner()
+    assert it.intern("") == 0
+    a = it.intern("/users")
+    assert it.intern("/users") == a
+    b = it.intern("/orders")
+    assert b != a
+    assert it.lookup(a) == "/users"
+    ids = it.intern_many(["/users", "/orders", "/users"])
+    assert list(ids) == [a, b, a]
+    assert it.lookup_many(ids) == ["/users", "/orders", "/users"]
+    assert it.get("/nope") is None
+
+
+def test_interner_threaded():
+    it = Interner()
+    strings = [f"s{i % 100}" for i in range(1000)]
+    out = [None] * 8
+
+    def work(k):
+        out[k] = [it.intern(s) for s in strings]
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(o == out[0] for o in out)
+    assert len(it) == 101  # 100 + empty string
+
+
+def test_method_strings_match_reference_enum_order():
+    # l7.go:204-325 string tables
+    assert method_to_string(L7Protocol.HTTP, HttpMethod.GET) == "GET"
+    assert method_to_string(L7Protocol.HTTP, HttpMethod.TRACE) == "TRACE"
+    assert method_to_string(L7Protocol.AMQP, 1) == "PUBLISH"
+    assert method_to_string(L7Protocol.POSTGRES, 2) == "SIMPLE_QUERY"
+    assert method_to_string(L7Protocol.REDIS, 2) == "PUSHED_EVENT"
+    assert method_to_string(L7Protocol.KAFKA, 1) == "PRODUCE_REQUEST"
+    assert method_to_string(L7Protocol.MYSQL, 3) == "EXEC_STMT"
+    assert method_to_string(L7Protocol.MONGO, 1) == "OP_MSG"
+    assert method_to_string(L7Protocol.HTTP, 0) == ""
+
+
+def test_event_arrays():
+    ev = make_l7_events(4)
+    assert ev.shape == (4,)
+    set_payloads(ev, b"GET / HTTP/1.1")
+    assert bytes(ev["payload"][0][:3]) == b"GET"
+    assert ev["payload_size"][0] == 14
+    tcp = make_tcp_events(2)
+    tcp["type"][0] = TcpEventType.ESTABLISHED
+    assert tcp["type"][0] == 1  # BPF enum value
+
+
+def test_batch_queue_drop_not_block():
+    q = BatchQueue(capacity_events=10, name="t")
+    a = np.zeros(6)
+    assert q.put_nowait_drop(a)
+    assert not q.put_nowait_drop(np.zeros(6))  # would exceed capacity
+    assert q.dropped == 6
+    assert q.put_nowait_drop(np.zeros(4))
+    got = q.get(timeout=0.1)
+    assert got.shape[0] == 6
+    stats = q.stats()
+    assert stats["dropped"] == 6 and stats["put_total"] == 10
+
+
+def test_batch_queue_close_drains():
+    q = BatchQueue(100)
+    q.put_nowait_drop(np.zeros(3))
+    q.close()
+    assert q.get() is not None
+    assert q.get() is None
+    with pytest.raises(Exception):
+        q.put_nowait_drop(np.zeros(1))
+
+
+def test_token_bucket():
+    tb = TokenBucket(rate_per_s=100, burst=1000, now_s=0.0)
+    assert tb.admit(1000, 0.0) == 1000  # burst
+    assert tb.admit(1000, 0.0) == 0
+    assert tb.admit(1000, 1.0) == 100  # refilled 100 after 1s
+
+
+def test_virtual_clock():
+    c = VirtualClock(start_ns=1000)
+    assert c.now_ns() == 1000
+    c.advance(500)
+    assert c.now_ns() == 1500
+    assert c.kernel_to_wall_ns(c.wall_to_kernel_ns(123456)) == 123456

@@ -1,0 +1,403 @@
+"""Protocol classifier/parser parity tests.
+
+Behavior cases mirror the kernel classifiers (ebpf/c/*.c) and userspace
+parsers (aggregator/data.go) cited in each module's docstring.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from alaz_tpu.events.schema import (
+    AmqpMethod,
+    HttpMethod,
+    L7Protocol,
+    MongoMethod,
+    MySqlMethod,
+    PostgresMethod,
+    RedisMethod,
+)
+from alaz_tpu.protocols import (
+    amqp,
+    classify_request,
+    hpack,
+    http,
+    http2,
+    kafka,
+    mongo,
+    mysql,
+    postgres,
+    redis,
+)
+
+
+class TestHttp:
+    def test_methods(self):
+        assert http.parse_method(b"GET /user HTTP/1.1") == HttpMethod.GET
+        assert http.parse_method(b"POST /x HTTP/1.1") == HttpMethod.POST
+        assert http.parse_method(b"DELETE /x HTTP/1.1") == HttpMethod.DELETE
+        assert http.parse_method(b"CONNECT a:443 HTTP/1.1") == HttpMethod.CONNECT
+        assert http.parse_method(b"NOPE /x") == 0
+        assert http.parse_method(b"GET") == 0  # < MIN_METHOD_LEN (http.c:14)
+
+    def test_status(self):
+        assert http.parse_status(b"HTTP/1.1 200 OK") == 200
+        assert http.parse_status(b"HTTP/1.0 404 NF") == 404
+        assert http.parse_status(b"HTTP/2.0 503 X") == 503
+        assert http.parse_status(b"HTTP/1.1 2x0") == -1
+        assert http.parse_status(b"nothttp") == 0
+
+    def test_parse_payload(self):
+        m, p, v, h = http.parse_payload(b"GET /user?id=1 HTTP/1.1\r\nHost: api.svc\r\n\r\n")
+        assert (m, p, v) == ("GET", "/user?id=1", "HTTP/1.1\r")
+        assert h == "api.svc"
+
+    def test_vectorized_matches_scalar(self):
+        payloads = [
+            b"GET /a HTTP/1.1",
+            b"POST /b HTTP/1.1",
+            b"TRACE /c HTTP/1.1",
+            b"XXXX /d HTTP/1.1",
+            b"PUT",
+        ]
+        mat = np.zeros((len(payloads), 24), dtype=np.uint8)
+        sizes = np.zeros(len(payloads), dtype=np.uint32)
+        for i, p in enumerate(payloads):
+            mat[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+            sizes[i] = len(p)
+        got = http.classify_batch(mat, sizes)
+        want = [http.parse_method(p) for p in payloads]
+        assert list(got) == [max(0, w) for w in want]
+
+        resp = [b"HTTP/1.1 200 OK ", b"HTTP/1.1 500 NO ", b"garbagegarbage  ", b"short"]
+        mat2 = np.zeros((len(resp), 16), dtype=np.uint8)
+        sizes2 = np.zeros(len(resp), dtype=np.uint32)
+        for i, p in enumerate(resp):
+            mat2[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+            sizes2[i] = len(p)
+        got2 = http.parse_status_batch(mat2, sizes2)
+        assert list(got2) == [200, 500, -1, 0]
+
+
+class TestHttp2:
+    def test_magic_and_frames(self):
+        assert http2.is_frame(http2.MAGIC)
+        # HEADERS frame, stream 1
+        frame = b"\x00\x00\x05" + bytes([http2.FRAME_HEADERS, 0x04]) + b"\x00\x00\x00\x01" + b"abcde"
+        assert http2.is_frame(frame)
+        # even stream id → not tracked (http2.c:108-112)
+        frame_even = b"\x00\x00\x05" + bytes([1, 4]) + b"\x00\x00\x00\x02" + b"abcde"
+        assert not http2.is_frame(frame_even)
+        # stream 0 (settings/ping) → tracked
+        frame_zero = b"\x00\x00\x00" + bytes([4, 0]) + b"\x00\x00\x00\x00"
+        assert http2.is_frame(frame_zero)
+        # invalid type
+        bad = b"\x00\x00\x00" + bytes([0x0A, 0]) + b"\x00\x00\x00\x01"
+        assert not http2.is_frame(bad)
+
+    def test_iter_frames(self):
+        f1 = b"\x00\x00\x03" + bytes([0, 0]) + b"\x00\x00\x00\x01" + b"xyz"
+        f2 = b"\x00\x00\x02" + bytes([1, 4]) + b"\x00\x00\x00\x03" + b"ab"
+        frames = list(http2.iter_frames(http2.MAGIC + f1 + f2))
+        assert [(f.stream_id, f.type) for f in frames] == [(1, 0), (3, 1)]
+
+
+class TestHpack:
+    def test_rfc7541_huffman_vectors(self):
+        vectors = {
+            b"www.example.com": "f1e3c2e5f23a6ba0ab90f4ff",
+            b"no-cache": "a8eb10649cbf",
+            b"custom-key": "25a849e95ba97d7f",
+            b"custom-value": "25a849e95bb8e8b4bf",
+            b"302": "6402",
+            b"private": "aec3771a4b",
+            b"Mon, 21 Oct 2013 20:13:21 GMT": "d07abe941054d444a8200595040b8166e082a62d1bff",
+            b"https://www.example.com": "9d29ad171863c78f0b97c8e9ae82ae43d3",
+            b"307": "640eff",
+            b"gzip": "9bd9ab",
+        }
+        for raw, hexv in vectors.items():
+            assert hpack.huffman_encode(raw).hex() == hexv
+            assert hpack.huffman_decode(bytes.fromhex(hexv)) == raw
+
+    def test_huffman_roundtrip_full_alphabet(self):
+        import random
+
+        rnd = random.Random(0)
+        for _ in range(100):
+            s = bytes(rnd.randrange(256) for _ in range(rnd.randrange(1, 64)))
+            assert hpack.huffman_decode(hpack.huffman_encode(s)) == s
+
+    def test_rfc7541_c3_requests(self):
+        d = hpack.Decoder()
+        h1 = d.decode(bytes.fromhex("828684410f7777772e6578616d706c652e636f6d"))
+        assert h1 == [
+            (":method", "GET"),
+            (":scheme", "http"),
+            (":path", "/"),
+            (":authority", "www.example.com"),
+        ]
+        # second request reuses the dynamic table entry
+        h2 = d.decode(bytes.fromhex("828684be58086e6f2d6361636865"))
+        assert (":authority", "www.example.com") in h2
+        assert ("cache-control", "no-cache") in h2
+
+    def test_rfc7541_c6_responses_huffman_with_eviction(self):
+        d = hpack.Decoder(max_table_size=256)
+        h1 = d.decode(
+            bytes.fromhex(
+                "488264025885aec3771a4b6196d07abe941054d444a8200595040b8166"
+                "e082a62d1bff6e919d29ad171863c78f0b97c8e9ae82ae43d3"
+            )
+        )
+        assert (":status", "302") in h1
+        assert ("location", "https://www.example.com") in h1
+        h2 = d.decode(bytes.fromhex("4883640effc1c0bf"))
+        assert (":status", "307") in h2
+        assert ("location", "https://www.example.com") in h2
+
+    def test_encoder_decoder_roundtrip(self):
+        enc = hpack.Encoder()
+        dec = hpack.Decoder()
+        headers = [
+            (":method", "POST"),
+            (":path", "/pkg.Service/Method"),
+            (":authority", "grpc.svc:50051"),
+            ("content-type", "application/grpc"),
+            ("x-custom", "value-1"),
+        ]
+        assert dec.decode(enc.encode(headers)) == headers
+        # second encode hits the encoder's dynamic table
+        assert dec.decode(enc.encode(headers)) == headers
+
+
+class TestPostgres:
+    def test_classify(self):
+        assert postgres.classify_request(b"Q\x00\x00\x00\x0bSELECT 1\x00") == PostgresMethod.SIMPLE_QUERY
+        assert postgres.classify_request(b"X\x00\x00\x00\x04") == PostgresMethod.CLOSE_OR_TERMINATE
+        parse = b"P\x00\x00\x00\x10s1\x00SELECT 1\x00\x00\x00" + b"S\x00\x00\x00\x04"
+        assert postgres.classify_request(parse) == PostgresMethod.EXTENDED_QUERY
+        # P without trailing Sync → not postgres (HTTP/2 magic guard)
+        assert postgres.classify_request(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n") == 0
+
+    def test_response(self):
+        assert postgres.parse_response(b"E\x00\x00\x00\x04") == postgres.ERROR_RESPONSE
+        assert postgres.parse_response(b"C\x00\x00\x00\x04") == postgres.COMMAND_COMPLETE
+        assert postgres.parse_response(b"Z\x00\x00\x00\x04") == 0
+
+    def test_parse_command_simple(self):
+        payload = b"Q\x00\x00\x00\x20SELECT * FROM users\x00"
+        assert postgres.parse_command(payload, PostgresMethod.SIMPLE_QUERY) == "SELECT * FROM users"
+        # garbage without SQL keywords dropped (data.go:1495-1500)
+        garbage = b"Q\x00\x00\x00\x08zzzz\x00"
+        assert postgres.parse_command(garbage, PostgresMethod.SIMPLE_QUERY) is None
+
+    def test_parse_command_extended_cache(self):
+        cache = {}
+        p = b"P\x00\x00\x00\x1fstmt1\x00SELECT * FROM t WHERE a=$1\x00\x00"
+        got = postgres.parse_command(p, PostgresMethod.EXTENDED_QUERY, cache, pid=7, fd=3)
+        assert got == "PREPARE stmt1 AS SELECT * FROM t WHERE a=$1"
+        b_msg = b"B\x00\x00\x00\x10\x00stmt1\x00rest"
+        got2 = postgres.parse_command(b_msg, PostgresMethod.EXTENDED_QUERY, cache, pid=7, fd=3)
+        assert got2 == "SELECT * FROM t WHERE a=$1"
+        # unknown stmt → EXECUTE placeholder (data.go:1540-1543)
+        got3 = postgres.parse_command(
+            b"B\x00\x00\x00\x10\x00nope\x00x", PostgresMethod.EXTENDED_QUERY, cache, pid=7, fd=3
+        )
+        assert got3 == "EXECUTE nope *values*"
+
+
+class TestMySql:
+    def _packet(self, com: int, body: bytes) -> bytes:
+        payload = bytes([com]) + body
+        ln = len(payload)
+        return bytes([ln & 0xFF, (ln >> 8) & 0xFF, (ln >> 16) & 0xFF, 0]) + payload
+
+    def test_classify(self):
+        q = self._packet(mysql.COM_QUERY, b"SELECT 1")
+        assert mysql.classify_request(q)[0] == MySqlMethod.TEXT_QUERY
+        p = self._packet(mysql.COM_STMT_PREPARE, b"SELECT ?")
+        assert mysql.classify_request(p)[0] == MySqlMethod.PREPARE_STMT
+        # bad length → reject (mysql.c:50-52)
+        assert mysql.classify_request(q[:-1])[0] == 0
+        # non-zero seq → reject
+        bad = bytearray(q)
+        bad[3] = 1
+        assert mysql.classify_request(bytes(bad))[0] == 0
+
+    def test_response_prepare_stmt_id(self):
+        resp = bytes([10, 0, 0, 1, 0x00]) + struct.pack("<I", 77) + b"xxxx"
+        status, stmt_id = mysql.parse_response(resp, MySqlMethod.PREPARE_STMT)
+        assert status == mysql.STATUS_OK and stmt_id == 77
+        err = bytes([3, 0, 0, 1, 0xFF]) + b"xx"
+        assert mysql.parse_response(err, 0)[0] == mysql.STATUS_FAILED
+
+    def test_parse_command_stmt_lifecycle(self):
+        cache = {}
+        prep = self._packet(mysql.COM_STMT_PREPARE, b"SELECT * FROM t WHERE id=?")
+        got = mysql.parse_command(prep, MySqlMethod.PREPARE_STMT, cache, 1, 2, prep_stmt_id=5)
+        assert got == "SELECT * FROM t WHERE id=?"
+        ex = self._packet(mysql.COM_STMT_EXECUTE, struct.pack("<I", 5) + b"\x00")
+        assert mysql.parse_command(ex, MySqlMethod.EXEC_STMT, cache, 1, 2) == "SELECT * FROM t WHERE id=?"
+        close = self._packet(mysql.COM_STMT_CLOSE, struct.pack("<I", 5))
+        assert mysql.parse_command(close, MySqlMethod.STMT_CLOSE, cache, 1, 2) == "CLOSE STMT 5 "
+        # now evicted → EXECUTE placeholder
+        assert mysql.parse_command(ex, MySqlMethod.EXEC_STMT, cache, 1, 2) == "EXECUTE 5 *values*"
+
+
+class TestMongo:
+    def _op_msg(self, response_to: int, command: bytes, collection: bytes) -> bytes:
+        # body doc: type2 element <command> : string <collection>
+        elem = bytes([2]) + command + b"\x00" + struct.pack("<I", len(collection) + 1) + collection + b"\x00"
+        doc = struct.pack("<I", 4 + len(elem) + 1) + elem + b"\x00"
+        body = struct.pack("<I", 0) + bytes([0]) + doc  # flags + kind0
+        header = struct.pack("<iiii", 16 + len(body), 7, response_to, mongo.OP_MSG)
+        return header + body
+
+    def test_classify(self):
+        req = self._op_msg(0, b"find", b"users")
+        assert mongo.classify_request(req) == MongoMethod.OP_MSG
+        reply = self._op_msg(7, b"ok", b"x")
+        assert mongo.classify_request(reply) == 0
+        assert mongo.is_reply(reply[4:])  # replies parsed without length prefix
+
+    def test_parse_summary(self):
+        req = self._op_msg(0, b"find", b"myCollection")
+        assert mongo.parse_summary(req) == "find myCollection"
+        assert mongo.parse_summary(b"\x00" * 8) is None
+
+
+class TestRedis:
+    def test_classify(self):
+        assert redis.classify_request(b"*1\r\n$4\r\nping\r\n") == RedisMethod.PING
+        assert redis.classify_request(b"*2\r\n$3\r\nGET\r\n$3\r\nfoo\r\n") == RedisMethod.COMMAND
+        pushed = b"*3\r\n$7\r\nmessage\r\n$2\r\nch\r\n$2\r\nhi\r\n"
+        assert redis.classify_request(pushed) == RedisMethod.PUSHED_EVENT
+        resp3 = b">3\r\n$7\r\nmessage\r\n$2\r\nch\r\n$2\r\nhi\r\n"
+        assert redis.classify_request(resp3) == RedisMethod.PUSHED_EVENT
+        # 'message' command from client side is not a command (redis.c:82-85)
+        assert not redis.is_command(b"*3\r\n$7\r\nmessage\r\n$2\r\nch\r\n$2\r\nhi\r\n")
+
+    def test_response(self):
+        assert redis.parse_response(b"+OK\r\n") == redis.STATUS_SUCCESS
+        assert redis.parse_response(b"-ERR bad\r\n") == redis.STATUS_ERROR
+        assert redis.parse_response(b":42\r\n") == redis.STATUS_SUCCESS
+        assert redis.parse_response(b"!9\r\nerrstring\r\n") == redis.STATUS_ERROR
+        assert redis.parse_response(b"+OK") == redis.STATUS_UNKNOWN  # no CRLF
+
+
+class TestAmqp:
+    def test_classify(self):
+        pub = amqp.build_method_frame(1, amqp.CLASS_BASIC, amqp.METHOD_PUBLISH)
+        assert amqp.classify_request(pub) == AmqpMethod.PUBLISH
+        dlv = amqp.build_method_frame(1, amqp.CLASS_BASIC, amqp.METHOD_DELIVER)
+        assert amqp.classify_request(dlv) == AmqpMethod.DELIVER
+        other = amqp.build_method_frame(1, 20, 10)  # channel class
+        assert amqp.classify_request(other) == 0
+        # corrupted frame-end
+        bad = bytearray(pub)
+        bad[-1] = 0
+        assert amqp.classify_request(bytes(bad)) == 0
+
+
+class TestKafka:
+    def _produce_request(self, topic: bytes, key: bytes, value: bytes, api_version=3) -> bytes:
+        # record batch v2 with one record
+        rec_body = bytes([0])  # attributes
+        rec_body += _zigzag(0) + _zigzag(0)  # ts delta, offset delta
+        rec_body += _zigzag(len(key)) + key
+        rec_body += _zigzag(len(value)) + value
+        rec_body += _zigzag(0)  # headers
+        record = _zigzag(len(rec_body)) + rec_body
+        batch_tail = (
+            struct.pack("!iBihqqqhii", 0, 2, 0, 0, 0, 0, -1, -1, -1, 1)
+        )  # leader epoch, magic, crc, attrs, lastOffsetDelta(in q?) -- built below
+        # build explicitly: leader_epoch i32, magic i8, crc i32, attrs i16,
+        # last_offset_delta i32, first_ts i64, max_ts i64, producer_id i64,
+        # producer_epoch i16, base_seq i32, n_records i32
+        batch_tail = struct.pack(
+            "!iBihiqqqhii", 0, 2, 0, 0, 0, 0, 0, -1, -1, -1, 1
+        ) + record
+        batch = struct.pack("!qi", 0, len(batch_tail)) + batch_tail
+        body = b""
+        if api_version >= 3:
+            body += struct.pack("!h", -1)  # null transactional id
+        body += struct.pack("!hi", 1, 30000)  # acks, timeout
+        body += struct.pack("!i", 1)  # topic count
+        body += struct.pack("!h", len(topic)) + topic
+        body += struct.pack("!i", 1)  # partitions
+        body += struct.pack("!i", 0)  # partition id
+        body += struct.pack("!i", len(batch)) + batch
+        header = struct.pack("!hhi", kafka.API_KEY_PRODUCE, api_version, 123)
+        header += struct.pack("!h", 4) + b"test"  # client id
+        wire = header + body
+        return struct.pack("!i", len(wire)) + wire
+
+    def test_request_header(self):
+        wire = self._produce_request(b"orders", b"k", b"v")
+        ok, corr, api_key, api_version = kafka.parse_request_header(wire)
+        assert ok and corr == 123 and api_key == 0 and api_version == 3
+        # size mismatch → reject (kafka.c:52-54)
+        assert not kafka.parse_request_header(wire[:-1])[0]
+
+    def test_produce_decode(self):
+        wire = self._produce_request(b"orders", b"key1", b"hello")
+        api_key, api_version, corr, body = kafka.split_request_header(wire)
+        msgs = kafka.decode_produce_request(body, api_version)
+        assert len(msgs) == 1
+        m = msgs[0]
+        assert (m.topic, m.partition, m.key, m.value, m.type) == (
+            "orders", 0, "key1", "hello", kafka.PUBLISH,
+        )
+
+    def test_fetch_response_decode(self):
+        # fetch response v4 with one record batch
+        rec_body = bytes([0]) + _zigzag(0) + _zigzag(0)
+        rec_body += _zigzag(2) + b"k2" + _zigzag(5) + b"world" + _zigzag(0)
+        record = _zigzag(len(rec_body)) + rec_body
+        batch_tail = struct.pack("!iBihiqqqhii", 0, 2, 0, 0, 0, 0, 0, -1, -1, -1, 1) + record
+        batch = struct.pack("!qi", 0, len(batch_tail)) + batch_tail
+        body = struct.pack("!i", 100)  # throttle
+        body += struct.pack("!i", 1)  # topics
+        body += struct.pack("!h", 6) + b"orders"
+        body += struct.pack("!i", 1)  # partitions
+        body += struct.pack("!ihq", 0, 0, 10)  # partition, err, hwm
+        body += struct.pack("!q", 10)  # last stable
+        body += struct.pack("!i", 0)  # aborted
+        body += struct.pack("!i", len(batch)) + batch
+        msgs = kafka.decode_fetch_response(body, 4)
+        assert len(msgs) == 1
+        assert msgs[0].value == "world" and msgs[0].type == kafka.CONSUME
+
+    def test_kerror_table(self):
+        assert kafka.kerror_name(0) == "NONE"
+        assert kafka.kerror_name(3) == "UNKNOWN_TOPIC_OR_PARTITION"
+        assert kafka.kerror_name(999) == "KError-999"
+
+
+def _zigzag(n: int) -> bytes:
+    z = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class TestDispatch:
+    def test_classify_chain_order(self):
+        # matches l7.c:248-384 dispatch
+        assert classify_request(b"GET /user HTTP/1.1")[0] == L7Protocol.HTTP
+        assert classify_request(http2.MAGIC)[0] == L7Protocol.HTTP2
+        assert classify_request(b"Q\x00\x00\x00\x0bSELECT 1\x00")[0] == L7Protocol.POSTGRES
+        assert classify_request(b"*1\r\n$4\r\nping\r\n")[0] == L7Protocol.REDIS
+        pub = amqp.build_method_frame(1, amqp.CLASS_BASIC, amqp.METHOD_PUBLISH)
+        assert classify_request(pub)[0] == L7Protocol.AMQP
+        # all-zero bytes are a valid DATA frame on stream 0 for the kernel
+        # too (http2.c:96-99), so use a truly invalid payload
+        assert classify_request(b"\xff" * 20)[0] == L7Protocol.UNKNOWN

@@ -1,0 +1,184 @@
+"""SocketLine interval-join semantics — case-for-case with GetValue/
+AddValue/DeleteUnused (aggregator/sock_num_line.go, exercised by the
+reference's sock_line_test.go patterns)."""
+
+import threading
+
+import numpy as np
+
+from alaz_tpu.aggregator.sockline import (
+    ONE_MINUTE_NS,
+    SockInfo,
+    SocketLine,
+    SocketLineStore,
+)
+
+
+def si(daddr=0x0A000001, dport=80, saddr=0x0A000002, sport=5000):
+    return SockInfo(pid=1, fd=3, saddr=saddr, sport=sport, daddr=daddr, dport=dport)
+
+
+class TestAddValue:
+    def test_sorted_insert(self):
+        line = SocketLine(1, 3)
+        line.add_value(300, si(dport=3))
+        line.add_value(100, si(dport=1))
+        line.add_value(200, None)
+        assert [ts for ts, _ in line.snapshot()] == [100, 200, 300]
+
+    def test_tail_dedup_identical_open(self):
+        # identical consecutive open is ignored (sock_num_line.go:71-77)
+        line = SocketLine(1, 3)
+        line.add_value(100, si())
+        line.add_value(200, si())
+        assert len(line) == 1
+        # different daddr is kept
+        line.add_value(300, si(daddr=0x0B000001))
+        assert len(line) == 2
+
+
+class TestGetValue:
+    def test_empty_line_misses(self):
+        line = SocketLine(1, 3)
+        assert line.get_value(100) is None
+
+    def test_after_last_open_entry(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=42))
+        got = line.get_value(500)
+        assert got is not None and got.dport == 42
+
+    def test_after_last_closed_entry_within_minute(self):
+        # last entry is a close; fall back to previous open if within 1 min
+        # (sock_num_line.go:96-104)
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=42))
+        line.add_value(200, None)
+        got = line.get_value(200 + 10)
+        assert got is not None and got.dport == 42
+        # beyond a minute → miss
+        line2 = SocketLine(1, 3)
+        line2.add_value(100, si(dport=42))
+        line2.add_value(200, None)
+        assert line2.get_value(100 + ONE_MINUTE_NS + 1000) is None
+
+    def test_before_first_entry_open_tolerance(self):
+        # timestamp before first open still matches (cold-start userspace
+        # timestamps, sock_num_line.go:107-118)
+        line = SocketLine(1, 3)
+        line.add_value(1000, si(dport=42))
+        got = line.get_value(50)
+        assert got is not None and got.dport == 42
+        # but not when the first entry is a close
+        line2 = SocketLine(1, 3)
+        line2.add_value(1000, None)
+        line2.add_value(2000, si())
+        assert line2.get_value(50) is None
+
+    def test_landed_on_close_with_agreeing_neighbors(self):
+        # open(A) close open(A') with same daddr:dport → closest wins
+        # (sock_num_line.go:123-152)
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=42, sport=1))
+        line.add_value(200, None)
+        line.add_value(400, si(dport=42, sport=2))
+        got = line.get_value(210)  # closer to the earlier open
+        assert got is not None and got.sport == 1
+        got = line.get_value(390)
+        assert got is not None and got.sport == 2
+
+    def test_landed_on_close_with_disagreeing_neighbors(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=42))
+        line.add_value(200, None)
+        line.add_value(400, si(dport=43))
+        assert line.get_value(250) is None
+
+    def test_normal_previous_open(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=1))
+        line.add_value(200, None)
+        line.add_value(300, si(dport=3))
+        got = line.get_value(350)
+        assert got is not None and got.dport == 3
+
+    def test_vectorized_matches_scalar(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=1, sport=10))
+        line.add_value(200, None)
+        line.add_value(400, si(dport=1, sport=20))
+        line.add_value(600, None)
+        queries = np.array([50, 150, 210, 390, 450, 590, 610, 10_000], dtype=np.uint64)
+        found, _, sport, _, dport = line.get_values(queries)
+        for i, q in enumerate(queries):
+            scalar = line.get_value(int(q))
+            assert found[i] == (scalar is not None)
+            if scalar is not None:
+                assert sport[i] == scalar.sport and dport[i] == scalar.dport
+
+
+class TestDeleteUnused:
+    def test_collapse_double_open(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=1))
+        line.add_value(200, si(dport=2))  # lost close → collapse to later
+        line.delete_unused()
+        snap = line.snapshot()
+        assert len(snap) == 1 and snap[0][0] == 200
+
+    def test_stale_pair_removal(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si(dport=1))
+        line.add_value(200, None)
+        line.add_value(300, si(dport=2))
+        # match old pair at t=250 (stale), then new at much later time
+        line.get_value(150, now_ns=1_000)
+        line.get_value(400, now_ns=ONE_MINUTE_NS * 100)
+        line.delete_unused()
+        snap = line.snapshot()
+        # the stale (open@100, close@200) pair is gone
+        assert [ts for ts, _ in snap] == [300]
+
+    def test_single_entry_untouched(self):
+        line = SocketLine(1, 3)
+        line.add_value(100, si())
+        line.delete_unused()
+        assert len(line) == 1
+
+
+class TestStore:
+    def test_get_or_create_and_remove_pid(self):
+        store = SocketLineStore()
+        a = store.get_or_create(1, 3)
+        assert store.get_or_create(1, 3) is a
+        store.get_or_create(1, 4)
+        store.get_or_create(2, 3)
+        assert len(store) == 3
+        assert store.remove_pid(1) == 2
+        assert len(store) == 1
+        assert store.get(1, 3) is None
+
+    def test_concurrent_add_get(self):
+        line = SocketLine(1, 3)
+        stop = threading.Event()
+
+        def writer():
+            t = 0
+            while not stop.is_set():
+                line.add_value(t, si(dport=t % 7))
+                line.add_value(t + 1, None)
+                t += 2
+
+        def reader():
+            while not stop.is_set():
+                line.get_values(np.arange(0, 1000, 7, dtype=np.uint64))
+
+        threads = [threading.Thread(target=writer), threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
