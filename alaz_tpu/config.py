@@ -155,7 +155,7 @@ class SimulationConfig:
         return cls(**kwargs)
 
 
-@dataclass
+@dataclass(frozen=True)
 class ModelConfig:
     """Flagship model hyperparameters (BASELINE.json configs 2-4)."""
 
@@ -180,7 +180,7 @@ class ModelConfig:
         )
 
 
-@dataclass
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh axes for the sharded model (SURVEY §2.3 P1-P7).
 

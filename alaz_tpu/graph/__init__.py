@@ -1,0 +1,14 @@
+"""Graph batching: resolved edges → static-shape COO snapshots.
+
+This is the bridge between the streaming data plane and the device: a
+DataStore sink (the BASELINE.json "new datastore.Backend behind the plugin
+interface") that accumulates REQUEST_DTYPE edges into time windows and
+closes each window into a padded, bucketed :class:`GraphBatch` ready for a
+jit'd GNN — the role the COO batcher sidecar plays in SURVEY §2.1's
+TPU-native plan.
+"""
+
+from alaz_tpu.graph.snapshot import GraphBatch, pad_to_bucket
+from alaz_tpu.graph.builder import GraphBuilder, WindowedGraphStore
+
+__all__ = ["GraphBatch", "pad_to_bucket", "GraphBuilder", "WindowedGraphStore"]

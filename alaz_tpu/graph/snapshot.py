@@ -1,0 +1,161 @@
+"""GraphBatch: the static-shape unit of device work.
+
+XLA compiles one program per distinct shape, so dynamic service graphs are
+padded to **bucketed** sizes (next power-of-two-ish), the padding masked
+out. This is SURVEY §7's hard part (a): the padding/bucketing policy is the
+perf lever — buckets too fine cause recompiles, too coarse waste FLOPs.
+
+All arrays are plain numpy here; ``to_device`` views are whatever jnp makes
+of them. Fields:
+
+- ``node_feats``  [N_pad, F]   float32 (cast to bf16 inside the model)
+- ``node_type``   [N_pad]      int32 (EP_* codes)
+- ``node_mask``   [N_pad]      bool
+- ``edge_src/dst``[E_pad]      int32 (indices into the node axis)
+- ``edge_type``   [E_pad]      int32 (L7Protocol codes — GAT edge-type
+                                embeddings, BASELINE.json config 3)
+- ``edge_feats``  [E_pad, Fe]  float32
+- ``edge_mask``   [E_pad]      bool
+- ``edge_label``  [E_pad]      float32 (fault labels when known; else 0)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+_BUCKET_STEPS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536, 131072, 262144, 524288, 1048576)
+
+
+def pad_to_bucket(n: int, minimum: int = 128) -> int:
+    """Next bucket ≥ n: powers of two with 1.5× midpoints (from 256 up, so
+    every bucket stays a multiple of 128 — the Pallas tile requirement),
+    capping padding waste at ~25% while keeping the shape count small."""
+    n = max(n, minimum)
+    for b in _BUCKET_STEPS:
+        if n <= b:
+            return b
+        mid = b + b // 2
+        if b >= 256 and n <= mid:
+            return mid
+    return int(2 ** np.ceil(np.log2(n)))
+
+
+@dataclass
+class GraphBatch:
+    node_feats: np.ndarray  # [N_pad, F] f32
+    node_type: np.ndarray  # [N_pad] i32
+    node_mask: np.ndarray  # [N_pad] bool
+    edge_src: np.ndarray  # [E_pad] i32
+    edge_dst: np.ndarray  # [E_pad] i32
+    edge_type: np.ndarray  # [E_pad] i32
+    edge_feats: np.ndarray  # [E_pad, Fe] f32
+    edge_mask: np.ndarray  # [E_pad] bool
+    edge_label: np.ndarray  # [E_pad] f32
+    n_nodes: int
+    n_edges: int
+    window_start_ms: int = 0
+    window_end_ms: int = 0
+    # node slot -> interned uid (host-side bookkeeping, not shipped to device)
+    node_uids: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @property
+    def n_pad(self) -> int:
+        return self.node_feats.shape[0]
+
+    @property
+    def e_pad(self) -> int:
+        return self.edge_src.shape[0]
+
+    def device_arrays(self) -> dict:
+        """The pytree the jit'd model consumes (static shapes only)."""
+        return {
+            "node_feats": self.node_feats,
+            "node_type": self.node_type,
+            "node_mask": self.node_mask,
+            "edge_src": self.edge_src,
+            "edge_dst": self.edge_dst,
+            "edge_type": self.edge_type,
+            "edge_feats": self.edge_feats,
+            "edge_mask": self.edge_mask,
+        }
+
+    @staticmethod
+    def build(
+        node_feats: np.ndarray,
+        node_type: np.ndarray,
+        edge_src: np.ndarray,
+        edge_dst: np.ndarray,
+        edge_type: np.ndarray,
+        edge_feats: np.ndarray,
+        edge_label: Optional[np.ndarray] = None,
+        node_uids: Optional[np.ndarray] = None,
+        window_start_ms: int = 0,
+        window_end_ms: int = 0,
+        sort_by_dst: bool = True,
+    ) -> "GraphBatch":
+        """Pad/bucket raw COO arrays into a GraphBatch. Edges are sorted by
+        destination so segment reductions see contiguous runs (the layout
+        the Pallas kernel requires)."""
+        n = int(node_feats.shape[0])
+        e = int(edge_src.shape[0])
+        n_pad = pad_to_bucket(n)
+        e_pad = pad_to_bucket(e)
+
+        if sort_by_dst and e > 0:
+            order = np.argsort(edge_dst, kind="stable")
+            edge_src = edge_src[order]
+            edge_dst = edge_dst[order]
+            edge_type = edge_type[order]
+            edge_feats = edge_feats[order]
+            if edge_label is not None:
+                edge_label = edge_label[order]
+
+        nf = np.zeros((n_pad, node_feats.shape[1]), dtype=np.float32)
+        nf[:n] = node_feats
+        nt = np.zeros(n_pad, dtype=np.int32)
+        nt[:n] = node_type
+        nm = np.zeros(n_pad, dtype=bool)
+        nm[:n] = True
+
+        es = np.zeros(e_pad, dtype=np.int32)
+        ed = np.zeros(e_pad, dtype=np.int32)
+        et = np.zeros(e_pad, dtype=np.int32)
+        ef = np.zeros((e_pad, edge_feats.shape[1]), dtype=np.float32)
+        em = np.zeros(e_pad, dtype=bool)
+        el = np.zeros(e_pad, dtype=np.float32)
+        es[:e] = edge_src
+        ed[:e] = edge_dst
+        # padding edges point at the last padded node slot so segment ops
+        # dump them into a masked-out row instead of polluting node 0
+        es[e:] = n_pad - 1
+        ed[e:] = n_pad - 1
+        et[:e] = edge_type
+        ef[:e] = edge_feats
+        em[:e] = True
+        if edge_label is not None:
+            el[:e] = edge_label
+
+        uids = None
+        if node_uids is not None:
+            uids = np.zeros(n_pad, dtype=np.int32)
+            uids[:n] = node_uids
+
+        return GraphBatch(
+            node_feats=nf,
+            node_type=nt,
+            node_mask=nm,
+            edge_src=es,
+            edge_dst=ed,
+            edge_type=et,
+            edge_feats=ef,
+            edge_mask=em,
+            edge_label=el,
+            n_nodes=n,
+            n_edges=e,
+            window_start_ms=window_start_ms,
+            window_end_ms=window_end_ms,
+            node_uids=uids,
+        )

@@ -1,0 +1,17 @@
+"""GNN anomaly scorers over service-graph batches.
+
+Model families per BASELINE.json:
+- ``graphsage`` — 2-layer GraphSAGE, static snapshots (config 2)
+- ``gat``       — attention + edge-type embeddings (config 3)
+- ``experts``   — per-edge-type expert MLPs, the EP surface (SURVEY §2.3 P5)
+- ``tgn``       — temporal memory over 1s windows (config 4)
+
+All models are functional: ``init(key, cfg) -> params`` pytrees and
+``apply(params, graph, cfg) -> {"node_h", "edge_logits", "node_logits"}``,
+so sharding is just PartitionSpecs over the params pytree.
+"""
+
+from alaz_tpu.models import graphsage, gat, tgn
+from alaz_tpu.models.registry import get_model
+
+__all__ = ["graphsage", "gat", "tgn", "get_model"]

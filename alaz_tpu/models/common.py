@@ -1,0 +1,89 @@
+"""Shared model building blocks (functional, no framework)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.ops.segment import gather_scatter_sum, segment_mean  # noqa: F401
+
+
+def dense_init(key, in_dim: int, out_dim: int) -> dict:
+    k1, _ = jax.random.split(key)
+    scale = (2.0 / in_dim) ** 0.5
+    return {
+        "w": jax.random.normal(k1, (in_dim, out_dim), dtype=jnp.float32) * scale,
+        "b": jnp.zeros((out_dim,), dtype=jnp.float32),
+    }
+
+
+def dense(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    return x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype)
+
+
+def layernorm_init(dim: int) -> dict:
+    return {"g": jnp.ones((dim,), jnp.float32), "b": jnp.zeros((dim,), jnp.float32)}
+
+
+def layernorm(params: dict, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    return y * params["g"].astype(x.dtype) + params["b"].astype(x.dtype)
+
+
+def mlp_init(key, dims: list[int]) -> list[dict]:
+    keys = jax.random.split(key, len(dims) - 1)
+    return [dense_init(k, dims[i], dims[i + 1]) for i, k in enumerate(keys)]
+
+
+def mlp(params: list[dict], x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(params):
+        x = dense(layer, x)
+        if i + 1 < len(params):
+            x = jax.nn.gelu(x)
+    return x
+
+
+def compute_dtype(cfg: ModelConfig):
+    return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+
+def scatter_messages(
+    msgs: jnp.ndarray,
+    edge_dst: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    num_nodes: int,
+    use_pallas: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked message scatter → (sum [N,H], degree [N]). Uses the Pallas
+    dst-sorted kernel on TPU, XLA segment_sum elsewhere."""
+    m = msgs * edge_mask[:, None].astype(msgs.dtype)
+    if use_pallas and jax.default_backend() == "tpu":
+        from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
+
+        agg = scatter_sum_sorted(m, edge_dst, num_nodes)
+    else:
+        agg = jax.ops.segment_sum(m, edge_dst, num_segments=num_nodes)
+    deg = jax.ops.segment_sum(
+        edge_mask.astype(msgs.dtype), edge_dst, num_segments=num_nodes
+    )
+    return agg, deg
+
+
+def edge_head_init(key, hidden: int, edge_feat_dim: int) -> list[dict]:
+    return mlp_init(key, [2 * hidden + edge_feat_dim, hidden, 1])
+
+
+def edge_head(params, h, graph, dtype) -> jnp.ndarray:
+    """Per-edge anomaly logit from [h_src, h_dst, edge_feats]."""
+    z = jnp.concatenate(
+        [
+            h[graph["edge_src"]],
+            h[graph["edge_dst"]],
+            graph["edge_feats"].astype(dtype),
+        ],
+        axis=-1,
+    )
+    return mlp(params, z)[:, 0]

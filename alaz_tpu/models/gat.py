@@ -1,0 +1,102 @@
+"""GAT with edge-type embeddings — BASELINE.json config 3 (10k-pod mixed
+HTTP/gRPC/Postgres/Kafka edges).
+
+Multi-head additive attention over incoming edges; attention logits are
+conditioned on source, destination, edge features, and the edge-type
+embedding (the reference's per-protocol handler dispatch, SURVEY §2.3 P5,
+re-expressed as typed attention). Per-destination normalization uses
+masked segment softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.models.common import (
+    compute_dtype,
+    dense,
+    dense_init,
+    edge_head,
+    edge_head_init,
+    layernorm,
+    layernorm_init,
+    mlp,
+    mlp_init,
+    scatter_messages,
+)
+from alaz_tpu.ops.segment import segment_softmax
+
+Params = Dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    h = cfg.hidden_dim
+    nh = cfg.num_heads
+    assert h % nh == 0, "num_heads must divide hidden_dim"
+    keys = jax.random.split(key, 4 + 6 * cfg.num_layers)
+    params: Params = {
+        "embed": dense_init(keys[0], cfg.node_feature_dim, h),
+        "type_emb": jax.random.normal(keys[1], (cfg.num_edge_types, h), jnp.float32) * 0.02,
+        "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
+        "node_head": mlp_init(keys[3], [h, h, 1]),
+        "layers": [],
+    }
+    for l in range(cfg.num_layers):
+        k = keys[4 + 6 * l : 10 + 6 * l]
+        params["layers"].append(
+            {
+                "q": dense_init(k[0], h, h),
+                "kv": dense_init(k[1], h, h),
+                "edge_proj": dense_init(k[2], cfg.edge_feature_dim, h),
+                "attn": jax.random.normal(k[3], (nh, 3 * (h // nh)), jnp.float32) * 0.05,
+                "out": dense_init(k[4], h, h),
+                "ln": layernorm_init(h),
+            }
+        )
+    return params
+
+
+def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
+    dtype = compute_dtype(cfg)
+    n = graph["node_feats"].shape[0]
+    nh = cfg.num_heads
+    hd = cfg.hidden_dim // nh
+    node_mask = graph["node_mask"].astype(dtype)
+    edge_mask = graph["edge_mask"]
+    src, dst = graph["edge_src"], graph["edge_dst"]
+
+    h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
+    e_type_emb = params["type_emb"].astype(dtype)[graph["edge_type"]]
+    ef = graph["edge_feats"].astype(dtype)
+
+    for layer in params["layers"]:
+        q = dense(layer["q"], h).reshape(n, nh, hd)
+        kv = dense(layer["kv"], h).reshape(n, nh, hd)
+        e_feat = (dense(layer["edge_proj"], ef) + e_type_emb).reshape(-1, nh, hd)
+
+        # additive attention logit per edge per head
+        z = jnp.concatenate([q[dst], kv[src], e_feat], axis=-1)  # [E, nh, 3hd]
+        logits = jnp.einsum(
+            "ehd,hd->eh", z, layer["attn"].astype(dtype)
+        ).astype(jnp.float32)
+        logits = jax.nn.leaky_relu(logits, 0.2)
+        alpha = jax.vmap(
+            lambda lg: segment_softmax(lg, dst, n, mask=edge_mask), in_axes=1, out_axes=1
+        )(logits).astype(dtype)  # [E, nh]
+
+        msgs = ((kv[src] + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
+        agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas)
+        h_new = dense(layer["out"], agg.astype(dtype))
+        h = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype)
+    node_logits = mlp(params["node_head"], h)[:, 0]
+    return {
+        "node_h": h,
+        "edge_logits": edge_logits.astype(jnp.float32),
+        "node_logits": node_logits.astype(jnp.float32),
+    }

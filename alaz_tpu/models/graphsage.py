@@ -1,0 +1,98 @@
+"""GraphSAGE anomaly scorer — BASELINE.json config 2's flagship model.
+
+Mean-aggregator GraphSAGE with edge-feature/edge-type-conditioned messages:
+
+    m_e   = W_msg·h[src_e] + W_ef·e_e + T[type_e]
+    agg_d = Σ_{e:dst=d} m_e / deg_d          (Pallas scatter on TPU)
+    h'_d  = GELU(LN(W_self·h_d + W_neigh·agg_d)) + h_d
+
+plus per-edge and per-node anomaly heads. Compute runs in bf16, params and
+scatter accumulation in f32 (MXU-friendly; see SURVEY §7.6 roofline note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.models.common import (
+    compute_dtype,
+    dense,
+    dense_init,
+    edge_head,
+    edge_head_init,
+    layernorm,
+    layernorm_init,
+    mlp,
+    mlp_init,
+    scatter_messages,
+)
+
+Params = Dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    h = cfg.hidden_dim
+    keys = jax.random.split(key, 4 + 4 * cfg.num_layers)
+    params: Params = {
+        "embed": dense_init(keys[0], cfg.node_feature_dim, h),
+        "type_emb": jax.random.normal(keys[1], (cfg.num_edge_types, h), jnp.float32) * 0.02,
+        "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
+        "node_head": mlp_init(keys[3], [h, h, 1]),
+        "layers": [],
+    }
+    for l in range(cfg.num_layers):
+        k = keys[4 + 4 * l : 8 + 4 * l]
+        params["layers"].append(
+            {
+                "msg": dense_init(k[0], h, h),
+                "edge_proj": dense_init(k[1], cfg.edge_feature_dim, h),
+                "self": dense_init(k[2], h, h),
+                "neigh": dense_init(k[3], h, h),
+                "ln": layernorm_init(h),
+            }
+        )
+    return params
+
+
+def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
+    """Forward pass. ``h_bias`` ([N, H], optional) is added to the embedded
+    node state before message passing — the hook the temporal model (tgn)
+    uses to condition on its node memory."""
+    dtype = compute_dtype(cfg)
+    n = graph["node_feats"].shape[0]
+    node_mask = graph["node_mask"].astype(dtype)
+    edge_mask = graph["edge_mask"]
+
+    h = dense(params["embed"], graph["node_feats"].astype(dtype))
+    if h_bias is not None:
+        h = h + h_bias.astype(dtype)
+    h = h * node_mask[:, None]
+
+    e_type_emb = params["type_emb"].astype(dtype)[graph["edge_type"]]
+    ef = graph["edge_feats"].astype(dtype)
+
+    for layer in params["layers"]:
+        msgs = (
+            dense(layer["msg"], h[graph["edge_src"]])
+            + dense(layer["edge_proj"], ef)
+            + e_type_emb
+        )
+        agg, deg = scatter_messages(
+            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas
+        )
+        agg = agg / jnp.maximum(deg, 1.0)[:, None]
+        h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
+        h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
+        h = (h + h_new) * node_mask[:, None]
+
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype)
+    node_logits = mlp(params["node_head"], h)[:, 0]
+    return {
+        "node_h": h,
+        "edge_logits": edge_logits.astype(jnp.float32),
+        "node_logits": node_logits.astype(jnp.float32),
+    }

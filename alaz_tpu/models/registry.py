@@ -1,0 +1,17 @@
+"""Model registry: name → (init, apply)."""
+
+from __future__ import annotations
+
+from alaz_tpu.models import gat, graphsage
+
+
+def get_model(name: str):
+    if name == "graphsage":
+        return graphsage.init, graphsage.apply
+    if name == "gat":
+        return gat.init, gat.apply
+    if name == "tgn":
+        from alaz_tpu.models import tgn
+
+        return tgn.init, tgn.step
+    raise ValueError(f"unknown model {name!r} (graphsage|gat|tgn)")

@@ -1,0 +1,79 @@
+"""Temporal GNN over 1s windows — BASELINE.json config 4 (TGN-style
+latency-spike forecasting).
+
+A persistent per-node memory (node slots are stable across windows thanks
+to the builder's NodeTable) is combined with each window's snapshot
+encoding and updated with a GRU cell:
+
+    h_t   = GraphSAGE(x_t ; h_bias = W_m·m_{t-1})
+    m_t   = GRU(m_{t-1}, h_t)        (active nodes only)
+
+Scores are read from h_t. Memory is an [M, H] array; when a window's node
+bucket outgrows M the memory is zero-extended to the new bucket, so
+streaming callers can size it from the first window and let it grow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.models import graphsage
+from alaz_tpu.models.common import compute_dtype, dense, dense_init
+
+Params = Dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    h = cfg.hidden_dim
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    gru_z = dense_init(k4, 2 * h, h)
+    # bias the update gate toward the fresh encoding at init (z ≈ 0.12) so
+    # early training isn't dominated by stale memory
+    gru_z["b"] = gru_z["b"] - 2.0
+    return {
+        "encoder": graphsage.init(k1, cfg),
+        "mem_in": dense_init(k2, h, h),
+        "gru_r": dense_init(k3, 2 * h, h),
+        "gru_z": gru_z,
+        "gru_n": dense_init(k5, 2 * h, h),
+    }
+
+
+def init_memory(cfg: ModelConfig, max_nodes: int) -> jnp.ndarray:
+    return jnp.zeros((max_nodes, cfg.hidden_dim), dtype=jnp.float32)
+
+
+def step(params: Params, graph: dict, memory: jnp.ndarray, cfg: ModelConfig) -> tuple[dict, jnp.ndarray]:
+    """One window: encode snapshot conditioned on memory, emit scores,
+    return updated memory (zero-extended if the node bucket grew)."""
+    dtype = compute_dtype(cfg)
+    n_pad = graph["node_feats"].shape[0]
+    if memory.shape[0] < n_pad:
+        memory = jnp.pad(memory, ((0, n_pad - memory.shape[0]), (0, 0)))
+    mem = memory[:n_pad]
+
+    out = graphsage.apply(
+        params["encoder"],
+        graph,
+        cfg,
+        h_bias=dense(params["mem_in"], mem.astype(dtype)),
+    )
+    h = out["node_h"].astype(jnp.float32)
+
+    # GRU memory update for active nodes
+    m_prev = memory[:n_pad]
+    hz = jnp.concatenate([m_prev.astype(dtype), h.astype(dtype)], axis=-1)
+    r = jax.nn.sigmoid(dense(params["gru_r"], hz)).astype(jnp.float32)
+    z = jax.nn.sigmoid(dense(params["gru_z"], hz)).astype(jnp.float32)
+    hn = jnp.concatenate([(r * m_prev).astype(dtype), h.astype(dtype)], axis=-1)
+    n_t = jnp.tanh(dense(params["gru_n"], hn)).astype(jnp.float32)
+    m_new = (1 - z) * n_t + z * m_prev
+
+    active = graph["node_mask"][:, None]
+    m_next = jnp.where(active, m_new, m_prev)
+    memory = memory.at[:n_pad].set(m_next)
+    return out, memory

@@ -1,0 +1,17 @@
+"""Sparse message-passing primitives for TPU.
+
+``segment``: XLA-lowered gather/segment reductions (work everywhere).
+``pallas_segment``: the hot-path Pallas kernel — edges sorted by
+destination, scatter-add realized as per-block one-hot matmuls on the MXU
+(the standard dense-hardware trick for sparse aggregation; cf. PAPERS.md
+"Fast Training of Sparse GNNs on Dense Hardware").
+"""
+
+from alaz_tpu.ops.segment import gather_scatter_sum, segment_mean, segment_softmax, segment_sum
+
+__all__ = [
+    "gather_scatter_sum",
+    "segment_mean",
+    "segment_softmax",
+    "segment_sum",
+]

@@ -1,0 +1,208 @@
+"""Pallas TPU kernel for the GNN hot loop: dst-sorted scatter-add as MXU
+one-hot matmuls.
+
+``out[d] = Σ_{e: dst[e]=d} msgs[e]`` with edges sorted by destination (the
+GraphBatch layout). Instead of a serialized scatter-add, each 128-row
+destination block computes ``onehotᵀ @ msg_chunk`` on the MXU over exactly
+the edge chunks that intersect its range (binary-searched boundaries are
+scalar-prefetched), with double-buffered DMA from HBM. This is the
+"sparse graph ops on dense hardware" formulation (PAPERS.md) — the FLOPs
+are redundant but land on the 128×128 systolic array, which beats
+bandwidth-bound scatter on TPU.
+
+The op is linear, so the backward pass is the same gather/scatter with
+src/dst exchanged — expressed via the XLA path (edges aren't src-sorted).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_N = 128  # destination rows per grid step (= MXU width)
+TILE_E = 128  # edges per inner chunk
+
+
+def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_scratch, sems):
+    i = pl.program_id(0)
+    e_lo = row_start_ref[i]
+    e_hi = row_start_ref[i + 1]
+    c0 = e_lo // TILE_E
+    c1 = pl.cdiv(e_hi, TILE_E)
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    def make_dma(slot, c):
+        m = pltpu.make_async_copy(
+            msgs_hbm.at[pl.ds(c * TILE_E, TILE_E), :],
+            msg_scratch.at[slot],
+            sems.at[slot, 0],
+        )
+        d = pltpu.make_async_copy(
+            dst_hbm.at[pl.ds(c, 1), :],
+            dst_scratch.at[slot],
+            sems.at[slot, 1],
+        )
+        return m, d
+
+    @pl.when(c1 > c0)
+    def _():
+        m0, d0 = make_dma(0, c0)
+        m0.start()
+        d0.start()
+
+        def body(c, _):
+            slot = jax.lax.rem(c - c0, 2)
+            next_slot = 1 - slot
+
+            @pl.when(c + 1 < c1)
+            def _():
+                mn, dn = make_dma(next_slot, c + 1)
+                mn.start()
+                dn.start()
+
+            mc, dc = make_dma(slot, c)
+            mc.wait()
+            dc.wait()
+
+            # edges whose dst falls outside this block one-hot to zero rows,
+            # so chunk overlap with neighboring blocks needs no masking
+            dst_local = dst_scratch[slot, 0, :].reshape(TILE_E, 1) - i * TILE_N
+            onehot = (
+                dst_local == jax.lax.broadcasted_iota(jnp.int32, (TILE_E, TILE_N), 1)
+            ).astype(jnp.float32)
+            out_ref[:] += jax.lax.dot_general(
+                onehot,
+                msg_scratch[slot],
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            return 0
+
+        jax.lax.fori_loop(c0, c1, body, 0)
+
+
+def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, interpret: bool = False) -> jnp.ndarray:
+    e, f = msgs.shape
+    assert e % TILE_E == 0 and num_nodes % TILE_N == 0, (
+        f"pad edges/nodes to {TILE_E}/{TILE_N} multiples (GraphBatch buckets do)"
+    )
+    n_blocks = num_nodes // TILE_N
+    boundaries = jnp.arange(0, num_nodes + 1, TILE_N, dtype=jnp.int32)
+    row_start = jnp.searchsorted(edge_dst, boundaries).astype(jnp.int32)
+    dst2d = edge_dst.reshape(e // TILE_E, TILE_E).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # msgs stay in HBM; DMA'd
+            pl.BlockSpec(memory_space=pl.ANY),  # dst ids
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_N, f), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, TILE_E, f), jnp.float32),
+            pltpu.VMEM((2, 1, TILE_E), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        out_shape=jax.ShapeDtypeStruct((num_nodes, f), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * e * TILE_N * f,
+            bytes_accessed=e * f * 4 + e * 4 + num_nodes * f * 4,
+            transcendentals=0,
+        ),
+    )(row_start, msgs, dst2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scatter_sum_sorted(msgs, edge_dst, num_nodes):
+    """out[d] = Σ_{e: dst[e]=d} msgs[e] for arbitrary per-edge messages
+    (models add edge features/type embeddings before scattering)."""
+    return _scatter_fwd_impl(msgs, edge_dst, num_nodes)
+
+
+def _scatter_fwd_impl(msgs, edge_dst, num_nodes):
+    dtype = msgs.dtype
+    msgs = msgs.astype(jnp.float32)
+    f = msgs.shape[1]
+    f_pad = ((f + 127) // 128) * 128
+    if f_pad != f:
+        msgs = jnp.pad(msgs, ((0, 0), (0, f_pad - f)))
+    interpret = jax.default_backend() != "tpu"
+    out = _scatter_sorted(msgs, edge_dst, num_nodes, interpret=interpret)
+    return out[:, :f].astype(dtype)
+
+
+def _scatter_vjp_fwd(msgs, edge_dst, num_nodes):
+    return _scatter_fwd_impl(msgs, edge_dst, num_nodes), (edge_dst,)
+
+
+def _scatter_vjp_bwd(num_nodes, residuals, g):
+    (edge_dst,) = residuals
+    return (g[edge_dst], None)
+
+
+scatter_sum_sorted.defvjp(_scatter_vjp_fwd, _scatter_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight=None):
+    """out[d] = Σ_{e: dst[e]=d} w[e]·x[src[e]], edges sorted by dst."""
+    return _forward(x, edge_src, edge_dst, num_nodes, edge_weight)
+
+
+def _forward(x, edge_src, edge_dst, num_nodes, edge_weight):
+    msgs = x[edge_src].astype(jnp.float32)
+    if edge_weight is not None:
+        msgs = msgs * edge_weight[:, None].astype(jnp.float32)
+    # VMEM slices must be 128-lane aligned: pad the feature dim up
+    f = msgs.shape[1]
+    f_pad = ((f + 127) // 128) * 128
+    if f_pad != f:
+        msgs = jnp.pad(msgs, ((0, 0), (0, f_pad - f)))
+    interpret = jax.default_backend() != "tpu"
+    out = _scatter_sorted(msgs, edge_dst, num_nodes, interpret=interpret)
+    return out[:, :f].astype(x.dtype)
+
+
+def _fwd(x, edge_src, edge_dst, num_nodes, edge_weight):
+    return _forward(x, edge_src, edge_dst, num_nodes, edge_weight), (
+        x,
+        edge_src,
+        edge_dst,
+        edge_weight,
+    )
+
+
+def _bwd(num_nodes, residuals, g):
+    x, edge_src, edge_dst, edge_weight = residuals
+    g_edges = g[edge_dst].astype(jnp.float32)  # [E, F]
+    w = (
+        edge_weight[:, None].astype(jnp.float32)
+        if edge_weight is not None
+        else jnp.float32(1.0)
+    )
+    # dx[s] = Σ_{e: src[e]=s} w[e]·g[dst[e]] — not src-sorted, XLA scatter
+    dx = jax.ops.segment_sum(g_edges * w, edge_src, num_segments=x.shape[0]).astype(x.dtype)
+    if edge_weight is not None:
+        dw = jnp.sum(x[edge_src].astype(jnp.float32) * g_edges, axis=1).astype(
+            edge_weight.dtype
+        )
+    else:
+        dw = None
+    return dx, None, None, dw
+
+
+pallas_gather_scatter_sum.defvjp(_fwd, _bwd)

@@ -1,0 +1,103 @@
+"""Segment reductions over COO edges (XLA path).
+
+These are the message-passing primitives every model in models/ is built
+on: gather source-node rows, reduce them per destination node. The XLA
+lowering of ``jax.ops.segment_sum`` is a sorted scatter-add; the Pallas
+path (ops/pallas_segment.py) beats it by turning the scatter into MXU
+one-hot matmuls over dst-sorted edge blocks. ``gather_scatter_sum``
+dispatches between them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_FALLBACK_WARNED = False
+
+
+def segment_sum(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    weights: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Mean with masked counts: ``weights`` (0/1 per edge) excludes padding
+    edges from both numerator and denominator."""
+    if weights is not None:
+        data = data * weights[:, None]
+        counts = jax.ops.segment_sum(weights, segment_ids, num_segments=num_segments)
+    else:
+        counts = jax.ops.segment_sum(
+            jnp.ones(segment_ids.shape[0], dtype=data.dtype),
+            segment_ids,
+            num_segments=num_segments,
+        )
+    totals = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    return totals / jnp.maximum(counts, 1.0)[:, None]
+
+
+def segment_max(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def segment_softmax(
+    logits: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-segment softmax over edge logits (GAT attention normalization).
+
+    Masked edges get -inf logits so they contribute zero weight."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = jnp.where(mask, exp, 0.0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    return exp / jnp.maximum(denom[segment_ids], 1e-30)
+
+
+def gather_scatter_sum(
+    x: jnp.ndarray,
+    edge_src: jnp.ndarray,
+    edge_dst: jnp.ndarray,
+    num_nodes: int,
+    edge_weight: jnp.ndarray | None = None,
+    use_pallas: bool | None = None,
+) -> jnp.ndarray:
+    """out[d] = Σ_{e: dst[e]=d} w[e] · x[src[e]] — the GNN hot loop.
+
+    Dispatches to the Pallas TPU kernel when edges are dst-sorted (the
+    GraphBatch layout guarantees this) and a TPU backend is active;
+    otherwise the XLA gather + segment_sum path.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        try:
+            from alaz_tpu.ops.pallas_segment import pallas_gather_scatter_sum
+
+            return pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight)
+        except Exception as exc:  # pragma: no cover - lowering issues
+            global _FALLBACK_WARNED
+            if not _FALLBACK_WARNED:
+                _FALLBACK_WARNED = True
+                from alaz_tpu.logging import get_logger
+
+                get_logger("alaz_tpu.ops").warning(
+                    f"pallas scatter unavailable, falling back to XLA "
+                    f"(throughput regression!): {type(exc).__name__}: {exc}"
+                )
+    msgs = x[edge_src]
+    if edge_weight is not None:
+        msgs = msgs * edge_weight[:, None]
+    return jax.ops.segment_sum(msgs, edge_dst, num_segments=num_nodes)

@@ -1,0 +1,25 @@
+"""Device-mesh parallelism (SURVEY §2.3).
+
+- ``mesh``      — Mesh construction over (dp, tp, ep, sp) axes
+- ``sharding``  — PartitionSpec rules for params and graph batches; the
+                  sharded train/score steps (DP over windows, TP over
+                  hidden dims; XLA inserts the collectives)
+- ``halo``      — ring halo exchange for node-sharded graphs (SP/CP)
+"""
+
+from alaz_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from alaz_tpu.parallel.sharding import (
+    graph_pspec,
+    make_sharded_train_step,
+    param_pspec,
+    stack_graphs,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "graph_pspec",
+    "param_pspec",
+    "stack_graphs",
+    "make_sharded_train_step",
+]

@@ -1,0 +1,39 @@
+"""Mesh construction.
+
+Axes (SURVEY §2.3): ``dp`` data-parallel over window batches, ``tp``
+tensor-parallel over hidden dims, ``ep`` expert-parallel over edge-type
+experts, ``sp`` sequence/temporal-parallel over node shards (halo layer).
+All four axes always exist (size 1 collapses harmlessly), so
+PartitionSpecs are stable across topologies. On multi-host TPU, the
+device order from ``jax.devices()`` keeps ICI-adjacent chips adjacent on
+the trailing axes; put ``dp`` on the outermost (DCN-crossing) axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from alaz_tpu.config import MeshConfig
+
+AXES = ("dp", "tp", "ep", "sp")
+
+
+def mesh_shape_for(n_devices: int, tp: int = 1, ep: int = 1, sp: int = 1) -> MeshConfig:
+    """Fill dp with whatever the other axes leave over."""
+    rest = tp * ep * sp
+    assert n_devices % rest == 0, f"{n_devices} devices not divisible by tp*ep*sp={rest}"
+    return MeshConfig(dp=n_devices // rest, tp=tp, ep=ep, sp=sp)
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cfg is None:
+        cfg = mesh_shape_for(n)
+    shape = (cfg.dp, cfg.tp, cfg.ep, cfg.sp)
+    assert int(np.prod(shape)) == n, f"mesh {shape} != {n} devices"
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)

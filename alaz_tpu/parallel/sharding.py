@@ -1,0 +1,148 @@
+"""Sharding rules + the sharded train step.
+
+Strategy (the scaling-book recipe: pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+- **DP**: a step consumes a *stack* of G window graphs ``[G, ...]``; G is
+  sharded over ``dp``. Gradients all-reduce over ``dp`` automatically
+  (params are replicated over dp).
+- **TP**: every dense ``w [in, out]`` shards its out-dim over ``tp``; the
+  next layer contracts the sharded dim, so XLA places the reduce where the
+  math needs it. Embedding/type tables shard over tp on the hidden dim.
+- **EP/SP** are layered separately (experts.py routes by edge type;
+  halo.py shards the node axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.train.objective import edge_bce_loss
+
+# ---------------------------------------------------------------------------
+# PartitionSpecs
+# ---------------------------------------------------------------------------
+
+
+def param_pspec(params: Any, tp: int = 1) -> Any:
+    """TP rule: 2D weights shard the output dim over 'tp' when divisible
+    (heads ending in width-1 logits replicate); 1D params replicate."""
+
+    def rule(path: tuple, leaf) -> P:
+        if leaf.ndim == 2 and tp > 1 and leaf.shape[-1] % tp == 0:
+            # type_emb [T, H] and dense w [in, out]: shard last dim
+            return P(None, "tp")
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def graph_pspec(stacked: bool = True) -> dict:
+    """Graph-batch pytree spec: leading G axis sharded over 'dp'."""
+    lead = ("dp",) if stacked else ()
+
+    def spec(extra_dims: int) -> P:
+        return P(*lead, *([None] * extra_dims))
+
+    return {
+        "node_feats": spec(2),
+        "node_type": spec(1),
+        "node_mask": spec(1),
+        "edge_src": spec(1),
+        "edge_dst": spec(1),
+        "edge_type": spec(1),
+        "edge_feats": spec(2),
+        "edge_mask": spec(1),
+    }
+
+
+def stack_graphs(batches: list[GraphBatch]) -> tuple[dict, np.ndarray]:
+    """Stack same-bucket GraphBatches into [G, ...] arrays + labels."""
+    assert len({(b.n_pad, b.e_pad) for b in batches}) == 1, "mixed shape buckets"
+    graphs = [b.device_arrays() for b in batches]
+    stacked = {k: np.stack([g[k] for g in graphs]) for k in graphs[0]}
+    labels = np.stack([b.edge_label for b in batches])
+    return stacked, labels
+
+
+# ---------------------------------------------------------------------------
+# Sharded steps
+# ---------------------------------------------------------------------------
+
+
+def make_sharded_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    params_example: Any,
+    pos_weight: float = 10.0,
+) -> Callable:
+    """jit'd train step over a dp-sharded stack of graphs with tp-sharded
+    params. Returns step(params, opt_state, stacked_graph, labels)."""
+    _, apply = get_model(cfg.model)
+    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1))
+    g_spec = graph_pspec(stacked=True)
+
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)
+    opt_sh = None  # inferred by jit from params closure
+    graph_sh = {k: NamedSharding(mesh, s) for k, s in g_spec.items()}
+    label_sh = NamedSharding(mesh, P("dp", None))
+
+    def loss_fn(params, stacked_graph, labels):
+        def one(graph, lbl):
+            out = apply(params, graph, cfg)
+            return edge_bce_loss(
+                out["edge_logits"], lbl, graph["edge_mask"].astype(jnp.float32), pos_weight
+            )
+
+        losses = jax.vmap(one)(stacked_graph, labels)
+        return jnp.mean(losses)
+
+    @jax.jit
+    def step(params, opt_state, stacked_graph, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, stacked_graph, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def run(params, opt_state, stacked_graph_np, labels_np):
+        params = jax.device_put(params, param_sh)
+        graph = {
+            k: jax.device_put(jnp.asarray(v), graph_sh[k])
+            for k, v in stacked_graph_np.items()
+        }
+        labels = jax.device_put(jnp.asarray(labels_np), label_sh)
+        return step(params, opt_state, graph, labels)
+
+    return run
+
+
+def make_sharded_score_step(cfg: ModelConfig, mesh: Mesh, params_example: Any) -> Callable:
+    """jit'd inference over a dp-sharded stack of graphs."""
+    _, apply = get_model(cfg.model)
+    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1))
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)
+    graph_sh = {k: NamedSharding(mesh, s) for k, s in graph_pspec(True).items()}
+
+    @jax.jit
+    def score(params, stacked_graph):
+        return jax.vmap(lambda g: apply(params, g, cfg)["edge_logits"])(stacked_graph)
+
+    def run(params, stacked_graph_np):
+        params = jax.device_put(params, param_sh)
+        graph = {
+            k: jax.device_put(jnp.asarray(v), graph_sh[k])
+            for k, v in stacked_graph_np.items()
+        }
+        return score(params, graph)
+
+    return run

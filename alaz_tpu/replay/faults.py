@@ -1,0 +1,130 @@
+"""Fault injection for anomaly-detection evaluation.
+
+The reference README's failure taxonomy (README.md:51-57 — the classes
+Alaz's SaaS surfaces) is the label space:
+
+- ``latency_spike`` — an edge's latencies multiply by ~10
+- ``error_burst``   — a large fraction of an edge's responses go 5xx
+- ``zombie``        — a service stops answering (requests marked failed,
+  traffic collapses)
+
+Faults are injected on *request rows* (post-aggregator, pre-window) for a
+chosen set of edges over a window span; the oracle then labels aggregated
+GraphBatch edges by (src_uid, dst_uid) membership, which is the ground
+truth AUROC is computed against (BASELINE.json ≥0.9 gate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+import numpy as np
+
+LATENCY_SPIKE = "latency_spike"
+ERROR_BURST = "error_burst"
+ZOMBIE = "zombie"
+
+FAULT_KINDS = (LATENCY_SPIKE, ERROR_BURST, ZOMBIE)
+
+
+@dataclass
+class FaultPlan:
+    """Which (from_uid, to_uid) edges are faulty, with what, and when."""
+
+    # (from_uid_id, to_uid_id) -> fault kind
+    edges: Dict[Tuple[int, int], str] = field(default_factory=dict)
+    start_ms: int = 0
+    end_ms: int = 1 << 62
+
+    def active(self, window_start_ms: int) -> bool:
+        return self.start_ms <= window_start_ms < self.end_ms
+
+    @property
+    def edge_set(self) -> Set[Tuple[int, int]]:
+        return set(self.edges)
+
+
+def make_plan(
+    rng: np.random.Generator,
+    edge_uid_pairs: List[Tuple[int, int]],
+    fault_fraction: float = 0.15,
+    kinds: tuple = FAULT_KINDS,
+    start_ms: int = 0,
+    end_ms: int = 1 << 62,
+) -> FaultPlan:
+    n_faulty = max(1, int(len(edge_uid_pairs) * fault_fraction))
+    pick = rng.choice(len(edge_uid_pairs), size=n_faulty, replace=False)
+    plan = FaultPlan(start_ms=start_ms, end_ms=end_ms)
+    for i in pick:
+        plan.edges[edge_uid_pairs[int(i)]] = kinds[int(rng.integers(0, len(kinds)))]
+    return plan
+
+
+def inject(rows: np.ndarray, plan: FaultPlan, rng: np.random.Generator) -> np.ndarray:
+    """Mutate REQUEST_DTYPE rows in place per the plan; returns per-row
+    0/1 labels (ground truth at request granularity)."""
+    labels = np.zeros(rows.shape[0], dtype=np.float32)
+    if not plan.edges:
+        return labels
+    if rows.shape[0] == 0:
+        return labels
+    active = plan.active(int(rows["start_time_ms"].min()))
+    if not active:
+        return labels
+    pair = rows["from_uid"].astype(np.int64) << 32 | rows["to_uid"].astype(np.int64)
+    for (fu, tu), kind in plan.edges.items():
+        mask = pair == (np.int64(fu) << 32 | np.int64(tu))
+        if not mask.any():
+            continue
+        labels[mask] = 1.0
+        idx = np.flatnonzero(mask)
+        if kind == LATENCY_SPIKE:
+            rows["latency_ns"][idx] = (
+                rows["latency_ns"][idx].astype(np.float64)
+                * rng.uniform(8.0, 15.0, idx.shape[0])
+            ).astype(np.uint64)
+        elif kind == ERROR_BURST:
+            hit = idx[rng.random(idx.shape[0]) < 0.8]
+            rows["status_code"][hit] = 500
+        elif kind == ZOMBIE:
+            # service stops answering: requests fail, most traffic vanishes
+            rows["completed"][idx] = False
+            rows["status_code"][idx] = 0
+    return labels
+
+
+def drop_zombie_rows(rows: np.ndarray, labels: np.ndarray, plan: FaultPlan, rng: np.random.Generator, keep_frac: float = 0.1):
+    """Zombie edges lose most of their traffic; apply after inject()."""
+    if not plan.edges:
+        return rows, labels
+    pair = rows["from_uid"].astype(np.int64) << 32 | rows["to_uid"].astype(np.int64)
+    drop = np.zeros(rows.shape[0], dtype=bool)
+    for (fu, tu), kind in plan.edges.items():
+        if kind != ZOMBIE:
+            continue
+        mask = pair == (np.int64(fu) << 32 | np.int64(tu))
+        drop |= mask & (rng.random(rows.shape[0]) > keep_frac)
+    return rows[~drop], labels[~drop]
+
+
+def _pack_pairs(fu: np.ndarray, tu: np.ndarray) -> np.ndarray:
+    return fu.astype(np.int64) << 32 | tu.astype(np.int64)
+
+
+def label_batch_edges(batch, plan: FaultPlan) -> np.ndarray:
+    """Oracle labels for an aggregated GraphBatch: edge is faulty iff its
+    (src_uid, dst_uid) is in the plan and the window overlaps the span.
+    Vectorized via the same packed int64 pair key inject() matches on."""
+    labels = np.zeros(batch.e_pad, dtype=np.float32)
+    if batch.node_uids is None or not plan.active(batch.window_start_ms) or not plan.edges:
+        return labels
+    uids = batch.node_uids
+    edge_keys = _pack_pairs(uids[batch.edge_src], uids[batch.edge_dst])
+    plan_keys = np.array(
+        [int(fu) << 32 | int(tu) for fu, tu in plan.edges], dtype=np.int64
+    )
+    hit = np.isin(edge_keys, plan_keys)
+    hit[batch.n_edges :] = False
+    labels[hit] = 1.0
+    return labels

@@ -1,0 +1,36 @@
+"""Eval metrics. AUROC via the rank statistic (Mann-Whitney U), computed
+host-side in numpy — the BASELINE.json quality gate is ≥0.9 AUROC on
+injected-fault graphs."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def auroc(scores: np.ndarray, labels: np.ndarray, mask: np.ndarray | None = None) -> float:
+    scores = np.asarray(scores, dtype=np.float64)
+    labels = np.asarray(labels) > 0.5
+    if mask is not None:
+        keep = np.asarray(mask, dtype=bool)
+        scores, labels = scores[keep], labels[keep]
+    n_pos = int(labels.sum())
+    n_neg = labels.shape[0] - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="stable")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, scores.shape[0] + 1)
+    # midranks for ties
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            mid = (i + j + 2) / 2.0
+            ranks[order[i : j + 1]] = mid
+        i = j + 1
+    pos_rank_sum = ranks[labels].sum()
+    u = pos_rank_sum - n_pos * (n_pos + 1) / 2.0
+    return float(u / (n_pos * n_neg))

@@ -1,0 +1,19 @@
+"""Losses. Fault detection is per-edge binary classification with heavy
+class imbalance, so BCE with positive-class upweighting, masked to real
+(non-padding) edges."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def edge_bce_loss(
+    edge_logits: jnp.ndarray,
+    edge_label: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    pos_weight: float = 10.0,
+) -> jnp.ndarray:
+    per_edge = optax.sigmoid_binary_cross_entropy(edge_logits, edge_label)
+    weight = jnp.where(edge_label > 0.5, pos_weight, 1.0) * edge_mask
+    return jnp.sum(per_edge * weight) / jnp.maximum(jnp.sum(weight), 1.0)

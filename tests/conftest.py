@@ -1,5 +1,12 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh before any import
-(the multi-host story SURVEY §4 notes the reference lacks)."""
+"""Test env: force JAX onto a virtual 8-device CPU mesh (the multi-host
+story SURVEY §4 notes the reference lacks).
+
+The runtime environment may pre-register an accelerator plugin via
+sitecustomize (importing jax before pytest starts), so env vars alone are
+too late — ``jax.config.update("jax_platforms", ...)`` works post-import
+and wins. XLA_FLAGS still applies because the CPU client initializes
+lazily on first device query.
+"""
 
 import os
 
@@ -7,3 +14,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # data-plane-only environments
+    pass

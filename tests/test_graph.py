@@ -1,0 +1,131 @@
+"""Graph batching: buckets, snapshot building, windowed aggregation."""
+
+import numpy as np
+
+from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.graph.builder import (
+    EDGE_FEATURE_DIM,
+    NODE_FEATURE_DIM,
+    GraphBuilder,
+    NodeTable,
+    WindowedGraphStore,
+)
+from alaz_tpu.graph.snapshot import GraphBatch, pad_to_bucket
+
+
+class TestBuckets:
+    def test_pad_to_bucket(self):
+        assert pad_to_bucket(1) == 128
+        assert pad_to_bucket(128) == 128
+        assert pad_to_bucket(129) == 256
+        assert pad_to_bucket(300) == 384  # midpoint bucket
+        assert pad_to_bucket(400) == 512
+        assert pad_to_bucket(11000) == 12288
+        # every bucket is a multiple of 128 (Pallas tile requirement)
+        for n in (1, 100, 500, 3000, 50_000, 900_000):
+            assert pad_to_bucket(n) % 128 == 0
+
+
+class TestGraphBatch:
+    def test_build_pads_and_sorts(self):
+        nf = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
+        src = np.array([1, 5, 2, 0], dtype=np.int32)
+        dst = np.array([9, 2, 7, 2], dtype=np.int32)
+        b = GraphBatch.build(
+            node_feats=nf,
+            node_type=np.ones(10, np.int32),
+            edge_src=src,
+            edge_dst=dst,
+            edge_type=np.zeros(4, np.int32),
+            edge_feats=np.zeros((4, 2), np.float32),
+        )
+        assert b.n_pad == 128 and b.e_pad == 128
+        assert b.n_nodes == 10 and b.n_edges == 4
+        # dst-sorted real edges
+        real_dst = b.edge_dst[:4]
+        assert list(real_dst) == sorted(real_dst)
+        # padding edges park on the last padded node slot, masked out
+        assert (b.edge_dst[4:] == b.n_pad - 1).all()
+        assert not b.edge_mask[4:].any()
+        assert b.node_mask[:10].all() and not b.node_mask[10:].any()
+
+
+class TestGraphBuilder:
+    def _rows(self, interner):
+        rows = make_requests(6)
+        a, b, svc = (
+            interner.intern("pod-a"),
+            interner.intern("pod-b"),
+            interner.intern("svc-x"),
+        )
+        rows["from_uid"] = [a, a, a, b, b, b]
+        rows["from_type"] = EP_POD
+        rows["to_uid"] = [svc, svc, svc, svc, svc, svc]
+        rows["to_type"] = EP_SERVICE
+        rows["protocol"] = [1, 1, 1, 1, 1, 3]  # http ×5, postgres ×1
+        rows["latency_ns"] = [100, 200, 300, 50, 50, 1000]
+        rows["status_code"] = [200, 500, 200, 200, 404, 200]
+        rows["completed"] = True
+        return rows
+
+    def test_aggregation(self):
+        interner = Interner()
+        builder = GraphBuilder(window_s=1.0)
+        batch = builder.build(self._rows(interner))
+        # 3 aggregated edges: (a→svc,HTTP), (b→svc,HTTP), (b→svc,POSTGRES)
+        assert batch.n_edges == 3
+        assert batch.n_nodes == 3
+        ef = batch.edge_feats[: batch.n_edges]
+        counts = np.expm1(ef[:, 0])
+        assert sorted(np.round(counts).astype(int)) == [1, 2, 3]
+        # error rate present on the a→svc HTTP edge (1 of 3 requests 500)
+        err = ef[:, 3]
+        assert np.isclose(err.max(), 1 / 3, atol=1e-5)
+        # node features: svc has in-traffic, pods have out-traffic
+        nf = batch.node_feats
+        svc_slot = 2  # third distinct uid seen
+        assert nf[svc_slot, 5] > 0 and nf[svc_slot, 4] == 0  # in but no out
+        assert nf[0, 4] > 0 and nf[0, 5] == 0
+
+    def test_node_slots_stable_across_windows(self):
+        interner = Interner()
+        builder = GraphBuilder(window_s=1.0)
+        b1 = builder.build(self._rows(interner))
+        b2 = builder.build(self._rows(interner))
+        assert b1.n_nodes == b2.n_nodes == 3
+        assert (b1.node_uids[:3] == b2.node_uids[:3]).all()
+
+    def test_labels_aggregate_by_any(self):
+        interner = Interner()
+        builder = GraphBuilder(window_s=1.0)
+        rows = self._rows(interner)
+        labels = np.array([0, 1, 0, 0, 0, 0], dtype=np.float32)
+        batch = builder.build(rows, edge_label=labels)
+        assert batch.edge_label[: batch.n_edges].sum() == 1.0
+
+    def test_feature_dims(self):
+        assert NODE_FEATURE_DIM == 32 and EDGE_FEATURE_DIM == 16
+
+
+class TestWindowedStore:
+    def test_window_close_on_watermark(self):
+        interner = Interner()
+        store = WindowedGraphStore(interner, window_s=1.0)
+        rows = make_requests(4)
+        rows["from_uid"] = interner.intern("p")
+        rows["from_type"] = EP_POD
+        rows["to_uid"] = interner.intern("s")
+        rows["to_type"] = EP_SERVICE
+        rows["start_time_ms"] = [0, 500, 999, 1500]  # windows 0 and 1
+        store.persist_requests(rows)
+        # watermark at window 1 closes window 0
+        assert len(store.batches) == 1
+        assert store.batches[0].window_start_ms == 0
+        rows2 = rows.copy()
+        rows2["start_time_ms"] = 2500
+        store.persist_requests(rows2)
+        assert len(store.batches) == 2
+        store.flush()
+        assert len(store.batches) == 3
+        assert store.request_count == 8

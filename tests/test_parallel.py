@@ -1,0 +1,106 @@
+"""Mesh/sharding: 8-virtual-device CPU mesh, sharded train step, dryrun."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from __graft_entry__ import _example_batch, dryrun_multichip, entry
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.parallel.mesh import AXES, make_mesh, mesh_shape_for
+from alaz_tpu.parallel.sharding import (
+    make_sharded_score_step,
+    make_sharded_train_step,
+    param_pspec,
+    stack_graphs,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+class TestMesh:
+    def test_axes_and_shapes(self):
+        mesh = make_mesh(mesh_shape_for(8, tp=2))
+        assert mesh.axis_names == AXES
+        assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+
+    def test_indivisible_raises(self):
+        with pytest.raises(AssertionError):
+            mesh_shape_for(8, tp=3)
+
+
+class TestParamSpecs:
+    def test_tp_sharding_rules(self):
+        cfg = ModelConfig(model="graphsage", hidden_dim=64)
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg)
+        specs = param_pspec(params, tp=2)
+        flat = jax.tree_util.tree_leaves_with_path(specs)
+        sharded = [s for _, s in flat if s == jax.sharding.PartitionSpec(None, "tp")]
+        assert len(sharded) > 4  # hidden-dim weights shard
+        # width-1 head output replicates
+        from jax.sharding import PartitionSpec as P
+
+        head_last = specs["edge_head"][-1]["w"]
+        assert head_last == P()
+
+
+class TestShardedTraining:
+    def test_sharded_step_matches_replicated_loss(self):
+        cfg = ModelConfig(model="graphsage", hidden_dim=64, use_pallas=False)
+        init, apply = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg)
+        opt = optax.sgd(0.0)  # lr 0: loss comparison only
+        opt_state = opt.init(params)
+
+        batches = [_example_batch(n_pods=60, n_svcs=12, n_edges=200, seed=s) for s in range(4)]
+        for b in batches:
+            b.edge_label = (np.random.default_rng(0).random(b.e_pad) < 0.1).astype(np.float32)
+        stacked, labels = stack_graphs(batches)
+
+        mesh = make_mesh(mesh_shape_for(8, tp=2))
+        with mesh:
+            step = make_sharded_train_step(cfg, mesh, opt, params)
+            _, _, loss_sharded = step(params, opt_state, stacked, labels)
+
+        # replicated reference
+        import jax.numpy as jnp
+
+        from alaz_tpu.train.objective import edge_bce_loss
+
+        losses = []
+        for b in batches:
+            g = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+            out = apply(params, g, cfg)
+            losses.append(
+                edge_bce_loss(out["edge_logits"], jnp.asarray(b.edge_label), g["edge_mask"].astype(jnp.float32))
+            )
+        ref = float(np.mean([float(l) for l in losses]))
+        assert abs(float(loss_sharded) - ref) < 5e-3
+
+    def test_sharded_score(self):
+        cfg = ModelConfig(model="graphsage", hidden_dim=64, use_pallas=False)
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg)
+        batches = [_example_batch(n_pods=60, n_svcs=12, n_edges=200, seed=s) for s in range(8)]
+        stacked, _ = stack_graphs(batches)
+        mesh = make_mesh(mesh_shape_for(8))  # dp=8
+        with mesh:
+            score = make_sharded_score_step(cfg, mesh, params)
+            out = score(params, stacked)
+        assert out.shape == (8, batches[0].e_pad)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestEntryPoints:
+    def test_entry_jits(self):
+        fn, args = entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape[0] == args[1]["edge_src"].shape[0]
+
+    def test_dryrun_multichip(self, capsys):
+        dryrun_multichip(8)
+        assert "dryrun_multichip ok" in capsys.readouterr().out
