@@ -1,0 +1,274 @@
+"""ctypes binding for the C++ ingest core (native/ingest.cc).
+
+``NativeIngest`` is the high-throughput path of the windowed graph builder:
+REQUEST_DTYPE rows are converted (vectorized) into the 32-byte wire record,
+pushed into the native ring, and closed windows come back as aggregated
+COO columns from which GraphBatches are assembled with the same feature
+schema as the pure-numpy ``GraphBuilder``. Build the library with
+``make -C alaz_tpu/native``; ``available()`` gates callers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from alaz_tpu.graph.builder import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
+from alaz_tpu.graph.snapshot import GraphBatch
+
+_LIB_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _LIB_DIR / "libalaz_ingest.so"
+
+# mirrors struct AlzRecord (ingest.cc); flags: bit0 tls, bit1 failed
+NATIVE_RECORD_DTYPE = np.dtype(
+    {
+        "names": [
+            "start_time_ms", "latency_ns", "from_uid", "to_uid",
+            "status", "from_type", "to_type", "protocol", "flags",
+        ],
+        "formats": [
+            np.int64, np.uint64, np.int32, np.int32,
+            np.uint32, np.uint8, np.uint8, np.uint8, np.uint8,
+        ],
+        "offsets": [0, 8, 16, 20, 24, 28, 29, 30, 31],
+        "itemsize": 32,
+    }
+)
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library if needed; True on success."""
+    if _LIB_PATH.exists() and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_LIB_DIR)], check=True, capture_output=True, timeout=120
+        )
+        return _LIB_PATH.exists()
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists() and not build():
+        return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.alz_create.restype = ctypes.c_void_p
+    lib.alz_create.argtypes = [ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32]
+    lib.alz_destroy.argtypes = [ctypes.c_void_p]
+    lib.alz_push.restype = ctypes.c_uint32
+    lib.alz_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
+    lib.alz_drain.restype = ctypes.c_int64
+    lib.alz_drain.argtypes = [ctypes.c_void_p]
+    lib.alz_dropped.restype = ctypes.c_uint64
+    lib.alz_dropped.argtypes = [ctypes.c_void_p]
+    lib.alz_current_window.restype = ctypes.c_int64
+    lib.alz_current_window.argtypes = [ctypes.c_void_p]
+    lib.alz_node_count.restype = ctypes.c_uint32
+    lib.alz_node_count.argtypes = [ctypes.c_void_p]
+    lib.alz_close_window.restype = ctypes.c_int32
+    lib.alz_close_window.argtypes = [ctypes.c_void_p, ctypes.c_uint32] + [ctypes.c_void_p] * 10
+    lib.alz_export_nodes.restype = ctypes.c_uint32
+    lib.alz_export_nodes.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_INT64_MIN = -(2**63)
+
+
+class NativeIngest:
+    """Windowed edge aggregation backed by the C++ core.
+
+    Usage: ``push(request_rows)`` (drop-not-block), then ``poll()`` which
+    returns a GraphBatch whenever a window closed.
+    """
+
+    def __init__(
+        self,
+        window_s: float = 1.0,
+        ring_capacity: int = 1 << 18,
+        max_edges: int = 1 << 20,
+        max_nodes: int = 1 << 20,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libalaz_ingest.so unavailable; run make -C alaz_tpu/native")
+        self._lib = lib
+        self.window_ms = int(window_s * 1000)
+        self.window_s = window_s
+        self.max_edges = max_edges
+        self.max_nodes = max_nodes
+        self._h = ctypes.c_void_p(
+            lib.alz_create(self.window_ms, ring_capacity, max_edges, max_nodes)
+        )
+        # reusable export buffers
+        self._src = np.zeros(max_edges, np.int32)
+        self._dst = np.zeros(max_edges, np.int32)
+        self._proto = np.zeros(max_edges, np.uint8)
+        self._count = np.zeros(max_edges, np.uint64)
+        self._lat_sum = np.zeros(max_edges, np.uint64)
+        self._lat_max = np.zeros(max_edges, np.uint64)
+        self._err5 = np.zeros(max_edges, np.uint32)
+        self._err4 = np.zeros(max_edges, np.uint32)
+        self._tls = np.zeros(max_edges, np.uint32)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.alz_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.alz_dropped(self._h))
+
+    @staticmethod
+    def to_records(rows: np.ndarray) -> np.ndarray:
+        """REQUEST_DTYPE rows → packed native records (vectorized)."""
+        out = np.zeros(rows.shape[0], dtype=NATIVE_RECORD_DTYPE)
+        out["start_time_ms"] = rows["start_time_ms"]
+        out["latency_ns"] = rows["latency_ns"]
+        out["from_uid"] = rows["from_uid"]
+        out["to_uid"] = rows["to_uid"]
+        out["status"] = rows["status_code"]
+        out["from_type"] = rows["from_type"]
+        out["to_type"] = rows["to_type"]
+        out["protocol"] = rows["protocol"]
+        out["flags"] = rows["tls"].astype(np.uint8) | (
+            (~rows["completed"]).astype(np.uint8) << 1
+        )
+        return out
+
+    def push(self, rows: np.ndarray) -> int:
+        """Push REQUEST_DTYPE rows; returns accepted count."""
+        recs = self.to_records(np.ascontiguousarray(rows))
+        return int(
+            self._lib.alz_push(
+                self._h, recs.ctypes.data_as(ctypes.c_void_p), recs.shape[0]
+            )
+        )
+
+    def poll(self) -> Optional[GraphBatch]:
+        """Drain the ring; if a window closed, build and return its batch."""
+        ready = int(self._lib.alz_drain(self._h))
+        if ready == _INT64_MIN:
+            return None
+        return self._close_current()
+
+    def flush(self) -> list[GraphBatch]:
+        """Drain everything and close every window (intermediate windows
+        closed during the drain are returned too, oldest first)."""
+        out: list[GraphBatch] = []
+        while True:
+            ready = int(self._lib.alz_drain(self._h))
+            if ready == _INT64_MIN:
+                break
+            out.append(self._close_current())
+        if int(self._lib.alz_current_window(self._h)) != _INT64_MIN:
+            out.append(self._close_current())
+        return out
+
+    def _close_current(self) -> GraphBatch:
+        ws = ctypes.c_int64(0)
+        n = int(
+            self._lib.alz_close_window(
+                self._h,
+                self.max_edges,
+                ctypes.byref(ws),
+                *(
+                    a.ctypes.data_as(ctypes.c_void_p)
+                    for a in (
+                        self._src, self._dst, self._proto, self._count,
+                        self._lat_sum, self._lat_max, self._err5, self._err4,
+                        self._tls,
+                    )
+                ),
+            )
+        )
+        if n < 0:
+            raise RuntimeError("native edge buffer overflow; raise max_edges")
+
+        n_nodes = int(self._lib.alz_node_count(self._h))
+        uids = np.zeros(n_nodes, np.int32)
+        types = np.zeros(n_nodes, np.uint8)
+        self._lib.alz_export_nodes(
+            self._h, n_nodes,
+            uids.ctypes.data_as(ctypes.c_void_p), types.ctypes.data_as(ctypes.c_void_p),
+        )
+        return self._assemble(
+            n, int(ws.value), uids, types.astype(np.int32)
+        )
+
+    def _assemble(self, n: int, window_start_ms: int, uids: np.ndarray, node_type: np.ndarray) -> GraphBatch:
+        count = self._count[:n].astype(np.float64)
+        lat_sum = self._lat_sum[:n].astype(np.float64)
+        lat_max = self._lat_max[:n].astype(np.float64)
+        err5 = self._err5[:n].astype(np.float64)
+        err4 = self._err4[:n].astype(np.float64)
+        tls = self._tls[:n].astype(np.float64)
+        src = self._src[:n].copy()
+        dst = self._dst[:n].copy()
+
+        window_s = max(self.window_s, 1e-6)
+        mean_lat = lat_sum / np.maximum(count, 1.0)
+        ef = np.zeros((n, EDGE_FEATURE_DIM), dtype=np.float32)
+        ef[:, 0] = np.log1p(count)
+        ef[:, 1] = np.log1p(mean_lat) / 20.0
+        ef[:, 2] = np.log1p(lat_max) / 20.0
+        ef[:, 3] = err5 / np.maximum(count, 1.0)
+        ef[:, 4] = err4 / np.maximum(count, 1.0)
+        ef[:, 5] = tls / np.maximum(count, 1.0)
+        ef[:, 6] = np.log1p(count / window_s)
+
+        n_nodes = uids.shape[0]
+        nf = np.zeros((n_nodes, NODE_FEATURE_DIM), dtype=np.float32)
+        for t in range(4):
+            nf[:, t] = node_type == t
+        out_cnt = np.bincount(src, weights=count, minlength=n_nodes)
+        in_cnt = np.bincount(dst, weights=count, minlength=n_nodes)
+        out_err = np.bincount(src, weights=err5, minlength=n_nodes)
+        in_err = np.bincount(dst, weights=err5, minlength=n_nodes)
+        out_lat = np.bincount(src, weights=lat_sum, minlength=n_nodes)
+        in_lat = np.bincount(dst, weights=lat_sum, minlength=n_nodes)
+        out_deg = np.bincount(src, minlength=n_nodes).astype(np.float64)
+        in_deg = np.bincount(dst, minlength=n_nodes).astype(np.float64)
+        nf[:, 4] = np.log1p(out_cnt)
+        nf[:, 5] = np.log1p(in_cnt)
+        nf[:, 6] = out_err / np.maximum(out_cnt, 1.0)
+        nf[:, 7] = in_err / np.maximum(in_cnt, 1.0)
+        nf[:, 8] = np.log1p(out_lat / np.maximum(out_cnt, 1.0)) / 20.0
+        nf[:, 9] = np.log1p(in_lat / np.maximum(in_cnt, 1.0)) / 20.0
+        nf[:, 10] = np.log1p(out_deg)
+        nf[:, 11] = np.log1p(in_deg)
+
+        return GraphBatch.build(
+            node_feats=nf,
+            node_type=node_type,
+            edge_src=src,
+            edge_dst=dst,
+            edge_type=self._proto[:n].astype(np.int32),
+            edge_feats=ef,
+            node_uids=uids,
+            window_start_ms=window_start_ms,
+            window_end_ms=window_start_ms + self.window_ms,
+        )

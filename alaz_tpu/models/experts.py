@@ -1,0 +1,103 @@
+"""Edge-type expert model (EP) — per-protocol expert MLPs.
+
+The reference dispatches each event to a per-protocol handler
+(data.go:1364-1383); here that becomes per-edge-type expert message
+transforms (SURVEY §2.3 P5): each L7 protocol gets its own message weight
+``W_t``, computed as a masked sum of T dense matmuls (T is small and
+static, so every matmul is MXU-shaped and the routing is branch-free):
+
+    m_e = Σ_t 1[type_e = t] · (h[src_e] @ W_t + b_t)
+
+Expert tables are stacked ``[T, H, H]``; under pjit the T axis shards over
+the ``ep`` mesh axis and XLA turns the masked sum into compute-where-
+resident + all-reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.models.common import (
+    compute_dtype,
+    dense,
+    dense_init,
+    edge_head,
+    edge_head_init,
+    layernorm,
+    layernorm_init,
+    mlp,
+    mlp_init,
+    scatter_messages,
+)
+
+Params = Dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    h = cfg.hidden_dim
+    t = cfg.num_edge_types
+    keys = jax.random.split(key, 4 + 4 * cfg.num_layers)
+    params: Params = {
+        "embed": dense_init(keys[0], cfg.node_feature_dim, h),
+        "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
+        "node_head": mlp_init(keys[3], [h, h, 1]),
+        "layers": [],
+    }
+    for l in range(cfg.num_layers):
+        k = jax.random.split(keys[4 + l], 5)
+        scale = (2.0 / h) ** 0.5
+        params["layers"].append(
+            {
+                # stacked experts: [T, H, H] + [T, H]
+                "expert_w": jax.random.normal(k[0], (t, h, h), jnp.float32) * scale,
+                "expert_b": jnp.zeros((t, h), jnp.float32),
+                "edge_proj": dense_init(k[1], cfg.edge_feature_dim, h),
+                "self": dense_init(k[2], h, h),
+                "neigh": dense_init(k[3], h, h),
+                "ln": layernorm_init(h),
+            }
+        )
+    return params
+
+
+def _expert_messages(layer: Params, h_src: jnp.ndarray, edge_type: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Masked sum over experts — T static matmuls, no gather of weights."""
+    t = layer["expert_w"].shape[0]
+    out = jnp.zeros_like(h_src)
+    for ti in range(t):
+        w = layer["expert_w"][ti].astype(dtype)
+        b = layer["expert_b"][ti].astype(dtype)
+        mask = (edge_type == ti).astype(dtype)[:, None]
+        out = out + mask * (h_src @ w + b)
+    return out
+
+
+def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
+    dtype = compute_dtype(cfg)
+    n = graph["node_feats"].shape[0]
+    node_mask = graph["node_mask"].astype(dtype)
+    edge_mask = graph["edge_mask"]
+
+    h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
+    ef = graph["edge_feats"].astype(dtype)
+
+    for layer in params["layers"]:
+        msgs = _expert_messages(layer, h[graph["edge_src"]], graph["edge_type"], dtype)
+        msgs = msgs + dense(layer["edge_proj"], ef)
+        agg, deg = scatter_messages(msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas)
+        agg = agg / jnp.maximum(deg, 1.0)[:, None]
+        h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
+        h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
+        h = (h + h_new) * node_mask[:, None]
+
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype)
+    node_logits = mlp(params["node_head"], h)[:, 0]
+    return {
+        "node_h": h,
+        "edge_logits": edge_logits.astype(jnp.float32),
+        "node_logits": node_logits.astype(jnp.float32),
+    }

@@ -14,4 +14,8 @@ def get_model(name: str):
         from alaz_tpu.models import tgn
 
         return tgn.init, tgn.step
-    raise ValueError(f"unknown model {name!r} (graphsage|gat|tgn)")
+    if name == "experts":
+        from alaz_tpu.models import experts
+
+        return experts.init, experts.apply
+    raise ValueError(f"unknown model {name!r} (graphsage|gat|tgn|experts)")

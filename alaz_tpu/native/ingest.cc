@@ -1,0 +1,308 @@
+// Host ingest plane: lock-free ring buffer + windowed edge accumulator.
+//
+// This is the native core of the graph batcher (SURVEY §2.1 "TPU-native
+// equivalents": the C++ analog of the reference's kernel-side event plane,
+// playing the role l7.c's maps play — bounded, drop-not-block, fixed-size
+// records). Producers push resolved edge records into a SPSC ring; the
+// consumer drains into an open-addressing accumulator keyed
+// (from_uid, to_uid, protocol) per time window; closed windows export COO
+// arrays + per-node tables directly into caller-provided (numpy) buffers.
+//
+// Build: make -C alaz_tpu/native   → libalaz_ingest.so (ctypes-loaded by
+// alaz_tpu/graph/native.py; the pure-numpy GraphBuilder is the fallback).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// 32-byte wire record; mirrored by NATIVE_RECORD_DTYPE in graph/native.py.
+// flags: bit0 = tls, bit1 = failed (request not completed)
+struct AlzRecord {
+  int64_t start_time_ms;
+  uint64_t latency_ns;
+  int32_t from_uid;
+  int32_t to_uid;
+  uint32_t status;
+  uint8_t from_type;
+  uint8_t to_type;
+  uint8_t protocol;
+  uint8_t flags;
+};
+
+struct EdgeSlot {
+  int32_t from_uid;
+  int32_t to_uid;
+  uint8_t protocol;
+  uint8_t used;
+  int32_t src_slot;
+  int32_t dst_slot;
+  uint64_t count;
+  uint64_t lat_sum;
+  uint64_t lat_max;
+  uint32_t err5;
+  uint32_t err4;
+  uint32_t tls_cnt;
+};
+
+struct NodeSlot {
+  int32_t uid;
+  int32_t slot;  // dense node index
+  uint8_t type;
+  uint8_t used;
+};
+
+}  // extern "C"
+
+namespace {
+
+inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+class NodeTable {
+ public:
+  explicit NodeTable(uint32_t cap_pow2) : mask_(cap_pow2 - 1), slots_(cap_pow2) {}
+
+  // uid -> dense slot (insert on miss); -1 when full
+  int32_t get_or_add(int32_t uid, uint8_t type, std::vector<int32_t>* uids,
+                     std::vector<uint8_t>* types) {
+    uint64_t h = mix64(static_cast<uint64_t>(static_cast<uint32_t>(uid)));
+    for (uint32_t probe = 0; probe <= mask_; ++probe) {
+      NodeSlot& s = slots_[(h + probe) & mask_];
+      if (!s.used) {
+        s.used = 1;
+        s.uid = uid;
+        s.type = type;
+        s.slot = static_cast<int32_t>(uids->size());
+        uids->push_back(uid);
+        types->push_back(type);
+        return s.slot;
+      }
+      if (s.uid == uid) return s.slot;
+    }
+    return -1;
+  }
+
+ private:
+  uint32_t mask_;
+  std::vector<NodeSlot> slots_;
+};
+
+class EdgeTable {
+ public:
+  explicit EdgeTable(uint32_t cap_pow2) : mask_(cap_pow2 - 1), slots_(cap_pow2) {}
+
+  EdgeSlot* get_or_add(int32_t fu, int32_t tu, uint8_t proto, bool* is_new) {
+    uint64_t h = mix64((static_cast<uint64_t>(static_cast<uint32_t>(fu)) << 32) ^
+                       (static_cast<uint64_t>(static_cast<uint32_t>(tu)) << 8) ^ proto);
+    for (uint32_t probe = 0; probe <= mask_; ++probe) {
+      EdgeSlot& s = slots_[(h + probe) & mask_];
+      if (!s.used) {
+        std::memset(&s, 0, sizeof(s));
+        s.used = 1;
+        s.from_uid = fu;
+        s.to_uid = tu;
+        s.protocol = proto;
+        *is_new = true;
+        order_.push_back(&s);
+        return &s;
+      }
+      if (s.from_uid == fu && s.to_uid == tu && s.protocol == proto) {
+        *is_new = false;
+        return &s;
+      }
+    }
+    return nullptr;
+  }
+
+  void clear() {
+    for (EdgeSlot* s : order_) s->used = 0;
+    order_.clear();
+  }
+
+  const std::vector<EdgeSlot*>& order() const { return order_; }
+
+ private:
+  uint32_t mask_;
+  std::vector<EdgeSlot> slots_;
+  std::vector<EdgeSlot*> order_;
+};
+
+struct Ingest {
+  // SPSC ring
+  std::vector<AlzRecord> ring;
+  uint32_t ring_mask;
+  std::atomic<uint64_t> head{0};  // producer writes
+  std::atomic<uint64_t> tail{0};  // consumer reads
+  std::atomic<uint64_t> dropped{0};
+
+  // window state
+  int64_t window_ms;
+  int64_t current_window = INT64_MIN;  // window id (start_ms / window_ms)
+  int64_t closed_upto = INT64_MIN;
+  uint64_t late_dropped = 0;
+
+  EdgeTable edges;
+  NodeTable nodes;
+  // persistent node identity (slots stable across windows)
+  std::vector<int32_t> node_uids;
+  std::vector<uint8_t> node_types;
+
+  Ingest(int64_t wms, uint32_t ring_cap, uint32_t edge_cap, uint32_t node_cap)
+      : ring(ring_cap), ring_mask(ring_cap - 1), window_ms(wms),
+        edges(edge_cap), nodes(node_cap) {}
+};
+
+inline uint32_t next_pow2(uint32_t v) {
+  uint32_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+void accumulate(Ingest* ig, const AlzRecord& r) {
+  int32_t src = ig->nodes.get_or_add(r.from_uid, r.from_type, &ig->node_uids,
+                                     &ig->node_types);
+  int32_t dst = ig->nodes.get_or_add(r.to_uid, r.to_type, &ig->node_uids,
+                                     &ig->node_types);
+  if (src < 0 || dst < 0) return;  // node table full: drop
+  bool is_new = false;
+  EdgeSlot* e = ig->edges.get_or_add(r.from_uid, r.to_uid, r.protocol, &is_new);
+  if (e == nullptr) return;  // edge table full: drop
+  if (is_new) {
+    e->src_slot = src;
+    e->dst_slot = dst;
+  }
+  e->count += 1;
+  e->lat_sum += r.latency_ns;
+  if (r.latency_ns > e->lat_max) e->lat_max = r.latency_ns;
+  // err5 matches GraphBuilder: (status >= 500) | !completed — status 0 on a
+  // completed request is a success for non-HTTP protocols
+  if (r.status >= 500 || (r.flags & 0x2)) e->err5 += 1;
+  else if (r.status >= 400) e->err4 += 1;
+  if (r.flags & 0x1) e->tls_cnt += 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* alz_create(int64_t window_ms, uint32_t ring_capacity, uint32_t max_edges,
+                 uint32_t max_nodes) {
+  return new Ingest(window_ms, next_pow2(ring_capacity),
+                    next_pow2(max_edges * 2), next_pow2(max_nodes * 2));
+}
+
+void alz_destroy(void* p) { delete static_cast<Ingest*>(p); }
+
+// Producer side: push n records; returns how many were accepted (the rest
+// are counted dropped — the l7.go:764-770 drop-not-block contract).
+uint32_t alz_push(void* p, const AlzRecord* recs, uint32_t n) {
+  Ingest* ig = static_cast<Ingest*>(p);
+  uint64_t head = ig->head.load(std::memory_order_relaxed);
+  uint64_t tail = ig->tail.load(std::memory_order_acquire);
+  uint32_t space = static_cast<uint32_t>(ig->ring.size() - (head - tail));
+  uint32_t take = n < space ? n : space;
+  for (uint32_t i = 0; i < take; ++i) {
+    ig->ring[(head + i) & ig->ring_mask] = recs[i];
+  }
+  ig->head.store(head + take, std::memory_order_release);
+  if (take < n) ig->dropped.fetch_add(n - take, std::memory_order_relaxed);
+  return take;
+}
+
+uint64_t alz_dropped(void* p) {
+  Ingest* ig = static_cast<Ingest*>(p);
+  return ig->dropped.load(std::memory_order_relaxed) + ig->late_dropped;
+}
+
+// Consumer side: drain the ring into the current window's accumulator.
+// Returns the window id (start_ms / window_ms) that became ready to close,
+// or -2^62 if the current window is still open. Records belonging to a
+// newer window than the current roll the window forward; records older
+// than a closed window are dropped as late.
+int64_t alz_drain(void* p) {
+  Ingest* ig = static_cast<Ingest*>(p);
+  uint64_t tail = ig->tail.load(std::memory_order_relaxed);
+  uint64_t head = ig->head.load(std::memory_order_acquire);
+  int64_t ready = INT64_MIN;
+  while (tail < head) {
+    const AlzRecord& r = ig->ring[tail & ig->ring_mask];
+    int64_t w = r.start_time_ms / ig->window_ms;
+    if (w <= ig->closed_upto) {
+      ig->late_dropped += 1;
+    } else if (ig->current_window == INT64_MIN || w == ig->current_window) {
+      ig->current_window = w;
+      accumulate(ig, r);
+    } else if (w > ig->current_window) {
+      // window rolls: signal the old one ready and leave this record in
+      // the ring for the drain that follows the close
+      ready = ig->current_window;
+      ig->tail.store(tail, std::memory_order_release);
+      return ready;
+    } else {
+      // w < current_window but > closed_upto: stale but window still open
+      accumulate(ig, r);
+    }
+    ++tail;
+  }
+  ig->tail.store(tail, std::memory_order_release);
+  return ready;
+}
+
+int64_t alz_current_window(void* p) {
+  return static_cast<Ingest*>(p)->current_window;
+}
+
+uint32_t alz_node_count(void* p) {
+  return static_cast<uint32_t>(static_cast<Ingest*>(p)->node_uids.size());
+}
+
+// Close the current window: export aggregated edges into caller buffers
+// (each sized >= max_edges) and advance. Returns the edge count, or -1 if
+// buffers are too small. Node tables persist across windows; fetch them
+// with alz_export_nodes.
+int32_t alz_close_window(void* p, uint32_t buf_cap, int64_t* window_start_ms,
+                         int32_t* src, int32_t* dst, uint8_t* protocol,
+                         uint64_t* count, uint64_t* lat_sum, uint64_t* lat_max,
+                         uint32_t* err5, uint32_t* err4, uint32_t* tls_cnt) {
+  Ingest* ig = static_cast<Ingest*>(p);
+  const auto& order = ig->edges.order();
+  if (order.size() > buf_cap) return -1;
+  *window_start_ms = ig->current_window * ig->window_ms;
+  int32_t n = 0;
+  for (const EdgeSlot* e : order) {
+    src[n] = e->src_slot;
+    dst[n] = e->dst_slot;
+    protocol[n] = e->protocol;
+    count[n] = e->count;
+    lat_sum[n] = e->lat_sum;
+    lat_max[n] = e->lat_max;
+    err5[n] = e->err5;
+    err4[n] = e->err4;
+    tls_cnt[n] = e->tls_cnt;
+    ++n;
+  }
+  ig->edges.clear();
+  if (ig->current_window != INT64_MIN) ig->closed_upto = ig->current_window;
+  ig->current_window = INT64_MIN;
+  return n;
+}
+
+uint32_t alz_export_nodes(void* p, uint32_t buf_cap, int32_t* uids, uint8_t* types) {
+  Ingest* ig = static_cast<Ingest*>(p);
+  uint32_t n = static_cast<uint32_t>(ig->node_uids.size());
+  if (n > buf_cap) n = buf_cap;
+  std::memcpy(uids, ig->node_uids.data(), n * sizeof(int32_t));
+  std::memcpy(types, ig->node_types.data(), n * sizeof(uint8_t));
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,48 @@
+"""Named collective wrappers over mesh axes.
+
+The reference's "distributed communication backend" is an HTTP/JSON batch
+plane (SURVEY §2.2 G17); here it is XLA collectives over the device mesh —
+ICI within a slice, DCN across slices (§2.3 P7). These wrappers exist so
+call sites name their intent (and so the halo/expert layers read like the
+algorithms they implement); they are all trivially `jax.lax` under the
+hood and only valid inside ``shard_map``/collective contexts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_sum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    return jax.lax.psum(x, axis)
+
+
+def all_gather(x: jnp.ndarray, axis: str, *, tiled: bool = True) -> jnp.ndarray:
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter_sum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    return jax.lax.psum_scatter(x, axis, tiled=True)
+
+
+def ring_shift(x: jnp.ndarray, axis: str, shift: int = 1) -> jnp.ndarray:
+    """Rotate shards around the ring: device i's block goes to i+shift.
+    The halo-exchange primitive (ppermute rides ICI neighbor links)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm=perm)
+
+
+def all_to_all(x: jnp.ndarray, axis: str, split_axis: int, concat_axis: int) -> jnp.ndarray:
+    """Ulysses-style resharding between node-sharded and feature-sharded
+    layouts (SURVEY §2.3 P6)."""
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis: str) -> jnp.ndarray:
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)

@@ -1,0 +1,119 @@
+"""Ring halo exchange: message passing over node-sharded graphs (SP/CP).
+
+For graphs too big for one chip (BASELINE.json config 5: 100k-pod fleets),
+the node axis is sharded across the ``sp`` mesh axis. Local edges (grouped
+by destination shard) may have *remote* sources — the halo. Instead of
+gathering all remote rows (memory blow-up), node-feature shards rotate
+around the ring and each device folds in the messages whose source lives
+in the block it currently holds — the graph analog of ring attention:
+D steps, one neighbor ppermute per step, peak memory one block
+(SURVEY §2.3 P4; blockwise aggregation caps memory like blockwise
+attention).
+
+Layout contract (prepared by ``shard_graph``):
+- nodes are partitioned contiguously: shard d owns slots [d·n_loc, (d+1)·n_loc)
+- each shard holds the edges whose **dst** is local, dst-sorted, padded to
+  a common per-shard edge budget
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from alaz_tpu.parallel.collectives import ring_shift
+
+
+def ring_gather_scatter(
+    h_local: jnp.ndarray,  # [n_loc, F] this shard's node states
+    edge_src: jnp.ndarray,  # [e_loc] GLOBAL src ids of local-dst edges
+    edge_dst_local: jnp.ndarray,  # [e_loc] LOCAL dst ids (dst - my_offset)
+    edge_mask: jnp.ndarray,  # [e_loc]
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """out[d_local] = Σ_{e: dst=d} h[src[e]] with h sharded over ``axis``.
+
+    Must run inside shard_map over ``axis``. D ring steps; at step k this
+    device holds the block owned by (my_idx - k) mod D and processes the
+    edges whose src falls in it.
+    """
+    n_loc = h_local.shape[0]
+    d = jax.lax.axis_size(axis)
+    my_idx = jax.lax.axis_index(axis)
+
+    src_owner = edge_src // n_loc
+    src_local = edge_src % n_loc
+
+    def body(k, carry):
+        acc, blk = carry
+        owner = jax.lax.rem(my_idx - k + d, d)
+        sel = (src_owner == owner) & edge_mask
+        msgs = blk[src_local] * sel[:, None].astype(blk.dtype)
+        acc = acc + jax.ops.segment_sum(msgs, edge_dst_local, num_segments=n_loc)
+        blk = ring_shift(blk, axis, shift=1)
+        return acc, blk
+
+    acc0 = jnp.zeros_like(h_local)
+    acc, _ = jax.lax.fori_loop(0, d, body, (acc0, h_local))
+    return acc
+
+
+def shard_graph(
+    node_feats: np.ndarray,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    n_shards: int,
+):
+    """Partition a COO graph for the halo layer.
+
+    Returns per-shard stacked arrays (leading axis = shard):
+    ``h [D, n_loc, F]``, ``src [D, e_loc]`` (global ids), ``dst_local
+    [D, e_loc]``, ``mask [D, e_loc]``. Nodes must already be padded to a
+    multiple of ``n_shards``; per-shard edge budget is the max shard edge
+    count rounded up to 128."""
+    n = node_feats.shape[0]
+    assert n % n_shards == 0, "pad node count to a multiple of n_shards"
+    n_loc = n // n_shards
+
+    owner = edge_dst // n_loc
+    e_budget = 0
+    per_shard = []
+    for s in range(n_shards):
+        sel = owner == s
+        per_shard.append((edge_src[sel], edge_dst[sel] - s * n_loc))
+        e_budget = max(e_budget, int(sel.sum()))
+    e_budget = max(128, ((e_budget + 127) // 128) * 128)
+
+    h = node_feats.reshape(n_shards, n_loc, -1)
+    src = np.zeros((n_shards, e_budget), dtype=np.int32)
+    dst_local = np.full((n_shards, e_budget), n_loc - 1, dtype=np.int32)
+    mask = np.zeros((n_shards, e_budget), dtype=bool)
+    for s, (es, ed) in enumerate(per_shard):
+        order = np.argsort(ed, kind="stable")
+        k = es.shape[0]
+        src[s, :k] = es[order]
+        dst_local[s, :k] = ed[order]
+        mask[s, :k] = True
+    return h, src, dst_local, mask
+
+
+def make_halo_aggregate(mesh: Mesh, axis: str = "sp"):
+    """jit'd node-sharded aggregation: stacked shard arrays in, stacked
+    per-shard sums out. The shard axis maps onto the mesh's ``axis``."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    def run(h, src, dst_local, mask):
+        # shard_map passes blocks with the leading shard axis of size 1
+        out = ring_gather_scatter(h[0], src[0], dst_local[0], mask[0], axis=axis)
+        return out[None]
+
+    return jax.jit(run)

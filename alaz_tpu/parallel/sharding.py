@@ -33,11 +33,19 @@ from alaz_tpu.train.objective import edge_bce_loss
 # ---------------------------------------------------------------------------
 
 
-def param_pspec(params: Any, tp: int = 1) -> Any:
+def param_pspec(params: Any, tp: int = 1, ep: int = 1) -> Any:
     """TP rule: 2D weights shard the output dim over 'tp' when divisible
-    (heads ending in width-1 logits replicate); 1D params replicate."""
+    (heads ending in width-1 logits replicate); 1D params replicate.
+    EP rule: stacked expert tables (``expert_*`` [T, ...]) shard the
+    expert axis over 'ep'."""
 
     def rule(path: tuple, leaf) -> P:
+        key_names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        is_expert = any(str(k).startswith("expert_") for k in key_names)
+        if is_expert and ep > 1 and leaf.shape[0] % ep == 0:
+            if leaf.ndim == 3 and tp > 1 and leaf.shape[-1] % tp == 0:
+                return P("ep", None, "tp")
+            return P("ep", *([None] * (leaf.ndim - 1)))
         if leaf.ndim == 2 and tp > 1 and leaf.shape[-1] % tp == 0:
             # type_emb [T, H] and dense w [in, out]: shard last dim
             return P(None, "tp")
@@ -89,7 +97,7 @@ def make_sharded_train_step(
     """jit'd train step over a dp-sharded stack of graphs with tp-sharded
     params. Returns step(params, opt_state, stacked_graph, labels)."""
     _, apply = get_model(cfg.model)
-    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1))
+    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1), ep=mesh.shape.get("ep", 1))
     g_spec = graph_pspec(stacked=True)
 
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)
@@ -129,7 +137,7 @@ def make_sharded_train_step(
 def make_sharded_score_step(cfg: ModelConfig, mesh: Mesh, params_example: Any) -> Callable:
     """jit'd inference over a dp-sharded stack of graphs."""
     _, apply = get_model(cfg.model)
-    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1))
+    p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1), ep=mesh.shape.get("ep", 1))
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)
     graph_sh = {k: NamedSharding(mesh, s) for k, s in graph_pspec(True).items()}
 

@@ -1,0 +1,111 @@
+"""Per-stage counters and gauges.
+
+The reference logs channel lag every 2 minutes (data.go:177-186), keeps
+dropped-event counters (l7.go:681-687), and exports node metrics through
+an embedded Prometheus exporter (backend.go:1038-1105). This registry is
+the analog: counters/gauges with a Prometheus-text rendering and a
+snapshot dict for the health/metrics push path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_fn", "_value")
+
+    def __init__(self, name: str, fn: Callable[[], float] | None = None):
+        self.name = name
+        self._fn = fn
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return float("nan")
+        return self._value
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = Counter(name)
+                self._counters[name] = c
+            return c
+
+    def gauge(self, name: str, fn: Callable[[], float] | None = None) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = Gauge(name, fn)
+                self._gauges[name] = g
+            elif fn is not None:
+                g._fn = fn
+            return g
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {n: c.value for n, c in self._counters.items()}
+            out.update({n: g.value for n, g in self._gauges.items()})
+            out["uptime_s"] = time.time() - self.started_at
+            return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (the :8182/inner/metrics analog)."""
+        lines = []
+        for name, value in sorted(self.snapshot().items()):
+            metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
+
+def device_gauges(metrics: Metrics) -> None:
+    """Register accelerator gauges (the gpu/ NVML collector analog,
+    SURVEY §2.2 G22): per-device HBM usage from the JAX runtime."""
+    try:
+        import jax
+
+        for i, dev in enumerate(jax.local_devices()):
+            def mem_fn(d=dev):
+                stats = d.memory_stats() or {}
+                return stats.get("bytes_in_use", 0)
+
+            metrics.gauge(f"device{i}.hbm_bytes_in_use", mem_fn)
+        metrics.gauge("device.count", lambda: len(jax.local_devices()))
+    except Exception:  # no accelerator runtime present
+        pass

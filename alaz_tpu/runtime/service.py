@@ -1,0 +1,272 @@
+"""The streaming scoring service — the main.go:28-188 wiring analog.
+
+Pipeline (every arrow a bounded queue; drop-not-block at the source edge):
+
+    sources → [l7 | tcp | proc | k8s queues] → aggregator workers
+            → fanout datastore (graph store [+ export backend])
+            → window queue → scorer thread (jit'd GNN, one program per
+              shape bucket) → score sink (edge annotations back through
+              the dto path — the BASELINE.json return leg)
+
+Pause/resume hooks match the health checker's stop/resume protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.config import RuntimeConfig
+from alaz_tpu.datastore.interface import BaseDataStore, DataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.schema import L7Protocol
+from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.logging import get_logger
+from alaz_tpu.runtime.metrics import Metrics, device_gauges
+from alaz_tpu.utils.queues import BatchQueue
+
+log = get_logger("alaz_tpu.service")
+
+
+@dataclass
+class ScoreRecord:
+    """Anomaly score flowing back as an edge annotation (dto.go leg)."""
+
+    window_start_ms: int
+    from_uid: str
+    to_uid: str
+    protocol: str
+    score: float
+
+
+class FanoutDataStore(BaseDataStore):
+    """Tee persisted data to several sinks (graph store + export backend)."""
+
+    def __init__(self, sinks: List[DataStore]):
+        self.sinks = sinks
+
+    def persist_requests(self, batch: np.ndarray) -> None:
+        for s in self.sinks:
+            s.persist_requests(batch)
+
+    def persist_kafka_events(self, batch: np.ndarray) -> None:
+        for s in self.sinks:
+            s.persist_kafka_events(batch)
+
+    def persist_alive_connections(self, batch: np.ndarray) -> None:
+        for s in self.sinks:
+            s.persist_alive_connections(batch)
+
+    def persist_resource(self, rtype, event, obj) -> None:
+        for s in self.sinks:
+            s.persist_resource(rtype, event, obj)
+
+
+class Service:
+    def __init__(
+        self,
+        config: Optional[RuntimeConfig] = None,
+        interner: Optional[Interner] = None,
+        export_backend: Optional[DataStore] = None,
+        score_sink: Optional[Callable[[List[ScoreRecord]], None]] = None,
+        model_state: Any = None,  # params; None = scoring disabled
+        score_threshold: float = 0.0,  # only annotate edges scoring above
+    ):
+        self.score_threshold = score_threshold
+        self.config = config if config is not None else RuntimeConfig()
+        self.interner = interner if interner is not None else Interner()
+        self.metrics = Metrics()
+        device_gauges(self.metrics)
+
+        q = self.config.queues
+        self.l7_queue = BatchQueue(q.l7_events, "l7")
+        self.tcp_queue = BatchQueue(q.tcp_events, "tcp")
+        self.proc_queue = BatchQueue(q.proc_events, "proc")
+        self.k8s_queue = BatchQueue(q.kube_events, "k8s")
+        self.window_queue = BatchQueue(10_000_000, "windows")
+
+        self.graph_store = WindowedGraphStore(
+            self.interner,
+            window_s=self.config.window_s,
+            on_batch=self._enqueue_window,
+        )
+        sinks: List[DataStore] = [self.graph_store]
+        if export_backend is not None:
+            sinks.append(export_backend)
+        self.datastore = FanoutDataStore(sinks)
+        self.aggregator = Aggregator(self.datastore, interner=self.interner, config=self.config)
+
+        self.score_sink = score_sink
+        self.model_state = model_state
+        self._score_fn = None
+        if model_state is not None:
+            from alaz_tpu.train.trainstep import make_score_fn
+
+            self._score_fn = make_score_fn(self.config.model)
+
+        self.scored_batches = 0
+        self.scored_edges = 0
+        self._paused = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        self.metrics.gauge("l7.pending", lambda: self.l7_queue.pending_events)
+        self.metrics.gauge("l7.dropped", lambda: self.l7_queue.dropped)
+        self.metrics.gauge("tcp.pending", lambda: self.tcp_queue.pending_events)
+        self.metrics.gauge("windows.pending", lambda: len(self.window_queue))
+        self.metrics.gauge("windows.late_dropped", lambda: self.graph_store.late_dropped)
+
+    # -- ingestion surface (what sources call) ------------------------------
+
+    def submit_l7(self, batch: np.ndarray) -> bool:
+        if self._paused.is_set():
+            return False
+        ok = self.l7_queue.put_nowait_drop(batch)
+        self.metrics.counter("l7.in").inc(batch.shape[0])
+        return ok
+
+    def submit_tcp(self, batch: np.ndarray) -> bool:
+        if self._paused.is_set():
+            return False
+        return self.tcp_queue.put_nowait_drop(batch)
+
+    def submit_proc(self, batch: np.ndarray) -> bool:
+        if self._paused.is_set():
+            return False
+        return self.proc_queue.put_nowait_drop(batch)
+
+    def submit_k8s(self, msg) -> bool:
+        if self._paused.is_set():
+            return False
+        return self.k8s_queue.put_nowait_drop([msg])
+
+    # -- workers -------------------------------------------------------------
+
+    def _enqueue_window(self, batch: GraphBatch) -> None:
+        self.window_queue.put_nowait_drop([batch])
+        self.metrics.counter("windows.closed").inc()
+
+    def _l7_worker(self) -> None:
+        while not self._stop.is_set():
+            batch = self.l7_queue.get(timeout=0.1)
+            if batch is None:
+                continue
+            out = self.aggregator.process_l7(batch)
+            self.metrics.counter("edges.out").inc(int(out.shape[0]))
+
+    def _tcp_worker(self) -> None:
+        while not self._stop.is_set():
+            batch = self.tcp_queue.get(timeout=0.1)
+            if batch is not None:
+                self.aggregator.process_tcp(batch)
+
+    def _proc_worker(self) -> None:
+        while not self._stop.is_set():
+            batch = self.proc_queue.get(timeout=0.1)
+            if batch is not None:
+                self.aggregator.process_proc(batch)
+
+    def _k8s_worker(self) -> None:
+        while not self._stop.is_set():
+            msgs = self.k8s_queue.get(timeout=0.1)
+            if msgs is not None:
+                for m in msgs:
+                    self.aggregator.process_k8s(m)
+
+    def _scorer_worker(self) -> None:
+        import jax.numpy as jnp
+
+        from alaz_tpu.models.registry import get_model  # noqa: F401 (jit cache warm)
+
+        while not self._stop.is_set():
+            item = self.window_queue.get(timeout=0.1)
+            if item is None:
+                continue
+            (batch,) = item
+            if self._score_fn is None or self.model_state is None:
+                continue
+            graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
+            out = self._score_fn(self.model_state, graph)
+            logits = np.asarray(out["edge_logits"])
+            self.scored_batches += 1
+            self.scored_edges += batch.n_edges
+            self.metrics.counter("scored.edges").inc(batch.n_edges)
+            if self.score_sink is not None:
+                self.score_sink(self._annotate(batch, logits))
+
+    def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> List[ScoreRecord]:
+        """Vectorized edge annotation: interner lookups happen once per
+        distinct node, protocol names come from a table, and (optionally)
+        only edges above ``score_threshold`` materialize as records."""
+        n = batch.n_edges
+        scores = 1.0 / (1.0 + np.exp(-logits[:n]))
+        keep = np.flatnonzero(scores >= self.score_threshold)
+        if keep.shape[0] == 0:
+            return []
+        uids = batch.node_uids
+        node_ids = np.unique(
+            np.concatenate([batch.edge_src[keep], batch.edge_dst[keep]])
+        )
+        uid_str = {int(i): self.interner.lookup(int(uids[i])) for i in node_ids}
+        proto_names = [L7Protocol(p).wire_name() for p in range(9)]
+        w = batch.window_start_ms
+        return [
+            ScoreRecord(
+                window_start_ms=w,
+                from_uid=uid_str[int(batch.edge_src[i])],
+                to_uid=uid_str[int(batch.edge_dst[i])],
+                protocol=proto_names[int(batch.edge_type[i])],
+                score=float(scores[i]),
+            )
+            for i in keep
+        ]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        workers = [
+            ("alaz-l7", self._l7_worker),
+            ("alaz-tcp", self._tcp_worker),
+            ("alaz-proc", self._proc_worker),
+            ("alaz-k8s", self._k8s_worker),
+            ("alaz-scorer", self._scorer_worker),
+        ]
+        for name, fn in workers:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.info("service started")
+
+    def pause(self) -> None:
+        """Backend-commanded stop (the payment-required protocol)."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Wait for queues to empty (test/shutdown helper)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        queues = (self.l7_queue, self.tcp_queue, self.proc_queue, self.k8s_queue)
+        while time.monotonic() < deadline:
+            if all(len(q) == 0 for q in queues) and len(self.window_queue) == 0:
+                return
+            time.sleep(0.02)
+
+    def flush_windows(self) -> None:
+        self.graph_store.flush()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+        log.info(f"service stopped; metrics={self.metrics.snapshot()}")

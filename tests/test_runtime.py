@@ -1,0 +1,163 @@
+"""Runtime service, health stop/resume protocol, metrics, checkpointing."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from alaz_tpu.config import ModelConfig, QueueConfig, RuntimeConfig, SimulationConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.replay.simulator import Simulator
+from alaz_tpu.runtime.health import HealthChecker, HealthState
+from alaz_tpu.runtime.metrics import Metrics
+from alaz_tpu.runtime.service import Service
+
+
+class TestMetrics:
+    def test_counters_gauges_snapshot(self):
+        m = Metrics()
+        m.counter("a").inc(3)
+        m.counter("a").inc()
+        m.gauge("b").set(2.5)
+        m.gauge("c", lambda: 7.0)
+        snap = m.snapshot()
+        assert snap["a"] == 4 and snap["b"] == 2.5 and snap["c"] == 7.0
+        text = m.render_prometheus()
+        assert "alaz_tpu_a 4" in text
+
+
+class TestHealth:
+    def test_stop_resume_protocol(self):
+        state = {"stops": 0, "resumes": 0, "status": 200}
+
+        def transport(ep, payload):
+            assert ep == "/healthcheck/"
+            return state["status"]
+
+        hc = HealthChecker(
+            transport,
+            on_stop=lambda: state.__setitem__("stops", state["stops"] + 1),
+            on_resume=lambda: state.__setitem__("resumes", state["resumes"] + 1),
+        )
+        assert hc.check_once() == HealthState.RUNNING
+        state["status"] = 402  # payment required → stop
+        assert hc.check_once() == HealthState.STOPPED
+        assert state["stops"] == 1
+        state["status"] = 200  # backend back → resume
+        assert hc.check_once() == HealthState.RUNNING
+        assert state["resumes"] == 1
+
+    def test_transport_errors_tolerated(self):
+        def transport(ep, payload):
+            raise ConnectionError("down")
+
+        hc = HealthChecker(transport)
+        assert hc.check_once() == HealthState.RUNNING
+        assert hc.failures == 1
+
+
+class TestService:
+    def _run_service(self, score=True):
+        interner = Interner()
+        cfg = RuntimeConfig(model=ModelConfig(model="graphsage", hidden_dim=32, use_pallas=False))
+        params = None
+        if score:
+            init, _ = get_model("graphsage")
+            params = init(jax.random.PRNGKey(0), cfg.model)
+        scores = []
+        svc = Service(
+            config=cfg,
+            interner=interner,
+            score_sink=scores.extend if score else None,
+            model_state=params,
+        )
+        sim = Simulator(
+            SimulationConfig(test_duration_s=3.0, pod_count=30, service_count=10, edge_count=15, edge_rate=200),
+            interner=interner,
+        )
+        svc.start()
+        try:
+            for m in sim.setup():
+                svc.submit_k8s(m)
+            svc.submit_tcp(sim.tcp_events())
+            time.sleep(0.1)
+            for batch in sim.iter_l7_batches():
+                svc.submit_l7(batch)
+            svc.drain(timeout_s=15)
+            svc.flush_windows()
+            svc.drain(timeout_s=15)
+        finally:
+            svc.stop()
+        return svc, scores
+
+    def test_end_to_end_scoring(self):
+        svc, scores = self._run_service(score=True)
+        assert svc.graph_store.request_count > 0
+        assert svc.scored_batches >= 3  # 3s of 1s windows
+        assert len(scores) > 0
+        r = scores[0]
+        assert r.from_uid.startswith("pod-uid-")
+        assert r.to_uid.startswith("svc-uid-")
+        assert 0.0 <= r.score <= 1.0
+        assert r.protocol == "HTTP"
+
+    def test_pause_drops_ingest(self):
+        interner = Interner()
+        svc = Service(interner=interner)
+        svc.pause()
+        from alaz_tpu.events.schema import make_l7_events
+
+        assert not svc.submit_l7(make_l7_events(5))
+        svc.resume()
+        assert svc.submit_l7(make_l7_events(5))
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        from alaz_tpu.train import checkpoint
+
+        cfg = ModelConfig(model="graphsage", hidden_dim=32)
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg)
+        memory = np.ones((64, 32), np.float32)
+        checkpoint.save(tmp_path / "ckpt", step=7, params=params, memory=memory)
+        step, state = checkpoint.restore(tmp_path / "ckpt")
+        assert step == 7
+        np.testing.assert_array_equal(state["memory"], memory)
+        orig = jax.tree.leaves(params)
+        rest = jax.tree.leaves(state["params"])
+        for a, b in zip(orig, rest):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_restore_missing_raises(self, tmp_path):
+        from alaz_tpu.train import checkpoint
+
+        with pytest.raises(FileNotFoundError):
+            checkpoint.restore(tmp_path / "nope")
+
+    def test_latest_step_tracks_saves(self, tmp_path):
+        from alaz_tpu.train import checkpoint
+
+        cfg = ModelConfig(model="graphsage", hidden_dim=32)
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg)
+        checkpoint.save(tmp_path / "c", step=1, params=params)
+        checkpoint.save(tmp_path / "c", step=2, params=params)
+        assert checkpoint.latest_step(tmp_path / "c") == 2
+
+
+class TestPauseGatesEverything:
+    def test_all_submit_paths_respect_pause(self):
+        from alaz_tpu.events.k8s import EventType, K8sResourceMessage, Pod, ResourceType
+        from alaz_tpu.events.schema import make_l7_events, make_proc_events, make_tcp_events
+
+        svc = Service(interner=Interner())
+        svc.pause()
+        assert not svc.submit_l7(make_l7_events(1))
+        assert not svc.submit_tcp(make_tcp_events(1))
+        assert not svc.submit_proc(make_proc_events(1))
+        assert not svc.submit_k8s(
+            K8sResourceMessage(ResourceType.POD, EventType.ADD, Pod(uid="x"))
+        )
