@@ -24,7 +24,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 128  # destination rows per grid step (= MXU width)
-TILE_E = 128  # edges per inner chunk
+TILE_E = 128  # edges per inner chunk (multiple of 128)
+_DST_ROWS = TILE_E // 128  # dst ids ship as [E/128, 128] rows
 
 
 def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_scratch, sems):
@@ -36,24 +37,30 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
 
     out_ref[:] = jnp.zeros_like(out_ref)
 
-    def make_dma(slot, c):
-        m = pltpu.make_async_copy(
-            msgs_hbm.at[pl.ds(c * TILE_E, TILE_E), :],
-            msg_scratch.at[slot],
-            sems.at[slot, 0],
-        )
-        d = pltpu.make_async_copy(
-            dst_hbm.at[pl.ds(c, 1), :],
-            dst_scratch.at[slot],
-            sems.at[slot, 1],
-        )
-        return m, d
+    def make_dmas(slot, c):
+        dmas = [
+            pltpu.make_async_copy(
+                msgs_hbm.at[pl.ds(c * TILE_E, TILE_E), :],
+                msg_scratch.at[slot],
+                sems.at[slot, 0],
+            )
+        ]
+        # int32 HBM slices tile at (8,128): a [k,128] slice with k<8 only
+        # lowers when k==1, so dst ids move as _DST_ROWS separate row DMAs
+        for r in range(_DST_ROWS):
+            dmas.append(
+                pltpu.make_async_copy(
+                    dst_hbm.at[pl.ds(c * _DST_ROWS + r, 1), :],
+                    dst_scratch.at[slot, pl.ds(r, 1)],
+                    sems.at[slot, 1 + r],
+                )
+            )
+        return dmas
 
     @pl.when(c1 > c0)
     def _():
-        m0, d0 = make_dma(0, c0)
-        m0.start()
-        d0.start()
+        for dma in make_dmas(0, c0):
+            dma.start()
 
         def body(c, _):
             slot = jax.lax.rem(c - c0, 2)
@@ -61,27 +68,31 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
 
             @pl.when(c + 1 < c1)
             def _():
-                mn, dn = make_dma(next_slot, c + 1)
-                mn.start()
-                dn.start()
+                for dma in make_dmas(next_slot, c + 1):
+                    dma.start()
 
-            mc, dc = make_dma(slot, c)
-            mc.wait()
-            dc.wait()
+            for dma in make_dmas(slot, c):
+                dma.wait()
 
             # edges whose dst falls outside this block one-hot to zero rows,
-            # so chunk overlap with neighboring blocks needs no masking
-            dst_local = dst_scratch[slot, 0, :].reshape(TILE_E, 1) - i * TILE_N
-            onehot = (
-                dst_local == jax.lax.broadcasted_iota(jnp.int32, (TILE_E, TILE_N), 1)
-            ).astype(jnp.float32)
-            out_ref[:] += jax.lax.dot_general(
-                onehot,
-                msg_scratch[slot],
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+            # so chunk overlap with neighboring blocks needs no masking.
+            # One 128-edge sub-row at a time (Mosaic can't reshape the
+            # (r,128) id block to (TILE_E,1) in one go).
+            acc = jnp.zeros_like(out_ref)
+            for r in range(_DST_ROWS):
+                dst_local = dst_scratch[slot, r, :].reshape(128, 1) - i * TILE_N
+                onehot = (
+                    dst_local
+                    == jax.lax.broadcasted_iota(jnp.int32, (128, TILE_N), 1)
+                ).astype(jnp.float32)
+                acc = acc + jax.lax.dot_general(
+                    onehot,
+                    msg_scratch[slot, r * 128 : (r + 1) * 128, :],
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+            out_ref[:] += acc
             return 0
 
         jax.lax.fori_loop(c0, c1, body, 0)
@@ -89,13 +100,19 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
 
 def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, interpret: bool = False) -> jnp.ndarray:
     e, f = msgs.shape
-    assert e % TILE_E == 0 and num_nodes % TILE_N == 0, (
-        f"pad edges/nodes to {TILE_E}/{TILE_N} multiples (GraphBatch buckets do)"
+    assert e % 128 == 0 and num_nodes % TILE_N == 0, (
+        f"pad edges/nodes to 128/{TILE_N} multiples (GraphBatch buckets do)"
     )
     n_blocks = num_nodes // TILE_N
     boundaries = jnp.arange(0, num_nodes + 1, TILE_N, dtype=jnp.int32)
     row_start = jnp.searchsorted(edge_dst, boundaries).astype(jnp.int32)
-    dst2d = edge_dst.reshape(e // TILE_E, TILE_E).astype(jnp.int32)
+    if e % TILE_E != 0:
+        # bucket sizes are 128-multiples; round the edge axis up to TILE_E
+        pad = TILE_E - e % TILE_E
+        msgs = jnp.pad(msgs, ((0, pad), (0, 0)))
+        edge_dst = jnp.pad(edge_dst, (0, pad), constant_values=num_nodes - 1)
+        e = e + pad
+    dst2d = edge_dst.reshape(e // 128, 128).astype(jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -109,8 +126,8 @@ def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, in
         ),
         scratch_shapes=[
             pltpu.VMEM((2, TILE_E, f), jnp.float32),
-            pltpu.VMEM((2, 1, TILE_E), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, _DST_ROWS, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 1 + _DST_ROWS)),
         ],
     )
     return pl.pallas_call(

@@ -93,3 +93,19 @@ class TestPallasScatter:
         out = gather_scatter_sum(coo["x"], coo["src"], coo["dst"], coo["n"])
         ref = segment_sum(coo["x"][coo["src"]], coo["dst"], coo["n"])
         np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestLargeTileEdgePadding:
+    def test_pad_path_with_tile_e_512(self, coo, monkeypatch):
+        """The edge-padding branch only activates when TILE_E > 128; pin it
+        at 512 (interpret mode) so that path keeps coverage."""
+        import alaz_tpu.ops.pallas_segment as ps
+
+        monkeypatch.setattr(ps, "TILE_E", 512)
+        monkeypatch.setattr(ps, "_DST_ROWS", 4)
+        msgs = coo["x"][coo["src"]]  # E=512 edges... use uneven edge count
+        msgs = msgs[:384]  # 384 % 512 != 0 → pad branch
+        dst = coo["dst"][:384]
+        out = ps._scatter_sorted(jnp.asarray(msgs, jnp.float32), dst, coo["n"], interpret=True)
+        ref = segment_sum(msgs, dst, coo["n"])
+        np.testing.assert_allclose(out, ref, atol=1e-4)

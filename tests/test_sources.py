@@ -1,0 +1,284 @@
+"""Sources plane: replay pacing, k8s fan-out, container index, TLS attach,
+log streaming, dist tracing."""
+
+import time
+
+import numpy as np
+
+from alaz_tpu.aggregator.dist_tracing import DistTracingCorrelator
+from alaz_tpu.config import SimulationConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import EventType, K8sResourceMessage, Pod, ResourceType
+from alaz_tpu.events.schema import make_l7_events
+from alaz_tpu.sources.containers import ContainerIndex, ContainerInfo, cgroup_pids
+from alaz_tpu.sources.k8s_watch import K8sWatchSource, fan_out_containers
+from alaz_tpu.sources.logstream import Connection, ConnectionPool, LogStreamer
+from alaz_tpu.sources.replay import ReplaySource
+from alaz_tpu.sources.tlsattach import TlsAttachTracker, find_ssl_lib, ssl_version_family
+
+
+class FakeService:
+    def __init__(self):
+        self.l7, self.tcp, self.proc, self.k8s = [], [], [], []
+
+    def submit_l7(self, b):
+        self.l7.append(b)
+        return True
+
+    def submit_tcp(self, b):
+        self.tcp.append(b)
+        return True
+
+    def submit_proc(self, b):
+        self.proc.append(b)
+        return True
+
+    def submit_k8s(self, m):
+        self.k8s.append(m)
+        return True
+
+
+class TestReplaySource:
+    def test_flat_out_replay(self):
+        svc = FakeService()
+        src = ReplaySource(
+            SimulationConfig(test_duration_s=0.5, pod_count=10, service_count=5, edge_count=5, edge_rate=100),
+            Interner(),
+        )
+        src.start(svc)
+        src.join(10)
+        assert src.emitted == 5 * 100 * 0.5
+        assert len(svc.tcp) == 1 and len(svc.k8s) == 15
+
+
+class TestK8sSource:
+    def test_fan_out_containers(self):
+        msg = K8sResourceMessage(
+            ResourceType.POD, EventType.ADD, Pod(uid="u", name="p", image="nginx:1")
+        )
+        out = fan_out_containers(msg)
+        assert len(out) == 2
+        assert out[1].resource_type == ResourceType.CONTAINER
+        assert out[1].object.pod_uid == "u"
+
+    def test_namespace_exclusion(self):
+        svc = FakeService()
+        src = K8sWatchSource(exclude_namespaces={"kube-system"})
+        src._service = svc
+        src.inject(
+            K8sResourceMessage(
+                ResourceType.POD, EventType.ADD, Pod(uid="a", namespace="kube-system")
+            )
+        )
+        assert svc.k8s == []
+        src.inject(
+            K8sResourceMessage(ResourceType.POD, EventType.ADD, Pod(uid="b", namespace="app"))
+        )
+        assert len(svc.k8s) == 1
+
+
+class TestContainerIndex:
+    def test_sync_diff_emits_proc_events(self):
+        svc = FakeService()
+        idx = ContainerIndex(sync_interval_s=999)
+        idx._service = svc
+        idx.register(ContainerInfo("c1", pids={100, 101}))
+        added, removed = idx.sync_once()
+        assert added == {100, 101} and removed == set()
+        ev = svc.proc[0]
+        assert set(ev["pid"]) == {100, 101}
+        assert (ev["type"] == 1).all()  # EXEC
+        # container goes away → EXIT events
+        idx.remove("c1")
+        added, removed = idx.sync_once()
+        assert removed == {100, 101}
+        assert (svc.proc[1]["type"] == 2).all()
+
+    def test_namespace_filter(self):
+        idx = ContainerIndex()
+        idx.register(ContainerInfo("sys", namespace="kube-system", pids={1}))
+        assert idx.get_pids_running_on_containers() == set()
+
+    def test_cgroup_pids_parsing(self, tmp_path):
+        f = tmp_path / "cgroup.procs"
+        f.write_text("100\n200\n\n300\n")
+        assert cgroup_pids(f) == {100, 200, 300}
+        assert cgroup_pids(tmp_path / "missing") == set()
+
+
+class TestTlsAttach:
+    MAPS = """7f1c2000-7f1c3000 r-xp 00000000 08:01 123 /usr/lib/x86_64-linux-gnu/libssl.so.1.1
+7f1c4000-7f1c5000 r-xp 00000000 08:01 124 /usr/lib/libcrypto.so.1.1
+"""
+
+    def test_find_ssl_lib_versions(self):
+        lib = find_ssl_lib(self.MAPS)
+        assert lib["path"].endswith("libssl.so.1.1") and lib["version"] == "1.1"
+        assert ssl_version_family("1.1.1") == "v1.1.1"
+        assert ssl_version_family("3.0.2") == "v3"
+        assert ssl_version_family("1.0.2") == "v1.0.2"
+        # deleted-but-mapped edge case (ssllib.go)
+        deleted = "7f-80 r-xp 0 0 1 /usr/lib/libssl.so.3 (deleted)\n"
+        lib2 = find_ssl_lib(deleted)
+        assert lib2["deleted"] and lib2["version"] == "3"
+
+    def test_attach_dedup_per_pid(self, tmp_path):
+        (tmp_path / "55").mkdir()
+        (tmp_path / "55" / "maps").write_text(self.MAPS)
+        attached = []
+        tr = TlsAttachTracker(on_attach=lambda pid, info: attached.append((pid, info)), proc_root=tmp_path)
+        assert tr.signal(55)
+        assert not tr.signal(55)  # dedup (tlsPidMap)
+        assert len(attached) == 1
+        assert attached[0][1]["family"] == "v1.1.1"
+        tr.detach(55)
+        assert tr.signal(55)
+
+
+class RecordingConn(Connection):
+    def __init__(self, log):
+        self.log = log
+        self.dead = False
+
+    def send(self, data):
+        self.log.append(data)
+
+    def alive(self):
+        return not self.dead
+
+
+class TestLogStreamer:
+    def test_tail_and_ship(self, tmp_path):
+        sent = []
+        pool = ConnectionPool(lambda: RecordingConn(sent))
+        ls = LogStreamer(pool)
+        f = tmp_path / "c1.log"
+        f.write_text("old line\n")  # preexisting content is skipped
+        ls.watch("c1", f, metadata={"pod": "p1"})
+        assert ls.pump_once() == 0
+        with open(f, "a") as fh:
+            fh.write("new line\n")
+        n = ls.pump_once()
+        assert n == len("new line\n")
+        assert sent[0].startswith(b"**AlazLogs_c1_p1\n")
+        assert sent[0].endswith(b"new line\n")
+
+    def test_rotation_restarts(self, tmp_path):
+        sent = []
+        pool = ConnectionPool(lambda: RecordingConn(sent))
+        ls = LogStreamer(pool)
+        f = tmp_path / "c.log"
+        f.write_text("aaaa")
+        ls.watch("c", f)
+        f.write_text("b")  # rotated: smaller than last pos
+        ls.pump_once()
+        assert sent and sent[-1].endswith(b"b")
+
+    def test_pool_discards_dead_conns(self):
+        sent = []
+        pool = ConnectionPool(lambda: RecordingConn(sent))
+        c1 = pool.get()
+        pool.put(c1)
+        c1.dead = True
+        c2 = pool.get()  # dead conn discarded, new one created
+        assert c2 is not c1
+        assert pool.discarded == 1
+
+
+class TestDistTracing:
+    def test_thread_propagation_links(self):
+        ev = make_l7_events(3)
+        ev["pid"] = 10
+        ev["tid"] = 7
+        ev["seq"] = [100, 200, 300]
+        ev["write_time_ns"] = [1000, 2000, 3000]
+        # ingress, then two egress calls on the same thread
+        is_ingress = np.array([True, False, False])
+        c = DistTracingCorrelator()
+        links = c.observe(ev, is_ingress)
+        assert len(links) == 2
+        assert all(l.ingress_seq == 100 for l in links)
+        assert [l.egress_seq for l in links] == [200, 300]
+        assert len(c.export_rows()) == 2
+
+    def test_window_expiry_and_unmatched(self):
+        c = DistTracingCorrelator(window_ns=500)
+        ev = make_l7_events(2)
+        ev["pid"], ev["tid"] = 1, 1
+        ev["seq"] = [1, 2]
+        ev["write_time_ns"] = [0, 10_000]  # egress far outside window
+        links = c.observe(ev, np.array([True, False]))
+        assert links == []
+        assert c.dropped_unmatched == 1
+
+    def test_different_threads_do_not_link(self):
+        c = DistTracingCorrelator()
+        ev = make_l7_events(2)
+        ev["pid"] = 1
+        ev["tid"] = [1, 2]
+        ev["seq"] = [5, 6]
+        ev["write_time_ns"] = [100, 200]
+        links = c.observe(ev, np.array([True, False]))
+        assert links == [] and c.dropped_unmatched == 1
+
+
+class FailingConn(Connection):
+    def __init__(self, fail_times):
+        self.fail_times = fail_times
+        self.sent = []
+
+    def send(self, data):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("broken pipe")
+        self.sent.append(data)
+
+
+class TestCodeReviewRegressions:
+    def test_log_send_failure_retries_bytes(self, tmp_path):
+        """A failed send must not advance the tail position nor re-pool the
+        broken connection — bytes re-ship on the next pump."""
+        conn = FailingConn(fail_times=1)
+        pool = ConnectionPool(lambda: conn)
+        ls = LogStreamer(pool)
+        f = tmp_path / "c.log"
+        f.write_text("")
+        ls.watch("c", f)
+        f.write_text("important\n")
+        assert ls.pump_once() == 0  # send failed, nothing counted
+        assert ls.pump_once() == len("important\n")  # retried and delivered
+        assert conn.sent[0].endswith(b"important\n")
+
+    def test_tls_failed_discovery_retries(self, tmp_path):
+        """No libssl yet → not cached; a later signal after dlopen attaches."""
+        attached = []
+        tr = TlsAttachTracker(on_attach=lambda p, i: attached.append(p), proc_root=tmp_path)
+        (tmp_path / "77").mkdir()
+        (tmp_path / "77" / "maps").write_text("7f-80 r-xp 0 0 1 /usr/lib/libc.so\n")
+        assert not tr.signal(77)  # no libssl mapped
+        (tmp_path / "77" / "maps").write_text(TestTlsAttach.MAPS)  # dlopen'd
+        assert tr.signal(77)
+        assert attached == [77]
+
+    def test_container_index_syncs_immediately_on_start(self):
+        svc = FakeService()
+        idx = ContainerIndex(sync_interval_s=30.0)
+        idx.register(ContainerInfo("c1", pids={42}))
+        idx.start(svc)
+        time.sleep(0.3)  # far less than the 30s tick
+        idx.stop()
+        assert svc.proc and 42 in set(svc.proc[0]["pid"])
+
+    def test_dist_tracing_bounded_and_draining(self):
+        from alaz_tpu.aggregator.dist_tracing import DistTracingCorrelator
+
+        c = DistTracingCorrelator(max_links=10)
+        for k in range(30):
+            ev = make_l7_events(2)
+            ev["pid"], ev["tid"] = 1, k
+            ev["seq"] = [k * 2, k * 2 + 1]
+            ev["write_time_ns"] = [k * 100, k * 100 + 50]
+            c.observe(ev, np.array([True, False]))
+        assert len(c.links) == 10  # bounded
+        rows = c.export_rows()
+        assert len(rows) == 10 and len(c.links) == 0  # drained
