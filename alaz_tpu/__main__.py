@@ -1,0 +1,167 @@
+"""CLI — the main.go analog, env-driven with subcommands.
+
+  python -m alaz_tpu serve   [--config testconfig/config1.json] [--ckpt DIR]
+  python -m alaz_tpu replay  [--config ...]        # data-plane acceptance
+  python -m alaz_tpu train   [--config ...] [--model graphsage] [--ckpt DIR]
+  python -m alaz_tpu bench                          # headline JSON line
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _sim_config(path: str | None):
+    from alaz_tpu.config import SimulationConfig
+
+    if path:
+        return SimulationConfig.from_json(path)
+    return SimulationConfig(test_duration_s=10.0, pod_count=100, service_count=50, edge_count=40, edge_rate=1000)
+
+
+def cmd_replay(args) -> int:
+    from alaz_tpu.replay.simulator import run_replay
+
+    res = run_replay(_sim_config(args.config))
+    print(
+        json.dumps(
+            {
+                "generated": res.generated,
+                "persisted": res.persisted,
+                "processed_ratio": round(res.processed_ratio, 4),
+                "events_per_s": round(res.events_per_s),
+                "passed": res.passed,
+            }
+        )
+    )
+    return 0 if res.passed else 1
+
+
+def cmd_train(args) -> int:
+    import numpy as np
+
+    from alaz_tpu.config import ModelConfig
+    from alaz_tpu.replay.scenario import run_anomaly_scenario
+    from alaz_tpu.train import checkpoint
+    from alaz_tpu.train.metrics import auroc
+    from alaz_tpu.train.trainstep import make_score_fn, score_batch, train_on_batches
+
+    sim_cfg = _sim_config(args.config)
+    cfg = ModelConfig(model=args.model)
+    data = run_anomaly_scenario(sim_cfg, n_windows=args.windows, fault_fraction=0.15, seed=args.seed)
+    state, losses = train_on_batches(cfg, data.train, epochs=args.epochs)
+    fn = make_score_fn(cfg)
+    scores, labels, masks = [], [], []
+    for b in data.eval:
+        out = score_batch(cfg, state.params, b, fn)
+        scores.append(out["edge_logits"])
+        labels.append(b.edge_label)
+        masks.append(b.edge_mask)
+    a = auroc(np.concatenate(scores), np.concatenate(labels), np.concatenate(masks))
+    if args.ckpt:
+        checkpoint.save(args.ckpt, step=state.step, params=state.params)
+    print(json.dumps({"model": args.model, "auroc": round(float(a), 4), "loss_final": round(losses[-1], 4), "steps": state.step}))
+    return 0 if a >= 0.9 else 1
+
+
+def cmd_serve(args) -> int:
+    from alaz_tpu.config import RuntimeConfig
+    from alaz_tpu.events.intern import Interner
+    from alaz_tpu.runtime.debug_http import DebugServer
+    from alaz_tpu.runtime.health import HealthChecker
+    from alaz_tpu.runtime.service import Service
+    from alaz_tpu.sources.replay import ReplaySource
+
+    cfg = RuntimeConfig.from_env()
+    interner = Interner()
+    params = None
+    if args.ckpt:
+        from alaz_tpu.train import checkpoint
+
+        _, state = checkpoint.restore(args.ckpt)
+        params = state["params"]
+
+    svc = Service(config=cfg, interner=interner, model_state=params)
+    svc.start()
+    debug = DebugServer(svc, port=args.debug_port)
+    debug.start()
+    hc = None
+    if cfg.backend.host:
+        from alaz_tpu.datastore.backend import http_transport
+
+        hc = HealthChecker(
+            http_transport(cfg.backend.host),
+            on_stop=svc.pause,
+            on_resume=svc.resume,
+            metrics_snapshot=svc.metrics.snapshot,
+        )
+        hc.start()
+    src = None
+    if args.config:
+        src = ReplaySource(_sim_config(args.config), interner, realtime=not args.flat_out)
+        src.start(svc)
+    print(f"serving; debug http on :{debug.port}", file=sys.stderr)
+    try:
+        if src is not None:
+            src.join()
+            svc.drain(30)
+            svc.flush_windows()
+            svc.drain(30)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if src:
+            src.stop()
+        if hc:
+            hc.stop()
+        debug.stop()
+        svc.stop()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="alaz_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("replay", help="data-plane acceptance replay")
+    pr.add_argument("--config", default=None)
+    pr.set_defaults(fn=cmd_replay)
+
+    pt = sub.add_parser("train", help="train + AUROC-gate an anomaly scorer")
+    pt.add_argument("--config", default=None)
+    pt.add_argument("--model", default="graphsage")
+    pt.add_argument("--epochs", type=int, default=20)
+    pt.add_argument("--windows", type=int, default=10)
+    pt.add_argument("--seed", type=int, default=0)
+    pt.add_argument("--ckpt", default=None)
+    pt.set_defaults(fn=cmd_train)
+
+    ps = sub.add_parser("serve", help="run the streaming scoring service")
+    ps.add_argument("--config", default=None, help="replay traffic config (omit for external ingest)")
+    ps.add_argument("--ckpt", default=None)
+    ps.add_argument("--debug-port", type=int, default=8181)
+    ps.add_argument("--flat-out", action="store_true")
+    ps.set_defaults(fn=cmd_serve)
+
+    pb = sub.add_parser("bench", help="headline benchmark")
+    pb.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

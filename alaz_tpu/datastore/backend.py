@@ -54,6 +54,31 @@ Transport = Callable[[str, dict], int]
 """(endpoint, json-able payload) -> HTTP-like status code."""
 
 
+def http_transport(host: str, timeout_s: float = 10.0) -> Transport:
+    """Real HTTP POST transport over urllib (the retryablehttp client's
+    wire role, backend.go:210-278; retries/backoff live in
+    BatchingBackend)."""
+    import urllib.error
+    import urllib.request
+
+    base = host.rstrip("/")
+
+    def send(endpoint: str, payload: dict) -> int:
+        req = urllib.request.Request(
+            base + endpoint,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST" if endpoint != EP_HEALTHCHECK else "PUT",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    return send
+
+
 @dataclass
 class _Stream:
     name: str

@@ -13,6 +13,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from pathlib import Path
 from typing import Optional
 
@@ -91,6 +92,59 @@ def available() -> bool:
 _INT64_MIN = -(2**63)
 
 
+class NativeWindowedStore:
+    """DataStore adapter over NativeIngest — drop-in for
+    WindowedGraphStore when the C++ core is available: persist_requests
+    pushes into the ring and polls closed windows to ``on_batch``."""
+
+    def __init__(self, window_s: float = 1.0, on_batch=None, **kwargs):
+        self.ingest = NativeIngest(window_s=window_s, **kwargs)
+        self.on_batch = on_batch
+        self.batches: list[GraphBatch] = []
+        self.request_count = 0
+        # the C++ side is single-consumer (alz_drain/alz_close_window share
+        # ring tail + export buffers); serialize like WindowedGraphStore does
+        self._lock = threading.Lock()
+
+    @property
+    def late_dropped(self) -> int:
+        return self.ingest.dropped
+
+    def persist_requests(self, batch: np.ndarray) -> None:
+        with self._lock:
+            self.request_count += batch.shape[0]
+            self.ingest.push(batch)
+            while True:
+                out = self.ingest.poll()
+                if out is None:
+                    break
+                self._emit(out)
+
+    def persist_kafka_events(self, batch: np.ndarray) -> None:
+        pass
+
+    def persist_alive_connections(self, batch: np.ndarray) -> None:
+        pass
+
+    def persist_resource(self, rtype, event, obj) -> None:
+        pass
+
+    def flush(self) -> None:
+        with self._lock:
+            for out in self.ingest.flush():
+                self._emit(out)
+
+    def _emit(self, batch: GraphBatch) -> None:
+        if self.on_batch is not None:
+            self.on_batch(batch)
+        else:
+            self.batches.append(batch)
+
+    def close(self) -> None:
+        with self._lock:
+            self.ingest.close()
+
+
 class NativeIngest:
     """Windowed edge aggregation backed by the C++ core.
 
@@ -140,6 +194,8 @@ class NativeIngest:
 
     @property
     def dropped(self) -> int:
+        if not self._h:
+            return 0  # closed: metrics gauges may still poll
         return int(self._lib.alz_dropped(self._h))
 
     @staticmethod
@@ -161,6 +217,8 @@ class NativeIngest:
 
     def push(self, rows: np.ndarray) -> int:
         """Push REQUEST_DTYPE rows; returns accepted count."""
+        if not self._h:
+            return 0
         recs = self.to_records(np.ascontiguousarray(rows))
         return int(
             self._lib.alz_push(
@@ -170,6 +228,8 @@ class NativeIngest:
 
     def poll(self) -> Optional[GraphBatch]:
         """Drain the ring; if a window closed, build and return its batch."""
+        if not self._h:
+            return None
         ready = int(self._lib.alz_drain(self._h))
         if ready == _INT64_MIN:
             return None
@@ -179,6 +239,8 @@ class NativeIngest:
         """Drain everything and close every window (intermediate windows
         closed during the drain are returned too, oldest first)."""
         out: list[GraphBatch] = []
+        if not self._h:
+            return out
         while True:
             ready = int(self._lib.alz_drain(self._h))
             if ready == _INT64_MIN:

@@ -1,0 +1,119 @@
+"""Debug/observability HTTP server — the pprof-on-:8181 +
+node-exporter-on-:8182 analog (main.go:25,160; backend.go:1038-1105).
+
+Endpoints:
+- ``/metrics``          Prometheus text (service counters/gauges + devices)
+- ``/healthz``          liveness
+- ``/stats``            JSON snapshot (queue lag, aggregator stats)
+- ``/stack``            all-thread stack dump (goroutine-profile analog)
+- ``/profiler/start``   begin a JAX profiler trace (``/profiler/stop`` ends;
+                        trace dir served back in the response)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from alaz_tpu.logging import get_logger
+
+log = get_logger("alaz_tpu.debug")
+
+
+class DebugServer:
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 8181):
+        self.service = service
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._trace_dir: Optional[str] = None
+
+    def start(self) -> int:
+        svc = self.service
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/metrics":
+                    self._send(200, svc.metrics.render_prometheus())
+                elif self.path == "/stats":
+                    stats = {
+                        "queues": {
+                            q.name: q.stats()
+                            for q in (svc.l7_queue, svc.tcp_queue, svc.proc_queue, svc.k8s_queue)
+                        },
+                        "aggregator": svc.aggregator.stats.as_dict(),
+                        "scored_batches": svc.scored_batches,
+                        "scored_edges": svc.scored_edges,
+                    }
+                    self._send(200, json.dumps(stats, indent=2), "application/json")
+                elif self.path == "/stack":
+                    buf = io.StringIO()
+                    frames = getattr(threading, "_current_frames", lambda: {})()
+                    import sys
+
+                    for tid, frame in sys._current_frames().items():
+                        buf.write(f"--- thread {tid} ---\n")
+                        traceback.print_stack(frame, file=buf)
+                    self._send(200, buf.getvalue())
+                elif self.path == "/profiler/start":
+                    self._send(200, outer._profiler_start())
+                elif self.path == "/profiler/stop":
+                    self._send(200, outer._profiler_stop())
+                else:
+                    self._send(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port  # resolves port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever, name="alaz-debug-http", daemon=True)
+        self._thread.start()
+        log.info(f"debug http on {self.host}:{self.port}")
+        return self.port
+
+    def _profiler_start(self) -> str:
+        import tempfile
+
+        import jax
+
+        if self._trace_dir is not None:
+            return f"already tracing to {self._trace_dir}"
+        self._trace_dir = tempfile.mkdtemp(prefix="alaz-jax-trace-")
+        jax.profiler.start_trace(self._trace_dir)
+        return f"tracing to {self._trace_dir}"
+
+    def _profiler_stop(self) -> str:
+        import jax
+
+        if self._trace_dir is None:
+            return "not tracing"
+        jax.profiler.stop_trace()
+        out = self._trace_dir
+        self._trace_dir = None
+        return f"trace written to {out}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

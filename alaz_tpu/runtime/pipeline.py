@@ -1,0 +1,43 @@
+"""Host→device pipeline: double-buffered window feeding (SURVEY §2.3 P3).
+
+The reference pipelines stages with channels; on TPU the analog is
+overlapping the host→device transfer of window N+1 with the scoring of
+window N. ``DevicePrefetcher`` wraps an iterator of GraphBatches: it
+issues ``jax.device_put`` for the next batch while the caller computes on
+the current one (JAX transfers are async, so the overlap costs one
+in-flight buffer of HBM).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+from alaz_tpu.graph.snapshot import GraphBatch
+
+
+class DevicePrefetcher:
+    def __init__(self, batches: Iterable[GraphBatch], device=None):
+        self._it = iter(batches)
+        self._device = device
+        self._staged: Optional[tuple[GraphBatch, dict]] = None
+
+    def _stage(self) -> Optional[tuple[GraphBatch, dict]]:
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            return None
+        arrays = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
+        if self._device is not None:
+            arrays = jax.device_put(arrays, self._device)
+        return batch, arrays
+
+    def __iter__(self) -> Iterator[tuple[GraphBatch, dict]]:
+        self._staged = self._stage()
+        while self._staged is not None:
+            current = self._staged
+            # start the next transfer before yielding (compute overlaps it)
+            self._staged = self._stage()
+            yield current

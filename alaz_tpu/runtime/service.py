@@ -76,8 +76,10 @@ class Service:
         score_sink: Optional[Callable[[List[ScoreRecord]], None]] = None,
         model_state: Any = None,  # params; None = scoring disabled
         score_threshold: float = 0.0,  # only annotate edges scoring above
+        use_native_ingest: bool = False,  # C++ window accumulator when built
     ):
         self.score_threshold = score_threshold
+        self.use_native_ingest = use_native_ingest
         self.config = config if config is not None else RuntimeConfig()
         self.interner = interner if interner is not None else Interner()
         self.metrics = Metrics()
@@ -90,11 +92,22 @@ class Service:
         self.k8s_queue = BatchQueue(q.kube_events, "k8s")
         self.window_queue = BatchQueue(10_000_000, "windows")
 
-        self.graph_store = WindowedGraphStore(
-            self.interner,
-            window_s=self.config.window_s,
-            on_batch=self._enqueue_window,
-        )
+        self.graph_store = None
+        if use_native_ingest:
+            from alaz_tpu.graph import native as native_mod
+
+            if native_mod.available():
+                self.graph_store = native_mod.NativeWindowedStore(
+                    window_s=self.config.window_s, on_batch=self._enqueue_window
+                )
+            else:
+                log.warning("native ingest requested but library unavailable; using numpy store")
+        if self.graph_store is None:
+            self.graph_store = WindowedGraphStore(
+                self.interner,
+                window_s=self.config.window_s,
+                on_batch=self._enqueue_window,
+            )
         sinks: List[DataStore] = [self.graph_store]
         if export_backend is not None:
             sinks.append(export_backend)

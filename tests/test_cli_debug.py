@@ -1,0 +1,98 @@
+"""CLI entry points, debug HTTP server, prefetcher, multislice mesh."""
+
+import json
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from __graft_entry__ import _example_batch
+from alaz_tpu.config import MeshConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.parallel.multislice import make_hybrid_mesh, slice_count
+from alaz_tpu.runtime.debug_http import DebugServer
+from alaz_tpu.runtime.pipeline import DevicePrefetcher
+from alaz_tpu.runtime.service import Service
+
+
+class TestCli:
+    def test_replay_subcommand(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "alaz_tpu", "replay", "--config", "testconfig/config1.json"],
+            capture_output=True, text=True, timeout=300,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["passed"] and res["processed_ratio"] >= 0.9
+        assert res["events_per_s"] >= 200_000
+
+    def test_train_subcommand(self):
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "alaz_tpu", "train",
+                "--model", "graphsage", "--epochs", "15", "--windows", "6",
+            ],
+            capture_output=True, text=True, timeout=600,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu", "ALAZ_TPU_USE_PALLAS": "0"},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["auroc"] >= 0.9
+
+
+class TestDebugServer:
+    def test_endpoints(self):
+        svc = Service(interner=Interner())
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                    return r.status, r.read().decode()
+
+            assert get("/healthz") == (200, "ok")
+            code, metrics = get("/metrics")
+            assert code == 200 and "alaz_tpu_" in metrics
+            code, stats = get("/stats")
+            assert code == 200
+            parsed = json.loads(stats)
+            assert "queues" in parsed and "aggregator" in parsed
+            code, stack = get("/stack")
+            assert code == 200 and "thread" in stack
+            with pytest.raises(urllib.error.HTTPError):
+                get("/nope")
+        finally:
+            server.stop()
+
+
+class TestPrefetcher:
+    def test_yields_all_batches_with_device_arrays(self):
+        batches = [_example_batch(n_pods=20, n_svcs=5, n_edges=50, seed=s) for s in range(3)]
+        seen = []
+        for batch, arrays in DevicePrefetcher(batches):
+            assert set(arrays) == set(batch.device_arrays())
+            seen.append(batch)
+        assert seen == batches
+
+    def test_empty_iterator(self):
+        assert list(DevicePrefetcher([])) == []
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+class TestMultislice:
+    def test_hybrid_mesh_dp_outermost(self):
+        mesh = make_hybrid_mesh(MeshConfig(dp=4, tp=2, ep=1, sp=1))
+        assert mesh.axis_names == ("dp", "tp", "ep", "sp")
+        assert mesh.shape["dp"] == 4
+        # dp-major ordering: first dp row holds the first 2 devices
+        arr = np.asarray(mesh.devices).reshape(4, 2)
+        flat = [d.id for d in arr.ravel()]
+        assert flat == sorted(flat)
+
+    def test_slice_count_single(self):
+        assert slice_count() == 1

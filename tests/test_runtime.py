@@ -161,3 +161,39 @@ class TestPauseGatesEverything:
         assert not svc.submit_k8s(
             K8sResourceMessage(ResourceType.POD, EventType.ADD, Pod(uid="x"))
         )
+
+
+class TestNativeServicePath:
+    def test_service_with_native_ingest(self):
+        from alaz_tpu.graph import native as native_mod
+
+        if not native_mod.available():
+            pytest.skip("native lib not built")
+        interner = Interner()
+        svc = Service(interner=interner, use_native_ingest=True)
+        assert type(svc.graph_store).__name__ == "NativeWindowedStore"
+        sim = Simulator(
+            SimulationConfig(test_duration_s=2.0, pod_count=20, service_count=8, edge_count=10, edge_rate=200),
+            interner=interner,
+        )
+        svc.start()
+        try:
+            for m in sim.setup():
+                svc.submit_k8s(m)
+            svc.submit_tcp(sim.tcp_events())
+            time.sleep(0.1)
+            for batch in sim.iter_l7_batches():
+                svc.submit_l7(batch)
+            svc.drain(15)
+            svc.flush_windows()
+            svc.drain(15)
+        finally:
+            svc.stop()
+        # threaded path: rows that raced their TCP state may still sit in
+        # the aggregator retry queue, so assert the acceptance bar, not
+        # exact equality (same reason the numpy-path test is loose)
+        assert svc.graph_store.request_count >= 0.9 * sim.expected_events
+        assert svc.metrics.snapshot()["windows.closed"] >= 2
+        # dropped gauge survives store closure (NULL-handle guard)
+        svc.graph_store.close()
+        assert svc.graph_store.late_dropped == 0
