@@ -29,6 +29,7 @@ from typing import Optional
 import numpy as np
 
 from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.dns import ReverseDnsCache
 from alaz_tpu.aggregator.h2 import Http2Assembler
 from alaz_tpu.aggregator.sockline import SockInfo, SocketLineStore
 from alaz_tpu.config import RuntimeConfig
@@ -119,6 +120,7 @@ class Aggregator:
         self._retries: deque[tuple[np.ndarray, int, int]] = deque()
         # payload-hash → interned path id, per protocol (cross-batch cache)
         self._path_cache: dict[int, dict[int, int]] = {}
+        self.reverse_dns = ReverseDnsCache()
 
     # ------------------------------------------------------------------
     # TCP events
@@ -312,12 +314,15 @@ class Aggregator:
         out["status_code"] = events["status"]
         out["method"] = events["method"]
 
-        # outbound destinations fall back to the IP string as UID
-        # (setFromToV2 reverse-DNS fallback; DNS itself is gated off here)
+        # outbound destinations: reverse-DNS name when the gated cache has
+        # one, else the IP string (setFromToV2 fallback chain,
+        # data.go:852-866)
         outbound = to_type == np.uint8(EP_OUTBOUND)
         if outbound.any():
             for i in np.flatnonzero(outbound):
-                out["to_uid"][i] = self.interner.intern(u32_to_ip(int(daddr[i])))
+                out["to_uid"][i] = self.interner.intern(
+                    self.reverse_dns.name_for(int(daddr[i]))
+                )
 
         # per-protocol payload enrichment
         self._enrich_paths(events, out)
@@ -545,3 +550,4 @@ class Aggregator:
         (the 10-worker sockline GC loop, data.go:1688; reaper 551-571)."""
         self.socket_lines.gc()
         self.h2.reap(now_ns if now_ns is not None else time.time_ns())
+        self.reverse_dns.purge()  # the 10-minute purge sweep analog

@@ -169,6 +169,7 @@ class ModelConfig:
     dropout: float = 0.1
     dtype: str = "bfloat16"
     use_pallas: bool = True
+    remat: bool = False  # jax.checkpoint each GNN layer (FLOPs for memory)
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -177,6 +178,7 @@ class ModelConfig:
             hidden_dim=env_int("HIDDEN_DIM", 128),
             num_layers=env_int("NUM_LAYERS", 2),
             use_pallas=env_bool("USE_PALLAS", True),
+            remat=env_bool("REMAT", False),
         )
 
 

@@ -146,7 +146,13 @@ def iter_request_views(rows: np.ndarray, interner: Interner) -> Iterator[Request
             to_type=_EP_NAMES[r["to_type"]],
             to_uid=interner.lookup(int(r["to_uid"])),
             to_port=int(r["to_port"]),
-            protocol=L7Protocol(r["protocol"]).wire_name(),
+            # TLS'd HTTP renders as HTTPS at the export boundary
+            # (processHttpEvent, data.go:1240-1242)
+            protocol=(
+                "HTTPS"
+                if r["tls"] and r["protocol"] == L7Protocol.HTTP
+                else L7Protocol(r["protocol"]).wire_name()
+            ),
             tls=bool(r["tls"]),
             completed=bool(r["completed"]),
             status_code=int(r["status_code"]),

@@ -73,7 +73,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     e_type_emb = params["type_emb"].astype(dtype)[graph["edge_type"]]
     ef = graph["edge_feats"].astype(dtype)
 
-    for layer in params["layers"]:
+    def layer_fn(layer, h):
         q = dense(layer["q"], h).reshape(n, nh, hd)
         kv = dense(layer["kv"], h).reshape(n, nh, hd)
         e_feat = (dense(layer["edge_proj"], ef) + e_type_emb).reshape(-1, nh, hd)
@@ -91,7 +91,12 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         msgs = ((kv[src] + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
         agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas)
         h_new = dense(layer["out"], agg.astype(dtype))
-        h = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+        return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        h = layer_fn(layer, h)
 
     edge_logits = edge_head(params["edge_head"], h, graph, dtype)
     node_logits = mlp(params["node_head"], h)[:, 0]

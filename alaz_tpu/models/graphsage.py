@@ -75,7 +75,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     e_type_emb = params["type_emb"].astype(dtype)[graph["edge_type"]]
     ef = graph["edge_feats"].astype(dtype)
 
-    for layer in params["layers"]:
+    def layer_fn(layer, h):
         msgs = (
             dense(layer["msg"], h[graph["edge_src"]])
             + dense(layer["edge_proj"], ef)
@@ -87,7 +87,14 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
         h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
         h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
-        h = (h + h_new) * node_mask[:, None]
+        return (h + h_new) * node_mask[:, None]
+
+    if cfg.remat:
+        # rematerialize per layer: trade recompute for activation memory
+        # (the jax.checkpoint lever for deep GNNs / big buckets)
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        h = layer_fn(layer, h)
 
     edge_logits = edge_head(params["edge_head"], h, graph, dtype)
     node_logits = mlp(params["node_head"], h)[:, 0]

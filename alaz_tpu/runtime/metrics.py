@@ -94,6 +94,43 @@ class Metrics:
         return "\n".join(lines) + "\n"
 
 
+def host_gauges(metrics: Metrics) -> None:
+    """Node metrics (the embedded node_exporter scrape analog,
+    backend.go:1038-1105): process RSS, host memory, load average from
+    /proc — pushed with the health payload like the reference pushes its
+    scrape."""
+
+    def rss_bytes() -> float:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return float(line.split()[1]) * 1024
+        except OSError:
+            pass
+        return 0.0
+
+    def meminfo(field: str) -> float:
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith(field + ":"):
+                        return float(line.split()[1]) * 1024
+        except OSError:
+            pass
+        return 0.0
+
+    def load1() -> float:
+        try:
+            return float(open("/proc/loadavg").read().split()[0])
+        except OSError:
+            return 0.0
+
+    metrics.gauge("host.process_rss_bytes", rss_bytes)
+    metrics.gauge("host.mem_available_bytes", lambda: meminfo("MemAvailable"))
+    metrics.gauge("host.load1", load1)
+
+
 def device_gauges(metrics: Metrics) -> None:
     """Register accelerator gauges (the gpu/ NVML collector analog,
     SURVEY §2.2 G22): per-device HBM usage from the JAX runtime."""

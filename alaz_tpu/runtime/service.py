@@ -27,7 +27,7 @@ from alaz_tpu.events.schema import L7Protocol
 from alaz_tpu.graph.builder import WindowedGraphStore
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
-from alaz_tpu.runtime.metrics import Metrics, device_gauges
+from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges
 from alaz_tpu.utils.queues import BatchQueue
 
 log = get_logger("alaz_tpu.service")
@@ -84,6 +84,7 @@ class Service:
         self.interner = interner if interner is not None else Interner()
         self.metrics = Metrics()
         device_gauges(self.metrics)
+        host_gauges(self.metrics)
 
         q = self.config.queues
         self.l7_queue = BatchQueue(q.l7_events, "l7")

@@ -196,7 +196,7 @@ class TestL7Join:
         from alaz_tpu.datastore.dto import iter_request_views
 
         views = list(iter_request_views(rows, interner))
-        assert views[0].protocol == "HTTP"  # enum name; HTTPS at payload layer
+        assert views[0].protocol == "HTTPS"
 
 
 class TestH2:
@@ -379,3 +379,43 @@ class TestCodeReviewRegressions:
         c.handle_msg(K8sResourceMessage(ResourceType.POD, EventType.DELETE, Pod(uid="pod-ep")))
         t, _ = c.attribute(np.array([ip_to_u32("10.0.9.9")], dtype=np.uint32))
         assert t[0] != EP_POD
+
+
+class TestReverseDns:
+    def test_cache_and_fallback(self):
+        from alaz_tpu.aggregator.dns import ReverseDnsCache
+
+        c = ReverseDnsCache(do_lookups=False)
+        ip = ip_to_u32("93.184.216.34")
+        assert c.name_for(ip) == "93.184.216.34"  # fallback, no lookup
+        c.put(ip, "example.com")
+        assert c.name_for(ip) == "example.com"
+        # expiry
+        c2 = ReverseDnsCache(ttl_s=0.0, do_lookups=False)
+        c2.put(ip, "stale.example", now_s=0.0)
+        assert c2.name_for(ip) == "93.184.216.34"
+        assert c2.purge() == 1
+
+    def test_outbound_uses_cache(self):
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.reverse_dns.put(ip_to_u32("93.184.216.34"), "api.example.com")
+        _establish(agg, daddr="93.184.216.34")
+        agg.process_l7(_http_events(1), now_ns=10_000)
+        rows = ds.all_requests()
+        assert interner.lookup(int(rows["to_uid"][0])) == "api.example.com"
+
+
+class TestHttpsRendering:
+    def test_tls_http_renders_https(self):
+        from alaz_tpu.datastore.dto import iter_request_views, make_requests
+        from alaz_tpu.events.schema import L7Protocol
+
+        interner = Interner()
+        rows = make_requests(2)
+        rows["protocol"] = L7Protocol.HTTP
+        rows["tls"] = [True, False]
+        views = list(iter_request_views(rows, interner))
+        assert views[0].protocol == "HTTPS" and views[1].protocol == "HTTP"

@@ -74,3 +74,72 @@ def test_determinism_same_seed():
     b = run_replay(cfg)
     assert a.generated == b.generated
     assert a.persisted == b.persisted
+
+
+def test_full_export_pipeline_wire_shape():
+    """Trace → aggregator → BatchingBackend: the complete reference pipeline
+    (simulated kernel events to backend wire rows with metadata)."""
+    from alaz_tpu.aggregator import Aggregator
+    from alaz_tpu.config import BackendConfig
+    from alaz_tpu.datastore.backend import BatchingBackend
+    from alaz_tpu.events.intern import Interner
+
+    interner = Interner()
+    calls = []
+    clock = {"t": 0.0}
+    be = BatchingBackend(
+        lambda ep, payload: (calls.append((ep, payload)), 200)[1],
+        interner,
+        BackendConfig(batch_size=1000, monitoring_id="m1", node_id="n1"),
+        time_fn=lambda: clock["t"],
+        sleep_fn=lambda s: None,
+    )
+    agg = Aggregator(be, interner=interner)
+    sim = Simulator(
+        SimulationConfig(test_duration_s=0.5, pod_count=10, service_count=5, edge_count=5, edge_rate=200),
+        interner=interner,
+    )
+    for m in sim.setup():
+        agg.process_k8s(m)
+    agg.process_tcp(sim.tcp_events())
+    for batch in sim.iter_l7_batches():
+        agg.process_l7(batch, now_ns=int(batch["write_time_ns"][-1]))
+    be.pump(force=True)
+
+    req_calls = [c for c in calls if c[0] == "/requests/"]
+    pod_calls = [c for c in calls if c[0] == "/pod/"]
+    svc_calls = [c for c in calls if c[0] == "/svc/"]
+    assert sum(len(c[1]["data"]) for c in req_calls) == sim.expected_events
+    assert sum(len(c[1]["data"]) for c in pod_calls) == 10
+    assert sum(len(c[1]["data"]) for c in svc_calls) == 5
+    md = req_calls[0][1]["metadata"]
+    assert md["monitoring_id"] == "m1" and md["node_id"] == "n1" and md["idempotency_key"]
+    row = req_calls[0][1]["data"][0]
+    assert len(row) == 16 and row[3] == "pod" and row[7] == "service"
+    assert row[10] == "HTTP" and row[13] == "GET" and row[14] == "/user"
+
+
+def test_trace_file_replay_through_aggregator(tmp_path):
+    """Recorded NPZ trace replays through the engine byte-identically."""
+    from alaz_tpu.aggregator import Aggregator
+    from alaz_tpu.datastore.inmem import InMemDataStore
+    from alaz_tpu.events.intern import Interner
+
+    interner = Interner()
+    cfg = SimulationConfig(test_duration_s=0.3, pod_count=8, service_count=3, edge_count=4, edge_rate=100)
+    sim = Simulator(cfg, interner=interner)
+    msgs = sim.setup()
+    tcp = sim.tcp_events()
+    path = tmp_path / "t.npz"
+    save_trace(path, msgs, tcp, sim.iter_l7_batches())
+
+    msgs2, tcp2, l7 = load_trace(path)
+    ds = InMemDataStore(retain=True)
+    agg = Aggregator(ds, interner=interner)
+    for m in msgs2:
+        agg.process_k8s(m)
+    agg.process_tcp(tcp2)
+    agg.process_l7(l7, now_ns=int(l7["write_time_ns"][-1]))
+    assert ds.request_count == sim.expected_events
+    rows = ds.all_requests()
+    assert (rows["from_type"] == 1).all()

@@ -91,3 +91,24 @@ class TestRegistry:
         assert get_model("gat") == (gat.init, gat.apply)
         with pytest.raises(ValueError):
             get_model("transformer")
+
+
+class TestRemat:
+    @pytest.mark.parametrize("name", ["graphsage", "gat"])
+    def test_remat_matches_plain_forward_and_grads(self, name, small_batch):
+        cfg = ModelConfig(model=name, hidden_dim=32, use_pallas=False)
+        cfg_r = ModelConfig(model=name, hidden_dim=32, use_pallas=False, remat=True)
+        init, apply = get_model(name)
+        params = init(jax.random.PRNGKey(0), cfg)
+        g = _graph(small_batch)
+        o1 = apply(params, g, cfg)["edge_logits"]
+        o2 = apply(params, g, cfg_r)["edge_logits"]
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+        def loss(p, c):
+            return jnp.sum(apply(p, g, c)["edge_logits"] ** 2)
+
+        g1 = jax.grad(lambda p: loss(p, cfg))(params)
+        g2 = jax.grad(lambda p: loss(p, cfg_r))(params)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
