@@ -84,7 +84,18 @@ def cmd_serve(args) -> int:
         _, state = checkpoint.restore(args.ckpt)
         params = state["params"]
 
-    svc = Service(config=cfg, interner=interner, model_state=params)
+    export_backend = None
+    if cfg.backend.host:
+        from alaz_tpu.datastore.backend import BatchingBackend, http_transport
+
+        export_backend = BatchingBackend(
+            http_transport(cfg.backend.host), interner, cfg.backend
+        )
+        export_backend.start()
+
+    svc = Service(
+        config=cfg, interner=interner, model_state=params, export_backend=export_backend
+    )
     svc.start()
     debug = DebugServer(svc, port=args.debug_port)
     debug.start()
@@ -122,6 +133,8 @@ def cmd_serve(args) -> int:
             hc.stop()
         debug.stop()
         svc.stop()
+        if export_backend is not None:
+            export_backend.stop()
     return 0
 
 

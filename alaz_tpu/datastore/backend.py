@@ -34,11 +34,12 @@ from alaz_tpu.logging import get_logger
 
 log = get_logger("alaz_tpu.datastore")
 
-# endpoint paths mirror backend.go:171-187
+# endpoint paths mirror backend.go:171-187 (+ the new anomaly-score leg)
 EP_REQUESTS = "/requests/"
 EP_CONNECTIONS = "/connections/"
 EP_KAFKA = "/events/kafka/"
 EP_HEALTHCHECK = "/healthcheck/"
+EP_ANOMALIES = "/anomalies/"
 _RESOURCE_EP = {
     ResourceType.POD: "/pod/",
     ResourceType.SERVICE: "/svc/",
@@ -114,6 +115,7 @@ class BatchingBackend(BaseDataStore):
             "requests": _Stream("requests", EP_REQUESTS, cfg.batch_size, cfg.req_flush_interval_s, last_flush=now),
             "connections": _Stream("connections", EP_CONNECTIONS, cfg.conn_batch_size, cfg.conn_flush_interval_s, last_flush=now),
             "kafka": _Stream("kafka", EP_KAFKA, cfg.kafka_batch_size, cfg.kafka_flush_interval_s, last_flush=now),
+            "anomalies": _Stream("anomalies", EP_ANOMALIES, cfg.batch_size, cfg.req_flush_interval_s, last_flush=now),
         }
         self._resource_streams: dict[ResourceType, _Stream] = {
             rt: _Stream(rt.value, ep, cfg.batch_size, cfg.resource_flush_interval_s, last_flush=now)
@@ -122,6 +124,7 @@ class BatchingBackend(BaseDataStore):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._warned_endpoints: set = set()
 
     # -- DataStore surface -------------------------------------------------
 
@@ -168,6 +171,16 @@ class BatchingBackend(BaseDataStore):
             for r in batch
         ]
         self._append("connections", rows)
+
+    def persist_scores(self, records) -> None:
+        """Anomaly-score edge annotations → /anomalies/ (the BASELINE.json
+        return leg: scores flow back through the dto path). Accepts
+        runtime.ScoreRecord instances (duck-typed)."""
+        rows = [
+            [r.window_start_ms, r.from_uid, r.to_uid, r.protocol, r.score]
+            for r in records
+        ]
+        self._append("anomalies", rows)
 
     def persist_resource(self, rtype: ResourceType, event: EventType, obj: Any) -> None:
         stream = self._resource_streams[rtype]
@@ -228,7 +241,14 @@ class BatchingBackend(BaseDataStore):
             if status < 400:
                 return True
             if status not in (400, 429) and status < 500:
-                return False  # non-retryable 4xx
+                # non-retryable 4xx: drop loudly (once per endpoint) so a
+                # backend without this endpoint doesn't silently eat data
+                if endpoint not in self._warned_endpoints:
+                    self._warned_endpoints.add(endpoint)
+                    log.warning(
+                        f"dropping batch for {endpoint}: non-retryable HTTP {status}"
+                    )
+                return False
             if attempt < self.cfg.max_retries:
                 self.sleep_fn(min(backoff + random.random() * 0.1, self.cfg.backoff_max_s))
                 backoff *= 2

@@ -116,6 +116,9 @@ class Service:
         self.aggregator = Aggregator(self.datastore, interner=self.interner, config=self.config)
 
         self.score_sink = score_sink
+        if self.score_sink is None and export_backend is not None and hasattr(export_backend, "persist_scores"):
+            # scores flow back to the backend's /anomalies/ stream by default
+            self.score_sink = export_backend.persist_scores
         self.model_state = model_state
         self._score_fn = None
         if model_state is not None:

@@ -163,3 +163,18 @@ def test_kafka_event_payload_arity():
     row = tr.calls[0][1]["data"][0]
     assert len(row) == 16  # KafkaEventInfo[16] (payload.go:163-180)
     assert row[10] == "orders" and row[14] == "PUBLISH"
+
+
+def test_non_retryable_4xx_warns_once():
+    # the alaz logger doesn't propagate (caplog can't see it); assert the
+    # once-per-endpoint dedup state that gates the warning instead
+    clock = FakeClock()
+    tr = RecordingTransport(statuses=[404, 404])
+    be = make_backend(tr, clock, batch_size=1, max_retries=0)
+    be.persist_requests(make_requests(1))
+    be.pump()
+    assert be._warned_endpoints == {"/requests/"}
+    be.persist_requests(make_requests(1))
+    be.pump()
+    assert be._warned_endpoints == {"/requests/"}  # still once per endpoint
+    assert be.stats()["requests"]["failed"] == 2

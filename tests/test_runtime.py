@@ -197,3 +197,48 @@ class TestNativeServicePath:
         # dropped gauge survives store closure (NULL-handle guard)
         svc.graph_store.close()
         assert svc.graph_store.late_dropped == 0
+
+
+class TestScoreExportLeg:
+    def test_scores_flow_to_anomalies_endpoint(self):
+        """The BASELINE return leg: scored edges export to /anomalies/."""
+        from alaz_tpu.config import BackendConfig, ModelConfig, RuntimeConfig
+        from alaz_tpu.datastore.backend import BatchingBackend
+
+        interner = Interner()
+        calls = []
+        be = BatchingBackend(
+            lambda ep, payload: (calls.append((ep, payload)), 200)[1],
+            interner,
+            BackendConfig(batch_size=100000),
+        )
+        cfg = RuntimeConfig(model=ModelConfig(model="graphsage", hidden_dim=32, use_pallas=False))
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg.model)
+        svc = Service(config=cfg, interner=interner, export_backend=be, model_state=params)
+        sim = Simulator(
+            SimulationConfig(test_duration_s=2.0, pod_count=10, service_count=4, edge_count=6, edge_rate=100),
+            interner=interner,
+        )
+        svc.start()
+        try:
+            for m in sim.setup():
+                svc.submit_k8s(m)
+            svc.submit_tcp(sim.tcp_events())
+            time.sleep(0.1)
+            for b in sim.iter_l7_batches():
+                svc.submit_l7(b)
+            svc.drain(15)
+            svc.flush_windows()
+            svc.drain(15)
+        finally:
+            svc.stop()
+        be.pump(force=True)
+        anomaly_calls = [c for c in calls if c[0] == "/anomalies/"]
+        assert anomaly_calls, [c[0] for c in calls]
+        row = anomaly_calls[0][1]["data"][0]
+        # [window_start_ms, from_uid, to_uid, protocol, score]
+        assert row[1].startswith("pod-uid-") and row[3] == "HTTP"
+        assert 0.0 <= row[4] <= 1.0
+        # requests were exported on the same backend too (fanout)
+        assert any(c[0] == "/requests/" for c in calls)
