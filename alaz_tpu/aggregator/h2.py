@@ -12,6 +12,7 @@ are reaped after one minute (data.go:551-571).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 from alaz_tpu.protocols import hpack, http2
@@ -60,8 +61,12 @@ class CompletedH2Request:
 
 
 class Http2Assembler:
+    """Thread-safe: feed() runs on the l7 worker while reap() runs on the
+    housekeeping ticker."""
+
     def __init__(self) -> None:
         self._conns: dict[tuple[int, int], _ConnState] = {}
+        self._lock = threading.Lock()
 
     def _conn(self, pid: int, fd: int) -> _ConnState:
         key = (pid, fd)
@@ -81,6 +86,10 @@ class Http2Assembler:
         tls: bool = False,
     ) -> list[CompletedH2Request]:
         """Feed one captured frame buffer; returns any completed requests."""
+        with self._lock:
+            return self._feed_locked(pid, fd, is_client, payload, write_time_ns, tls)
+
+    def _feed_locked(self, pid, fd, is_client, payload, write_time_ns, tls) -> list[CompletedH2Request]:
         conn = self._conn(pid, fd)
         done: list[CompletedH2Request] = []
         for frame in http2.iter_frames(payload):
@@ -149,6 +158,11 @@ class Http2Assembler:
 
     def reap(self, now_ns: int) -> int:
         """Drop half-arrived pairs older than a minute (data.go:551-571)."""
+        dropped = 0
+        with self._lock:
+            return self._reap_locked(now_ns)
+
+    def _reap_locked(self, now_ns: int) -> int:
         dropped = 0
         for conn in self._conns.values():
             doomed = [

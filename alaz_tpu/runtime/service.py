@@ -121,11 +121,37 @@ class Service:
             self.score_sink = export_backend.persist_scores
         self.model_state = model_state
         self._score_fn = None
+        self._tgn_memory = None  # temporal model node memory (tgn only)
         if model_state is not None:
-            from alaz_tpu.train.trainstep import make_score_fn
+            if self.config.model.model == "tgn":
+                import jax
 
-            self._score_fn = make_score_fn(self.config.model)
+                from alaz_tpu.models import tgn
 
+                self._tgn_memory = tgn.init_memory(self.config.model, max_nodes=128)
+                cfg = self.config.model
+                jitted_step = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
+
+                def tgn_score(params, graph):
+                    n_pad = graph["node_feats"].shape[0]
+                    if self._tgn_memory.shape[0] < n_pad:
+                        # grow outside jit so each bucket compiles once
+                        import jax.numpy as jnp
+
+                        self._tgn_memory = jnp.pad(
+                            self._tgn_memory,
+                            ((0, n_pad - self._tgn_memory.shape[0]), (0, 0)),
+                        )
+                    out, self._tgn_memory = jitted_step(params, graph, self._tgn_memory)
+                    return out
+
+                self._score_fn = tgn_score
+            else:
+                from alaz_tpu.train.trainstep import make_score_fn
+
+                self._score_fn = make_score_fn(self.config.model)
+
+        self.housekeeping_interval_s = 120.0  # reference ticker cadence
         self.scored_batches = 0
         self.scored_edges = 0
         self._paused = threading.Event()
@@ -195,6 +221,15 @@ class Service:
                 for m in msgs:
                     self.aggregator.process_k8s(m)
 
+    def _housekeeping_worker(self) -> None:
+        """Periodic gc: socket lines, h2 stream reaping, DNS purge — the
+        reference's 2-minute ticker loops (data.go:177-219,1688)."""
+        while not self._stop.wait(self.housekeeping_interval_s):
+            try:
+                self.aggregator.gc()
+            except Exception as exc:
+                log.warning(f"housekeeping failed: {exc}")
+
     def _scorer_worker(self) -> None:
         import jax.numpy as jnp
 
@@ -253,6 +288,7 @@ class Service:
             ("alaz-proc", self._proc_worker),
             ("alaz-k8s", self._k8s_worker),
             ("alaz-scorer", self._scorer_worker),
+            ("alaz-housekeeping", self._housekeeping_worker),
         ]
         for name, fn in workers:
             t = threading.Thread(target=fn, name=name, daemon=True)

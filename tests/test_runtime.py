@@ -242,3 +242,49 @@ class TestScoreExportLeg:
         assert 0.0 <= row[4] <= 1.0
         # requests were exported on the same backend too (fanout)
         assert any(c[0] == "/requests/" for c in calls)
+
+
+class TestTgnService:
+    def test_scorer_threads_temporal_memory(self):
+        from alaz_tpu.models import tgn
+
+        interner = Interner()
+        cfg = RuntimeConfig(model=ModelConfig(model="tgn", hidden_dim=32, use_pallas=False))
+        params = tgn.init(jax.random.PRNGKey(0), cfg.model)
+        scores = []
+        svc = Service(config=cfg, interner=interner, score_sink=scores.extend, model_state=params)
+        sim = Simulator(
+            SimulationConfig(test_duration_s=3.0, pod_count=15, service_count=5, edge_count=8, edge_rate=100),
+            interner=interner,
+        )
+        svc.start()
+        try:
+            for m in sim.setup():
+                svc.submit_k8s(m)
+            svc.submit_tcp(sim.tcp_events())
+            time.sleep(0.1)
+            for b in sim.iter_l7_batches():
+                svc.submit_l7(b)
+            svc.drain(15)
+            svc.flush_windows()
+            svc.drain(15)
+        finally:
+            svc.stop()
+        assert svc.scored_batches >= 2
+        # memory evolved across windows (grown to the bucket and non-zero)
+        mem = np.asarray(svc._tgn_memory)
+        assert mem.shape[0] >= 128 and np.abs(mem).sum() > 0
+        assert len(scores) > 0
+
+
+class TestHousekeeping:
+    def test_gc_ticker_runs(self):
+        svc = Service(interner=Interner())
+        svc.housekeeping_interval_s = 0.05
+        ran = {"n": 0}
+        orig = svc.aggregator.gc
+        svc.aggregator.gc = lambda *a, **k: (ran.__setitem__("n", ran["n"] + 1), orig())[1]
+        svc.start()
+        time.sleep(0.4)
+        svc.stop()
+        assert ran["n"] >= 2
