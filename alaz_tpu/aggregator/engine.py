@@ -62,6 +62,7 @@ from alaz_tpu.protocols import kafka as kafka_proto
 from alaz_tpu.protocols import mongo as mongo_proto
 from alaz_tpu.protocols import mysql as mysql_proto
 from alaz_tpu.protocols import postgres as postgres_proto
+from alaz_tpu.utils.ratelimit import TokenBucket
 
 log = get_logger("alaz_tpu.aggregator")
 
@@ -92,6 +93,7 @@ class AggregatorStats:
         self.k8s_in = 0
         self.edges_out = 0
         self.kafka_out = 0
+        self.l7_rate_limited = 0
 
     def as_dict(self) -> dict:
         return dict(self.__dict__)
@@ -121,6 +123,10 @@ class Aggregator:
         # payload-hash → interned path id, per protocol (cross-batch cache)
         self._path_cache: dict[int, dict[int, int]] = {}
         self.reverse_dns = ReverseDnsCache()
+        # per-pid rate limiting (100/s burst 1000, data.go:339-353) — the
+        # reference applies it on the trace path; gated off by default
+        self.rate_limit: tuple[float, float] | None = None
+        self._pid_buckets: dict[int, TokenBucket] = {}
 
     # ------------------------------------------------------------------
     # TCP events
@@ -188,6 +194,8 @@ class Aggregator:
             if r["type"] == ProcEventType.EXIT:
                 self.live_pids.discard(pid)
                 self.socket_lines.remove_pid(pid)
+                # a reused pid must start with a fresh burst allowance
+                self._pid_buckets.pop(pid, None)
             elif r["type"] == ProcEventType.EXEC:
                 self.live_pids.add(pid)
 
@@ -209,11 +217,36 @@ class Aggregator:
         REQUEST_DTYPE rows (also persisted to the datastore)."""
         now_ns = now_ns if now_ns is not None else time.time_ns()
         self.stats.l7_in += events.shape[0]
+        if self.rate_limit is not None and events.shape[0]:
+            events = self._apply_rate_limit(events, now_ns)
         emitted = self._process_l7_inner(events, attempts=0, now_ns=now_ns)
         retried = self.flush_retries(now_ns)
         if retried is not None and retried.shape[0]:
             emitted = np.concatenate([emitted, retried])
         return emitted
+
+    def _apply_rate_limit(self, events: np.ndarray, now_ns: int) -> np.ndarray:
+        """Per-pid token buckets, vectorized per pid group: each pid admits
+        up to its bucket's allowance per batch, excess drops (rate.Limiter
+        semantics, data.go:339-353)."""
+        rate, burst = self.rate_limit
+        now_s = now_ns / 1e9
+        keep = np.ones(events.shape[0], dtype=bool)
+        pids, inverse = np.unique(events["pid"], return_inverse=True)
+        for g, pid in enumerate(pids):
+            bucket = self._pid_buckets.get(int(pid))
+            if bucket is None:
+                bucket = TokenBucket(rate, burst, now_s=now_s)
+                self._pid_buckets[int(pid)] = bucket
+            idx = np.flatnonzero(inverse == g)
+            admitted = bucket.admit(idx.shape[0], now_s)
+            if admitted < idx.shape[0]:
+                keep[idx[admitted:]] = False
+        dropped = int((~keep).sum())
+        if dropped:
+            self.stats.l7_rate_limited += dropped
+            events = events[keep]
+        return events
 
     def flush_retries(self, now_ns: int) -> np.ndarray | None:
         """Re-run due retry entries (the signal-and-requeue path)."""

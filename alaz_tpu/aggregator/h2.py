@@ -158,7 +158,6 @@ class Http2Assembler:
 
     def reap(self, now_ns: int) -> int:
         """Drop half-arrived pairs older than a minute (data.go:551-571)."""
-        dropped = 0
         with self._lock:
             return self._reap_locked(now_ns)
 

@@ -130,18 +130,11 @@ class Service:
 
                 self._tgn_memory = tgn.init_memory(self.config.model, max_nodes=128)
                 cfg = self.config.model
+                # tgn.step zero-extends memory internally when the node
+                # bucket grows; one compile per (bucket, memory-shape) pair
                 jitted_step = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
 
                 def tgn_score(params, graph):
-                    n_pad = graph["node_feats"].shape[0]
-                    if self._tgn_memory.shape[0] < n_pad:
-                        # grow outside jit so each bucket compiles once
-                        import jax.numpy as jnp
-
-                        self._tgn_memory = jnp.pad(
-                            self._tgn_memory,
-                            ((0, n_pad - self._tgn_memory.shape[0]), (0, 0)),
-                        )
                     out, self._tgn_memory = jitted_step(params, graph, self._tgn_memory)
                     return out
 
@@ -227,6 +220,12 @@ class Service:
         while not self._stop.wait(self.housekeeping_interval_s):
             try:
                 self.aggregator.gc()
+                # channel-lag log (data.go:177-186 cadence)
+                lag = {
+                    q.name: q.stats()
+                    for q in (self.l7_queue, self.tcp_queue, self.window_queue)
+                }
+                log.info(f"queue lag: {lag}")
             except Exception as exc:
                 log.warning(f"housekeeping failed: {exc}")
 

@@ -20,11 +20,15 @@ class TokenBucket:
 
     def admit(self, n: int, now_s: float) -> int:
         """Admit up to n units at time now_s; returns how many were admitted
-        (the rest should be dropped, mirroring rate.Limiter.Allow)."""
+        (the rest should be dropped, mirroring rate.Limiter.Allow).
+
+        Only whole admitted units are charged — fractional refill carries
+        over instead of being burned, so sub-token refills between calls
+        still accumulate to the configured rate."""
         with self._lock:
             elapsed = max(0.0, now_s - self._last)
             self._last = now_s
             self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
-            take = min(float(n), self._tokens)
+            take = min(n, int(self._tokens))
             self._tokens -= take
-            return int(take)
+            return take

@@ -419,3 +419,33 @@ class TestHttpsRendering:
         rows["tls"] = [True, False]
         views = list(iter_request_views(rows, interner))
         assert views[0].protocol == "HTTPS" and views[1].protocol == "HTTP"
+
+
+class TestRateLimit:
+    def test_per_pid_rate_limit(self):
+        """data.go:339-353 semantics: burst admits, sustained rate caps."""
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.rate_limit = (100.0, 1000.0)  # 100/s, burst 1000
+        _establish(agg)
+        # burst of 1500 at t0: 1000 admitted, 500 dropped
+        agg.process_l7(_http_events(1500), now_ns=1_000_000_000)
+        assert ds.request_count == 1000
+        assert agg.stats.l7_rate_limited == 500
+        # one second later: 100 refilled
+        agg.process_l7(_http_events(300, ts0=3_000), now_ns=2_000_000_000)
+        assert ds.request_count == 1100
+
+    def test_pids_limited_independently(self):
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.rate_limit = (10.0, 10.0)
+        _establish(agg, pid=100, fd=7)
+        _establish(agg, pid=101, fd=8)
+        ev = np.concatenate([_http_events(20, pid=100, fd=7), _http_events(20, pid=101, fd=8)])
+        agg.process_l7(ev, now_ns=1_000_000_000)
+        assert ds.request_count == 20  # 10 per pid

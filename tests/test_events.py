@@ -119,3 +119,16 @@ def test_virtual_clock():
     c.advance(500)
     assert c.now_ns() == 1500
     assert c.kernel_to_wall_ns(c.wall_to_kernel_ns(123456)) == 123456
+
+
+def test_token_bucket_fractional_refill_not_burned():
+    """Sub-token refills accumulate instead of being charged away: at
+    10/s polled every 10ms, throughput must approach 10/s, not 0."""
+    tb = TokenBucket(rate_per_s=10, burst=10, now_s=0.0)
+    assert tb.admit(10, 0.0) == 10  # drain the burst
+    admitted = 0
+    t = 0.0
+    for _ in range(100):  # one second of 10ms polls
+        t += 0.01
+        admitted += tb.admit(5, t)
+    assert 9 <= admitted <= 11
