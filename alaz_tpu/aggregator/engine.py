@@ -233,12 +233,15 @@ class Aggregator:
         now_s = now_ns / 1e9
         keep = np.ones(events.shape[0], dtype=bool)
         pids, inverse = np.unique(events["pid"], return_inverse=True)
+        # group rows per pid in O(n log n): one sort, contiguous slices
+        order = np.argsort(inverse, kind="stable")
+        boundaries = np.searchsorted(inverse[order], np.arange(pids.shape[0] + 1))
         for g, pid in enumerate(pids):
             bucket = self._pid_buckets.get(int(pid))
             if bucket is None:
                 bucket = TokenBucket(rate, burst, now_s=now_s)
                 self._pid_buckets[int(pid)] = bucket
-            idx = np.flatnonzero(inverse == g)
+            idx = order[boundaries[g] : boundaries[g + 1]]
             admitted = bucket.admit(idx.shape[0], now_s)
             if admitted < idx.shape[0]:
                 keep[idx[admitted:]] = False
@@ -584,3 +587,10 @@ class Aggregator:
         self.socket_lines.gc()
         self.h2.reap(now_ns if now_ns is not None else time.time_ns())
         self.reverse_dns.purge()  # the 10-minute purge sweep analog
+        # prune idle rate-limit buckets (deployments without proc events
+        # never hit the EXIT cleanup; idle = 10min behind the newest pid)
+        if self._pid_buckets:
+            newest = max(b._last for b in self._pid_buckets.values())
+            stale = [p for p, b in self._pid_buckets.items() if newest - b._last > 600]
+            for p in stale:
+                del self._pid_buckets[p]

@@ -1,0 +1,198 @@
+"""Pure-Python decompressors for Kafka record batches.
+
+The reference's codec table (aggregator/kafka/decompress.go) handles gzip,
+snappy, lz4, and zstd via Go libraries. Python ships gzip; snappy and lz4
+get small from-scratch decoders here (their *decompression* formats are
+simple tag machines), so Kafka payloads decode without optional C
+libraries. zstd remains gated on the optional ``zstandard`` module — its
+format is a full entropy coder, not worth a reimplementation.
+
+Formats:
+- snappy raw block (https://github.com/google/snappy/blob/main/format_description.txt):
+  uncompressed-length varint, then literal/copy tags.
+- snappy xerial framing (what Kafka's Java client writes): 8-byte magic
+  ``\\x82SNAPPY\\x00`` + version/compat ints, then length-prefixed raw blocks.
+- lz4 block (https://github.com/lz4/lz4/blob/dev/doc/lz4_Block_format.md):
+  token-based literal/match sequences.
+- lz4 frame: magic 0x184D2204 + descriptor + length-prefixed blocks
+  (optionally uncompressed, high bit of the size).
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class CorruptData(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# snappy
+# ---------------------------------------------------------------------------
+
+_XERIAL_MAGIC = b"\x82SNAPPY\x00"
+
+
+def snappy_decompress_raw(data: bytes) -> bytes:
+    """Raw snappy block format."""
+    # preamble: uncompressed length as little-endian varint
+    n = 0
+    shift = 0
+    pos = 0
+    while True:
+        if pos >= len(data):
+            raise CorruptData("truncated length varint")
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+        if shift > 32:
+            raise CorruptData("length varint too long")
+
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        elem_type = tag & 0x03
+        if elem_type == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if pos + extra > len(data):
+                    raise CorruptData("truncated literal length")
+                length = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            if pos + length > len(data):
+                raise CorruptData("truncated literal")
+            out += data[pos : pos + length]
+            pos += length
+        else:
+            if elem_type == 1:  # copy, 1-byte offset
+                length = ((tag >> 2) & 0x07) + 4
+                if pos >= len(data):
+                    raise CorruptData("truncated copy1")
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif elem_type == 2:  # copy, 2-byte offset
+                length = (tag >> 2) + 1
+                if pos + 2 > len(data):
+                    raise CorruptData("truncated copy2")
+                offset = int.from_bytes(data[pos : pos + 2], "little")
+                pos += 2
+            else:  # copy, 4-byte offset
+                length = (tag >> 2) + 1
+                if pos + 4 > len(data):
+                    raise CorruptData("truncated copy4")
+                offset = int.from_bytes(data[pos : pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise CorruptData("bad copy offset")
+            # overlapping copies are the point: copy byte-by-byte semantics
+            start = len(out) - offset
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != n:
+        raise CorruptData(f"length mismatch: {len(out)} != {n}")
+    return bytes(out)
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Snappy with Kafka's xerial framing auto-detected."""
+    if data[:8] == _XERIAL_MAGIC:
+        pos = 16  # magic + version + compat
+        out = bytearray()
+        while pos + 4 <= len(data):
+            (block_len,) = struct.unpack_from(">I", data, pos)
+            pos += 4
+            out += snappy_decompress_raw(data[pos : pos + block_len])
+            pos += block_len
+        return bytes(out)
+    return snappy_decompress_raw(data)
+
+
+# ---------------------------------------------------------------------------
+# lz4
+# ---------------------------------------------------------------------------
+
+_LZ4_FRAME_MAGIC = 0x184D2204
+
+
+def lz4_block_decompress(data: bytes) -> bytes:
+    """LZ4 block format (token machine)."""
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                if pos >= n:
+                    raise CorruptData("truncated literal length")
+                b = data[pos]
+                pos += 1
+                lit_len += b
+                if b != 255:
+                    break
+        if pos + lit_len > n:
+            raise CorruptData("truncated literals")
+        out += data[pos : pos + lit_len]
+        pos += lit_len
+        if pos >= n:
+            break  # last sequence has no match
+        if pos + 2 > n:
+            raise CorruptData("truncated offset")
+        offset = int.from_bytes(data[pos : pos + 2], "little")
+        pos += 2
+        if offset == 0 or offset > len(out):
+            raise CorruptData("bad match offset")
+        match_len = (token & 0x0F) + 4
+        if (token & 0x0F) == 15:
+            while True:
+                if pos >= n:
+                    raise CorruptData("truncated match length")
+                b = data[pos]
+                pos += 1
+                match_len += b
+                if b != 255:
+                    break
+        start = len(out) - offset
+        for i in range(match_len):
+            out.append(out[start + i])
+    return bytes(out)
+
+
+def lz4_frame_decompress(data: bytes) -> bytes:
+    """LZ4 frame format (the container Kafka writes)."""
+    if len(data) < 7 or struct.unpack_from("<I", data, 0)[0] != _LZ4_FRAME_MAGIC:
+        # not a frame: treat as a bare block
+        return lz4_block_decompress(data)
+    flg = data[4]
+    pos = 6  # magic + FLG + BD
+    if flg & 0x08:  # content size present
+        pos += 8
+    if flg & 0x01:  # dict id
+        pos += 4
+    pos += 1  # header checksum
+    content_checksum = bool(flg & 0x04)
+    block_checksum = bool(flg & 0x10)
+    out = bytearray()
+    while pos + 4 <= len(data):
+        (block_size,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        if block_size == 0:  # EndMark
+            break
+        uncompressed = bool(block_size & 0x80000000)
+        block_size &= 0x7FFFFFFF
+        block = data[pos : pos + block_size]
+        pos += block_size
+        if block_checksum:
+            pos += 4
+        out += block if uncompressed else lz4_block_decompress(block)
+    if content_checksum:
+        pos += 4
+    return bytes(out)

@@ -217,18 +217,18 @@ def _decompress(codec: int, data: bytes) -> bytes | None:
             return gzip.GzipFile(fileobj=io.BytesIO(data)).read()
         except OSError:
             return None
-    if codec == 2:  # snappy
+    if codec == 2:  # snappy (pure-python decoder, xerial framing aware)
         try:
-            import snappy  # type: ignore
+            from alaz_tpu.protocols.compression import snappy_decompress
 
-            return snappy.decompress(data)
+            return snappy_decompress(data)
         except Exception:
             return None
-    if codec == 3:  # lz4
+    if codec == 3:  # lz4 (pure-python frame/block decoder)
         try:
-            import lz4.frame  # type: ignore
+            from alaz_tpu.protocols.compression import lz4_frame_decompress
 
-            return lz4.frame.decompress(data)
+            return lz4_frame_decompress(data)
         except Exception:
             return None
     if codec == 4:  # zstd

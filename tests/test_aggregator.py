@@ -449,3 +449,18 @@ class TestRateLimit:
         ev = np.concatenate([_http_events(20, pid=100, fd=7), _http_events(20, pid=101, fd=8)])
         agg.process_l7(ev, now_ns=1_000_000_000)
         assert ds.request_count == 20  # 10 per pid
+
+
+class TestRateLimitGc:
+    def test_idle_buckets_pruned_by_gc(self):
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.rate_limit = (100.0, 100.0)
+        _establish(agg, pid=1, fd=1)
+        _establish(agg, pid=2, fd=2)
+        agg.process_l7(_http_events(5, pid=1, fd=1), now_ns=1_000_000_000)
+        agg.process_l7(_http_events(5, pid=2, fd=2), now_ns=700_000_000_000)  # 699s later
+        assert set(agg._pid_buckets) == {1, 2}
+        agg.gc()
+        assert set(agg._pid_buckets) == {2}  # pid 1 idle >10min → pruned

@@ -401,3 +401,96 @@ class TestDispatch:
         # all-zero bytes are a valid DATA frame on stream 0 for the kernel
         # too (http2.c:96-99), so use a truly invalid payload
         assert classify_request(b"\xff" * 20)[0] == L7Protocol.UNKNOWN
+
+
+class TestCompression:
+    def _snappy_compress_literals(self, data: bytes) -> bytes:
+        """Minimal valid snappy encoder (literals only) for round-trips."""
+        out = bytearray()
+        n = len(data)
+        while n >= 0x80:
+            out.append((n & 0x7F) | 0x80)
+            n >>= 7
+        out.append(n)
+        pos = 0
+        while pos < len(data):
+            chunk = data[pos : pos + 60]
+            out.append((len(chunk) - 1) << 2)
+            out += chunk
+            pos += len(chunk)
+        return bytes(out)
+
+    def test_snappy_literals_roundtrip(self):
+        from alaz_tpu.protocols import compression as cx
+
+        for payload in (b"", b"x", b"hello kafka world " * 20):
+            raw = self._snappy_compress_literals(payload)
+            assert cx.snappy_decompress_raw(raw) == payload
+
+    def test_snappy_copy_tags(self):
+        from alaz_tpu.protocols import compression as cx
+
+        # "abcdabcdabcd": literal "abcd" + copy1(offset=4, len=8)
+        # copy1 tag: type=1, len-4 in bits 2-4, offset high bits in 5-7
+        raw = bytes([12]) + bytes([(4 - 1) << 2]) + b"abcd" + bytes([((8 - 4) << 2) | 1, 4])
+        assert cx.snappy_decompress_raw(raw) == b"abcdabcdabcd"
+
+    def test_snappy_xerial_framing(self):
+        from alaz_tpu.protocols import compression as cx
+
+        block = self._snappy_compress_literals(b"framed payload")
+        framed = b"\x82SNAPPY\x00" + b"\x00\x00\x00\x01" + b"\x00\x00\x00\x01"
+        framed += len(block).to_bytes(4, "big") + block
+        assert cx.snappy_decompress(framed) == b"framed payload"
+
+    def test_snappy_corrupt_raises(self):
+        from alaz_tpu.protocols import compression as cx
+
+        with pytest.raises(cx.CorruptData):
+            cx.snappy_decompress_raw(bytes([200, 0]) + b"short")
+
+    def _lz4_compress_literals(self, data: bytes) -> bytes:
+        """Minimal LZ4 block: one literal run, no matches."""
+        out = bytearray()
+        lit = len(data)
+        token_lit = min(lit, 15)
+        out.append(token_lit << 4)
+        if token_lit == 15:
+            rest = lit - 15
+            while rest >= 255:
+                out.append(255)
+                rest -= 255
+            out.append(rest)
+        out += data
+        return bytes(out)
+
+    def test_lz4_block_roundtrip(self):
+        from alaz_tpu.protocols import compression as cx
+
+        for payload in (b"", b"q", b"lz4 block data " * 30):
+            assert cx.lz4_block_decompress(self._lz4_compress_literals(payload)) == payload
+
+    def test_lz4_match_sequences(self):
+        from alaz_tpu.protocols import compression as cx
+
+        # literals "abcd", then match offset=4 len=8 → "abcdabcdabcd"
+        block = bytes([(4 << 4) | (8 - 4)]) + b"abcd" + (4).to_bytes(2, "little")
+        assert cx.lz4_block_decompress(block) == b"abcdabcdabcd"
+
+    def test_lz4_frame(self):
+        from alaz_tpu.protocols import compression as cx
+        import struct as _s
+
+        block = self._lz4_compress_literals(b"framed lz4")
+        frame = _s.pack("<I", 0x184D2204) + bytes([0x40, 0x40]) + b"\x00"  # FLG/BD/HC
+        frame += _s.pack("<I", len(block)) + block + _s.pack("<I", 0)
+        assert cx.lz4_frame_decompress(frame) == b"framed lz4"
+
+    def test_kafka_decompress_dispatch(self):
+        from alaz_tpu.protocols.kafka import _decompress
+
+        snappy_data = self._snappy_compress_literals(b"via kafka")
+        assert _decompress(2, snappy_data) == b"via kafka"
+        lz4_data = self._lz4_compress_literals(b"via lz4")
+        assert _decompress(3, lz4_data) == b"via lz4"
+        assert _decompress(0, b"raw") == b"raw"
