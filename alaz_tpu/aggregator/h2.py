@@ -40,8 +40,10 @@ class _ConnState:
     client_decoder: hpack.Decoder = field(default_factory=hpack.Decoder)
     server_decoder: hpack.Decoder = field(default_factory=hpack.Decoder)
     streams: dict[int, _StreamState] = field(default_factory=dict)
-    client_buffer: bytes = b""
-    server_buffer: bytes = b""
+    # header block spanning HEADERS + CONTINUATION frames, per direction:
+    # (stream_id, accumulated block, first frame time) until END_HEADERS
+    client_partial: tuple[int, bytes, int] | None = None
+    server_partial: tuple[int, bytes, int] | None = None
 
 
 @dataclass
@@ -68,6 +70,13 @@ class Http2Assembler:
         self._conns: dict[tuple[int, int], _ConnState] = {}
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _set_partial(conn: _ConnState, is_client: bool, value) -> None:
+        if is_client:
+            conn.client_partial = value
+        else:
+            conn.server_partial = value
+
     def _conn(self, pid: int, fd: int) -> _ConnState:
         key = (pid, fd)
         st = self._conns.get(key)
@@ -93,23 +102,45 @@ class Http2Assembler:
         conn = self._conn(pid, fd)
         done: list[CompletedH2Request] = []
         for frame in http2.iter_frames(payload):
-            if frame.type != http2.FRAME_HEADERS:
-                continue
             if len(frame.payload) < frame.length:
-                continue  # truncated by the capture window
-            block = http2.headers_block(frame)
+                # truncated by the capture window — also drop any pending
+                # partial: a later CONTINUATION would assemble a block with
+                # a missing middle chunk and desync the HPACK table
+                self._set_partial(conn, is_client, None)
+                continue
+            # header blocks may span HEADERS + CONTINUATION frames; hold the
+            # partial block per direction until END_HEADERS
+            partial = conn.client_partial if is_client else conn.server_partial
+            if frame.type == http2.FRAME_HEADERS:
+                block = http2.headers_block(frame)
+                stream_id = frame.stream_id
+                block_time_ns = write_time_ns
+            elif frame.type == http2.FRAME_CONTINUATION and partial is not None:
+                stream_id, acc, block_time_ns = partial
+                if stream_id != frame.stream_id:
+                    # interleaved continuation for a different stream is a
+                    # protocol error; drop the partial
+                    self._set_partial(conn, is_client, None)
+                    continue
+                block = acc + frame.payload
+            else:
+                continue
+            if not frame.flags & http2.FLAG_END_HEADERS:
+                self._set_partial(conn, is_client, (stream_id, block, block_time_ns))
+                continue
+            self._set_partial(conn, is_client, None)
             decoder = conn.client_decoder if is_client else conn.server_decoder
             try:
                 headers = decoder.decode(block)
             except hpack.HpackError:
                 continue
-            stream = conn.streams.get(frame.stream_id)
+            stream = conn.streams.get(stream_id)
             if stream is None:
-                stream = _StreamState(frame.stream_id)
-                conn.streams[frame.stream_id] = stream
+                stream = _StreamState(stream_id)
+                conn.streams[stream_id] = stream
             if is_client:
                 stream.has_client = True
-                stream.client_time_ns = write_time_ns
+                stream.client_time_ns = block_time_ns
                 for name, value in headers:
                     if name == ":method":
                         stream.method = value
@@ -124,7 +155,7 @@ class Http2Assembler:
                 # without a decodable :status — the reference flags
                 # ServerHeadersFrameArrived unconditionally (data.go:775-777)
                 stream.has_server = True
-                stream.server_time_ns = write_time_ns
+                stream.server_time_ns = block_time_ns
                 for name, value in headers:
                     if name == ":status":
                         try:
@@ -153,7 +184,7 @@ class Http2Assembler:
                         tls=tls,
                     )
                 )
-                del conn.streams[frame.stream_id]
+                del conn.streams[stream_id]
         return done
 
     def reap(self, now_ns: int) -> int:
@@ -172,4 +203,10 @@ class Http2Assembler:
             for sid in doomed:
                 del conn.streams[sid]
                 dropped += 1
+            # stale partial header blocks age out the same way
+            for attr in ("client_partial", "server_partial"):
+                partial = getattr(conn, attr)
+                if partial is not None and partial[2] + ONE_MINUTE_NS < now_ns:
+                    setattr(conn, attr, None)
+                    dropped += 1
         return dropped

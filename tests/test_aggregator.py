@@ -464,3 +464,93 @@ class TestRateLimitGc:
         assert set(agg._pid_buckets) == {1, 2}
         agg.gc()
         assert set(agg._pid_buckets) == {2}  # pid 1 idle >10min → pruned
+
+
+class TestH2Continuation:
+    def _frame(self, ftype, flags, stream_id, payload):
+        return (
+            len(payload).to_bytes(3, "big")
+            + bytes([ftype, flags])
+            + stream_id.to_bytes(4, "big")
+            + payload
+        )
+
+    def test_headers_spanning_continuation(self):
+        """Header block split across HEADERS + CONTINUATION frames pairs
+        correctly once END_HEADERS arrives."""
+        from alaz_tpu.aggregator.h2 import Http2Assembler
+
+        asm = Http2Assembler()
+        enc_c, enc_s = hpack.Encoder(), hpack.Encoder()
+        req_block = enc_c.encode(
+            [(":method", "POST"), (":path", "/Svc/M"), ("content-type", "application/grpc")]
+        )
+        half = len(req_block) // 2
+        # HEADERS without END_HEADERS, then CONTINUATION with END_HEADERS
+        f1 = self._frame(http2.FRAME_HEADERS, 0, 1, req_block[:half])
+        f2 = self._frame(http2.FRAME_CONTINUATION, http2.FLAG_END_HEADERS, 1, req_block[half:])
+        assert asm.feed(1, 2, True, f1, 100) == []
+        assert asm.feed(1, 2, True, f2, 150) == []
+        resp = enc_s.encode([(":status", "200"), ("grpc-status", "0")])
+        f3 = self._frame(http2.FRAME_HEADERS, http2.FLAG_END_HEADERS, 1, resp)
+        done = asm.feed(1, 2, False, f3, 400)
+        assert len(done) == 1
+        assert done[0].path == "/Svc/M" and done[0].is_grpc
+        assert done[0].start_time_ns == 100  # first HEADERS frame time
+
+    def test_mismatched_continuation_dropped(self):
+        from alaz_tpu.aggregator.h2 import Http2Assembler
+
+        asm = Http2Assembler()
+        enc = hpack.Encoder()
+        block = enc.encode([(":method", "GET"), (":path", "/x")])
+        f1 = self._frame(http2.FRAME_HEADERS, 0, 1, block[:2])
+        f2 = self._frame(http2.FRAME_CONTINUATION, http2.FLAG_END_HEADERS, 3, block[2:])
+        asm.feed(1, 2, True, f1, 100)
+        assert asm.feed(1, 2, True, f2, 200) == []  # protocol error: dropped
+        # a fresh complete HEADERS still works afterwards
+        enc2 = hpack.Encoder()
+        f3 = self._frame(http2.FRAME_HEADERS, http2.FLAG_END_HEADERS, 5, enc2.encode([(":method", "GET"), (":path", "/y")]))
+        asm.feed(1, 2, True, f3, 300)
+        assert 5 in asm._conns[(1, 2)].streams
+
+
+class TestH2PartialHygiene:
+    def _frame(self, ftype, flags, sid, payload, truncate=0):
+        full = (
+            len(payload).to_bytes(3, "big")
+            + bytes([ftype, flags])
+            + sid.to_bytes(4, "big")
+            + payload
+        )
+        return full[: len(full) - truncate] if truncate else full
+
+    def test_truncated_continuation_drops_partial(self):
+        from alaz_tpu.aggregator.h2 import Http2Assembler
+
+        asm = Http2Assembler()
+        enc = hpack.Encoder()
+        block = enc.encode([(":method", "GET"), (":path", "/a"), ("x", "y" * 30)])
+        third = len(block) // 3
+        f1 = self._frame(http2.FRAME_HEADERS, 0, 1, block[:third])
+        f2_truncated = self._frame(http2.FRAME_CONTINUATION, 0, 1, block[third : 2 * third], truncate=3)
+        f3 = self._frame(http2.FRAME_CONTINUATION, http2.FLAG_END_HEADERS, 1, block[2 * third :])
+        asm.feed(1, 2, True, f1, 100)
+        asm.feed(1, 2, True, f2_truncated, 150)  # middle chunk lost
+        asm.feed(1, 2, True, f3, 200)
+        # the gap-containing block must NOT have produced a stream
+        assert asm._conns[(1, 2)].streams == {}
+        assert asm._conns[(1, 2)].client_partial is None
+
+    def test_reap_expires_stale_partials(self):
+        from alaz_tpu.aggregator.h2 import ONE_MINUTE_NS, Http2Assembler
+
+        asm = Http2Assembler()
+        enc = hpack.Encoder()
+        block = enc.encode([(":method", "GET"), (":path", "/b")])
+        f1 = self._frame(http2.FRAME_HEADERS, 0, 7, block[:4])
+        asm.feed(1, 2, True, f1, 1000)
+        assert asm._conns[(1, 2)].client_partial is not None
+        dropped = asm.reap(now_ns=1000 + 2 * ONE_MINUTE_NS)
+        assert dropped == 1
+        assert asm._conns[(1, 2)].client_partial is None
