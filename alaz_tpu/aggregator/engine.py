@@ -22,6 +22,7 @@ amortized by unique-payload grouping.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -69,7 +70,7 @@ log = get_logger("alaz_tpu.aggregator")
 RETRY_ATTEMPT_LIMIT = 3  # data.go:109 attemptLimit
 RETRY_INTERVAL_NS = 20_000_000  # data.go:108 retryInterval (20ms)
 
-_PATH_WINDOW = 128  # unique-payload grouping window for path extraction
+_PATH_CACHE_MAX = 65536  # per-protocol parsed-path cache bound (cleared in gc)
 
 
 def _conn_keys(pid: np.ndarray, fd: np.ndarray) -> np.ndarray:
@@ -120,6 +121,10 @@ class Aggregator:
         self.mysql_stmts: dict[tuple[int, int, int], str] = {}
         # retry queue of (l7 rows, attempts, not_before_ns)
         self._retries: deque[tuple[np.ndarray, int, int]] = deque()
+        # L7 processing is single-logical-threaded, but the housekeeping
+        # ticker also fires flush_retries (ADVICE: retries must not wait
+        # for the next L7 batch); reentrant because process_l7 flushes too
+        self._l7_lock = threading.RLock()
         # payload-hash → interned path id, per protocol (cross-batch cache)
         self._path_cache: dict[int, dict[int, int]] = {}
         self.reverse_dns = ReverseDnsCache()
@@ -145,6 +150,7 @@ class Aggregator:
             _conn_keys(events["pid"], events["fd"]), return_index=True, return_inverse=True
         )
         alive_rows = []
+        closed_pairs: set[tuple[int, int]] = set()
         for g, start in enumerate(starts):
             rows = events[inverse == g]
             pid = int(rows["pid"][0])
@@ -167,8 +173,25 @@ class Aggregator:
                     alive_rows.append(r)
                 else:
                     line.add_value(int(r["timestamp_ns"]), None)
+                    closed_pairs.add((pid, fd))
+        if closed_pairs:
+            self._teardown_conns(closed_pairs)
         if self.config.send_alive_tcp_connections and alive_rows:
             self._persist_alive(np.array(alive_rows, dtype=events.dtype))
+
+    def _teardown_conns(self, closed_pairs: set[tuple[int, int]]) -> None:
+        """Per-connection state teardown on TCP CLOSED: h2 parsers and
+        prepared-statement caches must not survive a (pid, fd) reuse
+        (reference deletes both on close, data.go:363-380,496-500). Runs
+        on the TCP worker; the stmt caches are mutated by the L7 worker
+        under _l7_lock, so take it here too."""
+        for pid, fd in closed_pairs:
+            self.h2.remove_conn(pid, fd)
+        with self._l7_lock:
+            for cache in (self.pg_stmts, self.mysql_stmts):
+                doomed = [k for k in cache if (k[0], k[1]) in closed_pairs]
+                for k in doomed:
+                    del cache[k]
 
     def _persist_alive(self, rows: np.ndarray) -> None:
         out = np.zeros(rows.shape[0], dtype=ALIVE_CONNECTION_DTYPE)
@@ -194,6 +217,12 @@ class Aggregator:
             if r["type"] == ProcEventType.EXIT:
                 self.live_pids.discard(pid)
                 self.socket_lines.remove_pid(pid)
+                self.h2.remove_pid(pid)
+                with self._l7_lock:  # stmt caches belong to the L7 worker
+                    for cache in (self.pg_stmts, self.mysql_stmts):
+                        doomed = [k for k in cache if k[0] == pid]
+                        for k in doomed:
+                            del cache[k]
                 # a reused pid must start with a fresh burst allowance
                 self._pid_buckets.pop(pid, None)
             elif r["type"] == ProcEventType.EXEC:
@@ -216,11 +245,12 @@ class Aggregator:
         """Join + attribute an L7_EVENT_DTYPE batch. Returns the emitted
         REQUEST_DTYPE rows (also persisted to the datastore)."""
         now_ns = now_ns if now_ns is not None else time.time_ns()
-        self.stats.l7_in += events.shape[0]
-        if self.rate_limit is not None and events.shape[0]:
-            events = self._apply_rate_limit(events, now_ns)
-        emitted = self._process_l7_inner(events, attempts=0, now_ns=now_ns)
-        retried = self.flush_retries(now_ns)
+        with self._l7_lock:
+            self.stats.l7_in += events.shape[0]
+            if self.rate_limit is not None and events.shape[0]:
+                events = self._apply_rate_limit(events, now_ns)
+            emitted = self._process_l7_inner(events, attempts=0, now_ns=now_ns)
+            retried = self.flush_retries(now_ns)
         if retried is not None and retried.shape[0]:
             emitted = np.concatenate([emitted, retried])
         return emitted
@@ -251,16 +281,23 @@ class Aggregator:
             events = events[keep]
         return events
 
+    @property
+    def pending_retries(self) -> int:
+        return len(self._retries)
+
     def flush_retries(self, now_ns: int) -> np.ndarray | None:
-        """Re-run due retry entries (the signal-and-requeue path)."""
+        """Re-run due retry entries (the signal-and-requeue path). Safe to
+        call from the housekeeping ticker — the reference's retry is
+        timer-driven, not gated on the next L7 batch."""
         out = []
-        pending = len(self._retries)
-        for _ in range(pending):
-            rows, attempts, not_before = self._retries.popleft()
-            if not_before > now_ns:
-                self._retries.append((rows, attempts, not_before))
-                continue
-            out.append(self._process_l7_inner(rows, attempts, now_ns))
+        with self._l7_lock:
+            pending = len(self._retries)
+            for _ in range(pending):
+                rows, attempts, not_before = self._retries.popleft()
+                if not_before > now_ns:
+                    self._retries.append((rows, attempts, not_before))
+                    continue
+                out.append(self._process_l7_inner(rows, attempts, now_ns))
         if not out:
             return None
         return np.concatenate(out) if len(out) > 1 else out[0]
@@ -442,8 +479,15 @@ class Aggregator:
 
     def _hashed_parse(self, events, out, idx, proto_key: int, row_parser) -> None:
         cache = self._path_cache.setdefault(proto_key, {})
-        window = np.ascontiguousarray(events["payload"][idx, :_PATH_WINDOW])
+        # hash the FULL captured window plus payload_size: two payloads
+        # identical in a prefix but differing beyond (long paths/SQL) must
+        # not share the first-seen interned path
+        window = np.ascontiguousarray(events["payload"][idx])
         hashes = self._payload_hashes(window)
+        with np.errstate(over="ignore"):
+            hashes ^= events["payload_size"][idx].astype(np.uint64) * np.uint64(
+                0xD6E8FEB86659FD93
+            )
         uniq, starts, inverse = np.unique(hashes, return_index=True, return_inverse=True)
         path_ids = np.zeros(uniq.shape[0], dtype=np.int32)
         for u in range(uniq.shape[0]):
@@ -587,10 +631,18 @@ class Aggregator:
         self.socket_lines.gc()
         self.h2.reap(now_ns if now_ns is not None else time.time_ns())
         self.reverse_dns.purge()  # the 10-minute purge sweep analog
+        # bound the parsed-path caches: high-cardinality paths (unique
+        # URLs/query strings) must not grow them without limit. Snapshot:
+        # the L7 worker setdefault-inserts new protocol keys concurrently.
+        for cache in list(self._path_cache.values()):
+            if len(cache) > _PATH_CACHE_MAX:
+                cache.clear()
         # prune idle rate-limit buckets (deployments without proc events
-        # never hit the EXIT cleanup; idle = 10min behind the newest pid)
-        if self._pid_buckets:
-            newest = max(b._last for b in self._pid_buckets.values())
-            stale = [p for p, b in self._pid_buckets.items() if newest - b._last > 600]
-            for p in stale:
-                del self._pid_buckets[p]
+        # never hit the EXIT cleanup; idle = 10min behind the newest pid).
+        # Snapshot: the L7 worker inserts buckets concurrently.
+        buckets = list(self._pid_buckets.items())
+        if buckets:
+            newest = max(b._last for _, b in buckets)
+            for p, b in buckets:
+                if newest - b._last > 600:
+                    self._pid_buckets.pop(p, None)

@@ -187,6 +187,26 @@ class Http2Assembler:
                 del conn.streams[stream_id]
         return done
 
+    def remove_conn(self, pid: int, fd: int) -> None:
+        """Tear down one connection's state on TCP CLOSED — the reference
+        deletes h2Parsers on close (data.go:363-380); without this a reused
+        (pid, fd) inherits a desynced HPACK table from the prior
+        connection."""
+        with self._lock:
+            self._conns.pop((pid, fd), None)
+
+    def remove_pid(self, pid: int) -> None:
+        """Tear down all of a pid's connections on process EXIT
+        (data.go:486-494)."""
+        with self._lock:
+            doomed = [k for k in self._conns if k[0] == pid]
+            for k in doomed:
+                del self._conns[k]
+
+    def conn_count(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
     def reap(self, now_ns: int) -> int:
         """Drop half-arrived pairs older than a minute (data.go:551-571)."""
         with self._lock:

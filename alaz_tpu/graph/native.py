@@ -45,25 +45,37 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build(force: bool = False) -> bool:
-    """Compile the shared library if needed; True on success."""
-    if _LIB_PATH.exists() and not force:
-        return True
+    """Compile the shared library if needed; True on success. Always runs
+    make (a no-op when up to date) so an edited ingest.cc is never shadowed
+    by a stale .so."""
     try:
-        subprocess.run(
-            ["make", "-C", str(_LIB_DIR)], check=True, capture_output=True, timeout=120
-        )
+        cmd = ["make", "-C", str(_LIB_DIR)]
+        if force:
+            cmd.append("-B")
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return _LIB_PATH.exists()
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
-        return False
+        return _LIB_PATH.exists()
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not _LIB_PATH.exists() and not build():
+    if not build():
         return None
-    lib = ctypes.CDLL(str(_LIB_PATH))
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        _register(lib)
+    except (OSError, AttributeError):
+        # unloadable or stale .so missing newer symbols (e.g. prebuilt lib
+        # + no toolchain): fall back to the numpy store gracefully
+        return None
+    _lib = lib
+    return lib
+
+
+def _register(lib: ctypes.CDLL) -> None:
     lib.alz_create.restype = ctypes.c_void_p
     lib.alz_create.argtypes = [ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32]
     lib.alz_destroy.argtypes = [ctypes.c_void_p]
@@ -73,6 +85,12 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.alz_drain.argtypes = [ctypes.c_void_p]
     lib.alz_dropped.restype = ctypes.c_uint64
     lib.alz_dropped.argtypes = [ctypes.c_void_p]
+    lib.alz_ring_dropped.restype = ctypes.c_uint64
+    lib.alz_ring_dropped.argtypes = [ctypes.c_void_p]
+    lib.alz_late_dropped.restype = ctypes.c_uint64
+    lib.alz_late_dropped.argtypes = [ctypes.c_void_p]
+    lib.alz_acc_dropped.restype = ctypes.c_uint64
+    lib.alz_acc_dropped.argtypes = [ctypes.c_void_p]
     lib.alz_current_window.restype = ctypes.c_int64
     lib.alz_current_window.argtypes = [ctypes.c_void_p]
     lib.alz_node_count.restype = ctypes.c_uint32
@@ -81,8 +99,6 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.alz_close_window.argtypes = [ctypes.c_void_p, ctypes.c_uint32] + [ctypes.c_void_p] * 10
     lib.alz_export_nodes.restype = ctypes.c_uint32
     lib.alz_export_nodes.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p]
-    _lib = lib
-    return lib
 
 
 def available() -> bool:
@@ -108,7 +124,11 @@ class NativeWindowedStore:
 
     @property
     def late_dropped(self) -> int:
-        return self.ingest.dropped
+        return self.ingest.late_dropped
+
+    @property
+    def ring_dropped(self) -> int:
+        return self.ingest.ring_dropped
 
     def persist_requests(self, batch: np.ndarray) -> None:
         with self._lock:
@@ -198,6 +218,27 @@ class NativeIngest:
             return 0  # closed: metrics gauges may still poll
         return int(self._lib.alz_dropped(self._h))
 
+    @property
+    def ring_dropped(self) -> int:
+        """Backpressure drops (ring full), separate from lateness drops."""
+        if not self._h:
+            return 0
+        return int(self._lib.alz_ring_dropped(self._h))
+
+    @property
+    def late_dropped(self) -> int:
+        """Rows dropped because their window was already emitted."""
+        if not self._h:
+            return 0
+        return int(self._lib.alz_late_dropped(self._h))
+
+    @property
+    def acc_dropped(self) -> int:
+        """Rows dropped on node/edge table capacity."""
+        if not self._h:
+            return 0
+        return int(self._lib.alz_acc_dropped(self._h))
+
     @staticmethod
     def to_records(rows: np.ndarray) -> np.ndarray:
         """REQUEST_DTYPE rows → packed native records (vectorized)."""
@@ -236,8 +277,7 @@ class NativeIngest:
         return self._close_current()
 
     def flush(self) -> list[GraphBatch]:
-        """Drain everything and close every window (intermediate windows
-        closed during the drain are returned too, oldest first)."""
+        """Drain everything and close every open window, oldest first."""
         out: list[GraphBatch] = []
         if not self._h:
             return out
@@ -246,7 +286,7 @@ class NativeIngest:
             if ready == _INT64_MIN:
                 break
             out.append(self._close_current())
-        if int(self._lib.alz_current_window(self._h)) != _INT64_MIN:
+        while int(self._lib.alz_current_window(self._h)) != _INT64_MIN:
             out.append(self._close_current())
         return out
 
@@ -267,6 +307,8 @@ class NativeIngest:
                 ),
             )
         )
+        if n == -2:
+            raise RuntimeError("alz_close_window called with no open window")
         if n < 0:
             raise RuntimeError("native edge buffer overflow; raise max_edges")
 

@@ -55,12 +55,15 @@ def scatter_messages(
     edge_dst: jnp.ndarray,
     edge_mask: jnp.ndarray,
     num_nodes: int,
-    use_pallas: bool,
+    use_pallas: bool | str,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Masked message scatter → (sum [N,H], degree [N]). Uses the Pallas
-    dst-sorted kernel on TPU, XLA segment_sum elsewhere."""
+    dst-sorted kernel on TPU, XLA segment_sum elsewhere. ``use_pallas``
+    may be the string ``"interpret"`` to force the Pallas path off-TPU
+    (pl.pallas_call interpret mode) — how the sharding tests exercise the
+    kernel+shard_map interaction on a CPU mesh."""
     m = msgs * edge_mask[:, None].astype(msgs.dtype)
-    if use_pallas and jax.default_backend() == "tpu":
+    if (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret":
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
         agg = scatter_sum_sorted(m, edge_dst, num_nodes)

@@ -4,12 +4,20 @@
 // equivalents": the C++ analog of the reference's kernel-side event plane,
 // playing the role l7.c's maps play — bounded, drop-not-block, fixed-size
 // records). Producers push resolved edge records into a SPSC ring; the
-// consumer drains into an open-addressing accumulator keyed
-// (from_uid, to_uid, protocol) per time window; closed windows export COO
-// arrays + per-node tables directly into caller-provided (numpy) buffers.
+// consumer drains into per-window accumulators keyed
+// (from_uid, to_uid, protocol); closed windows export COO arrays +
+// per-node tables directly into caller-provided (numpy) buffers.
+//
+// Window semantics mirror WindowedGraphStore (graph/builder.py): multiple
+// windows may be open at once, a window becomes ready to close when the
+// watermark (max window id seen) passes it, and rows for already-closed
+// windows are dropped as late (the aggregator retry queue legitimately
+// delivers old-window rows after new-window rows — reference requeue
+// behavior /root/reference/aggregator/data.go:404-437).
 //
 // Build: make -C alaz_tpu/native   → libalaz_ingest.so (ctypes-loaded by
 // alaz_tpu/graph/native.py; the pure-numpy GraphBuilder is the fallback).
+// `make tsan` additionally builds a -fsanitize=thread test binary.
 
 #include <atomic>
 #include <cstdint>
@@ -36,7 +44,7 @@ struct EdgeSlot {
   int32_t from_uid;
   int32_t to_uid;
   uint8_t protocol;
-  uint8_t used;
+  uint8_t _pad;
   int32_t src_slot;
   int32_t dst_slot;
   uint64_t count;
@@ -96,45 +104,95 @@ class NodeTable {
   std::vector<NodeSlot> slots_;
 };
 
-class EdgeTable {
+// One open window's edge accumulator: a dense append-only arena of
+// EdgeSlots plus an open-addressing index (key -> arena position). The
+// index rehashes as the arena grows, so straggler windows stay tiny while
+// the hot window grows to full size; recycling keeps arena capacity.
+class WindowAcc {
  public:
-  explicit EdgeTable(uint32_t cap_pow2) : mask_(cap_pow2 - 1), slots_(cap_pow2) {}
+  WindowAcc() { reset_index(64); }
 
-  EdgeSlot* get_or_add(int32_t fu, int32_t tu, uint8_t proto, bool* is_new) {
+  void open(int64_t window_id) {
+    window_id_ = window_id;
+    edges_.clear();
+    if (index_.size() > 64 && edges_.capacity() < index_.size() / 4) {
+      reset_index(64);  // shrink index for a recycled straggler table
+    } else {
+      std::memset(index_.data(), 0, index_.size() * sizeof(IndexSlot));
+    }
+  }
+
+  int64_t window_id() const { return window_id_; }
+  const std::vector<EdgeSlot>& edges() const { return edges_; }
+
+  // nullptr when the caller-imposed edge cap is reached
+  EdgeSlot* get_or_add(int32_t fu, int32_t tu, uint8_t proto, uint32_t max_edges) {
+    if (edges_.size() * 2 >= index_.size()) grow_index();
     uint64_t h = mix64((static_cast<uint64_t>(static_cast<uint32_t>(fu)) << 32) ^
                        (static_cast<uint64_t>(static_cast<uint32_t>(tu)) << 8) ^ proto);
-    for (uint32_t probe = 0; probe <= mask_; ++probe) {
-      EdgeSlot& s = slots_[(h + probe) & mask_];
+    uint32_t mask = static_cast<uint32_t>(index_.size() - 1);
+    for (uint32_t probe = 0; probe <= mask; ++probe) {
+      IndexSlot& s = index_[(h + probe) & mask];
       if (!s.used) {
-        std::memset(&s, 0, sizeof(s));
+        if (edges_.size() >= max_edges) return nullptr;
         s.used = 1;
         s.from_uid = fu;
         s.to_uid = tu;
         s.protocol = proto;
-        *is_new = true;
-        order_.push_back(&s);
-        return &s;
+        s.idx = static_cast<uint32_t>(edges_.size());
+        edges_.push_back(EdgeSlot{});
+        EdgeSlot& e = edges_.back();
+        std::memset(&e, 0, sizeof(e));
+        e.from_uid = fu;
+        e.to_uid = tu;
+        e.protocol = proto;
+        return &e;
       }
       if (s.from_uid == fu && s.to_uid == tu && s.protocol == proto) {
-        *is_new = false;
-        return &s;
+        return &edges_[s.idx];
       }
     }
     return nullptr;
   }
 
-  void clear() {
-    for (EdgeSlot* s : order_) s->used = 0;
-    order_.clear();
+ private:
+  struct IndexSlot {
+    int32_t from_uid;
+    int32_t to_uid;
+    uint32_t idx;
+    uint8_t protocol;
+    uint8_t used;
+  };
+
+  void reset_index(uint32_t cap) {
+    index_.assign(cap, IndexSlot{});
   }
 
-  const std::vector<EdgeSlot*>& order() const { return order_; }
+  void grow_index() {
+    std::vector<IndexSlot> old = std::move(index_);
+    reset_index(static_cast<uint32_t>(old.size() * 2));
+    uint32_t mask = static_cast<uint32_t>(index_.size() - 1);
+    for (const IndexSlot& s : old) {
+      if (!s.used) continue;
+      uint64_t h = mix64(
+          (static_cast<uint64_t>(static_cast<uint32_t>(s.from_uid)) << 32) ^
+          (static_cast<uint64_t>(static_cast<uint32_t>(s.to_uid)) << 8) ^ s.protocol);
+      for (uint32_t probe = 0; probe <= mask; ++probe) {
+        IndexSlot& d = index_[(h + probe) & mask];
+        if (!d.used) {
+          d = s;
+          break;
+        }
+      }
+    }
+  }
 
- private:
-  uint32_t mask_;
-  std::vector<EdgeSlot> slots_;
-  std::vector<EdgeSlot*> order_;
+  int64_t window_id_ = INT64_MIN;
+  std::vector<EdgeSlot> edges_;
+  std::vector<IndexSlot> index_;
 };
+
+constexpr int kMaxOpenWindows = 8;
 
 struct Ingest {
   // SPSC ring
@@ -142,15 +200,19 @@ struct Ingest {
   uint32_t ring_mask;
   std::atomic<uint64_t> head{0};  // producer writes
   std::atomic<uint64_t> tail{0};  // consumer reads
-  std::atomic<uint64_t> dropped{0};
+  std::atomic<uint64_t> ring_dropped{0};
+  std::atomic<uint64_t> late_dropped{0};
+  std::atomic<uint64_t> acc_dropped{0};  // node/edge table capacity drops
 
-  // window state
+  // window state (consumer-side only)
   int64_t window_ms;
-  int64_t current_window = INT64_MIN;  // window id (start_ms / window_ms)
-  int64_t closed_upto = INT64_MIN;
-  uint64_t late_dropped = 0;
+  int64_t watermark = INT64_MIN;    // max window id seen
+  int64_t closed_upto = INT64_MIN;  // windows <= this are emitted, never reopened
+  uint32_t max_edges;
 
-  EdgeTable edges;
+  std::vector<WindowAcc*> open;  // open windows, unordered, <= kMaxOpenWindows
+  std::vector<WindowAcc*> pool;  // recycled accumulators
+
   NodeTable nodes;
   // persistent node identity (slots stable across windows)
   std::vector<int32_t> node_uids;
@@ -158,7 +220,51 @@ struct Ingest {
 
   Ingest(int64_t wms, uint32_t ring_cap, uint32_t edge_cap, uint32_t node_cap)
       : ring(ring_cap), ring_mask(ring_cap - 1), window_ms(wms),
-        edges(edge_cap), nodes(node_cap) {}
+        max_edges(edge_cap), nodes(node_cap) {}
+
+  ~Ingest() {
+    for (WindowAcc* a : open) delete a;
+    for (WindowAcc* a : pool) delete a;
+  }
+
+  WindowAcc* find_open(int64_t w) {
+    for (WindowAcc* a : open) {
+      if (a->window_id() == w) return a;
+    }
+    return nullptr;
+  }
+
+  WindowAcc* oldest_open() {
+    WindowAcc* best = nullptr;
+    for (WindowAcc* a : open) {
+      if (best == nullptr || a->window_id() < best->window_id()) best = a;
+    }
+    return best;
+  }
+
+  WindowAcc* acquire(int64_t w) {
+    WindowAcc* a;
+    if (!pool.empty()) {
+      a = pool.back();
+      pool.pop_back();
+    } else {
+      a = new WindowAcc();
+    }
+    a->open(w);
+    open.push_back(a);
+    return a;
+  }
+
+  void release(WindowAcc* a) {
+    for (size_t i = 0; i < open.size(); ++i) {
+      if (open[i] == a) {
+        open[i] = open.back();
+        open.pop_back();
+        break;
+      }
+    }
+    pool.push_back(a);
+  }
 };
 
 inline uint32_t next_pow2(uint32_t v) {
@@ -167,16 +273,21 @@ inline uint32_t next_pow2(uint32_t v) {
   return p;
 }
 
-void accumulate(Ingest* ig, const AlzRecord& r) {
+void accumulate(Ingest* ig, WindowAcc* acc, const AlzRecord& r) {
   int32_t src = ig->nodes.get_or_add(r.from_uid, r.from_type, &ig->node_uids,
                                      &ig->node_types);
   int32_t dst = ig->nodes.get_or_add(r.to_uid, r.to_type, &ig->node_uids,
                                      &ig->node_types);
-  if (src < 0 || dst < 0) return;  // node table full: drop
-  bool is_new = false;
-  EdgeSlot* e = ig->edges.get_or_add(r.from_uid, r.to_uid, r.protocol, &is_new);
-  if (e == nullptr) return;  // edge table full: drop
-  if (is_new) {
+  if (src < 0 || dst < 0) {  // node table full: drop
+    ig->acc_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  EdgeSlot* e = acc->get_or_add(r.from_uid, r.to_uid, r.protocol, ig->max_edges);
+  if (e == nullptr) {  // edge cap reached: drop
+    ig->acc_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  if (e->count == 0) {
     e->src_slot = src;
     e->dst_slot = dst;
   }
@@ -196,8 +307,8 @@ extern "C" {
 
 void* alz_create(int64_t window_ms, uint32_t ring_capacity, uint32_t max_edges,
                  uint32_t max_nodes) {
-  return new Ingest(window_ms, next_pow2(ring_capacity),
-                    next_pow2(max_edges * 2), next_pow2(max_nodes * 2));
+  return new Ingest(window_ms, next_pow2(ring_capacity), max_edges,
+                    next_pow2(max_nodes * 2));
 }
 
 void alz_destroy(void* p) { delete static_cast<Ingest*>(p); }
@@ -214,85 +325,112 @@ uint32_t alz_push(void* p, const AlzRecord* recs, uint32_t n) {
     ig->ring[(head + i) & ig->ring_mask] = recs[i];
   }
   ig->head.store(head + take, std::memory_order_release);
-  if (take < n) ig->dropped.fetch_add(n - take, std::memory_order_relaxed);
+  if (take < n) ig->ring_dropped.fetch_add(n - take, std::memory_order_relaxed);
   return take;
 }
 
-uint64_t alz_dropped(void* p) {
-  Ingest* ig = static_cast<Ingest*>(p);
-  return ig->dropped.load(std::memory_order_relaxed) + ig->late_dropped;
+// Backpressure drops (ring full) and lateness drops (row for an
+// already-emitted window), exported separately so the service gauges do
+// not conflate the two failure modes.
+uint64_t alz_ring_dropped(void* p) {
+  return static_cast<Ingest*>(p)->ring_dropped.load(std::memory_order_relaxed);
 }
 
-// Consumer side: drain the ring into the current window's accumulator.
-// Returns the window id (start_ms / window_ms) that became ready to close,
-// or -2^62 if the current window is still open. Records belonging to a
-// newer window than the current roll the window forward; records older
-// than a closed window are dropped as late.
+uint64_t alz_late_dropped(void* p) {
+  return static_cast<Ingest*>(p)->late_dropped.load(std::memory_order_relaxed);
+}
+
+uint64_t alz_acc_dropped(void* p) {
+  return static_cast<Ingest*>(p)->acc_dropped.load(std::memory_order_relaxed);
+}
+
+uint64_t alz_dropped(void* p) {  // combined, kept for callers wanting a total
+  Ingest* ig = static_cast<Ingest*>(p);
+  return ig->ring_dropped.load(std::memory_order_relaxed) +
+         ig->late_dropped.load(std::memory_order_relaxed);
+}
+
+// Consumer side: drain the ring into per-window accumulators. Returns the
+// oldest window id that is ready to close (watermark passed it, like the
+// numpy store's `_close_upto(watermark - 1)`), or -2^62.. INT64_MIN when
+// nothing is ready. May return ready windows on repeated calls with an
+// empty ring — callers loop drain/close until INT64_MIN. If the open-window
+// bound is hit, the oldest open window is force-signaled ready and the
+// offending record stays in the ring for the next drain.
 int64_t alz_drain(void* p) {
   Ingest* ig = static_cast<Ingest*>(p);
   uint64_t tail = ig->tail.load(std::memory_order_relaxed);
   uint64_t head = ig->head.load(std::memory_order_acquire);
-  int64_t ready = INT64_MIN;
   while (tail < head) {
     const AlzRecord& r = ig->ring[tail & ig->ring_mask];
     int64_t w = r.start_time_ms / ig->window_ms;
     if (w <= ig->closed_upto) {
-      ig->late_dropped += 1;
-    } else if (ig->current_window == INT64_MIN || w == ig->current_window) {
-      ig->current_window = w;
-      accumulate(ig, r);
-    } else if (w > ig->current_window) {
-      // window rolls: signal the old one ready and leave this record in
-      // the ring for the drain that follows the close
-      ready = ig->current_window;
-      ig->tail.store(tail, std::memory_order_release);
-      return ready;
-    } else {
-      // w < current_window but > closed_upto: stale but window still open
-      accumulate(ig, r);
+      ig->late_dropped.fetch_add(1, std::memory_order_relaxed);
+      ++tail;
+      continue;
     }
+    WindowAcc* acc = ig->find_open(w);
+    if (acc == nullptr) {
+      if (ig->open.size() >= kMaxOpenWindows) {
+        // out of accumulators: force-close the oldest; record stays queued
+        ig->tail.store(tail, std::memory_order_release);
+        return ig->oldest_open()->window_id();
+      }
+      acc = ig->acquire(w);
+    }
+    accumulate(ig, acc, r);
+    if (w > ig->watermark) ig->watermark = w;
     ++tail;
   }
   ig->tail.store(tail, std::memory_order_release);
-  return ready;
+  WindowAcc* oldest = ig->oldest_open();
+  if (oldest != nullptr && oldest->window_id() < ig->watermark) {
+    return oldest->window_id();
+  }
+  return INT64_MIN;
 }
 
+// Oldest open window id (the one alz_close_window would close), or
+// INT64_MIN when no window is open.
 int64_t alz_current_window(void* p) {
-  return static_cast<Ingest*>(p)->current_window;
+  Ingest* ig = static_cast<Ingest*>(p);
+  WindowAcc* oldest = ig->oldest_open();
+  return oldest == nullptr ? INT64_MIN : oldest->window_id();
 }
 
 uint32_t alz_node_count(void* p) {
   return static_cast<uint32_t>(static_cast<Ingest*>(p)->node_uids.size());
 }
 
-// Close the current window: export aggregated edges into caller buffers
-// (each sized >= max_edges) and advance. Returns the edge count, or -1 if
-// buffers are too small. Node tables persist across windows; fetch them
-// with alz_export_nodes.
+// Close the oldest open window: export aggregated edges into caller
+// buffers (each sized >= max_edges) and mark it emitted. Returns the edge
+// count, -1 if buffers are too small, -2 if no window is open. Node tables
+// persist across windows; fetch them with alz_export_nodes.
 int32_t alz_close_window(void* p, uint32_t buf_cap, int64_t* window_start_ms,
                          int32_t* src, int32_t* dst, uint8_t* protocol,
                          uint64_t* count, uint64_t* lat_sum, uint64_t* lat_max,
                          uint32_t* err5, uint32_t* err4, uint32_t* tls_cnt) {
   Ingest* ig = static_cast<Ingest*>(p);
-  const auto& order = ig->edges.order();
-  if (order.size() > buf_cap) return -1;
-  *window_start_ms = ig->current_window * ig->window_ms;
+  WindowAcc* acc = ig->oldest_open();
+  if (acc == nullptr) return -2;
+  const std::vector<EdgeSlot>& edges = acc->edges();
+  if (edges.size() > buf_cap) return -1;
+  *window_start_ms = acc->window_id() * ig->window_ms;
   int32_t n = 0;
-  for (const EdgeSlot* e : order) {
-    src[n] = e->src_slot;
-    dst[n] = e->dst_slot;
-    protocol[n] = e->protocol;
-    count[n] = e->count;
-    lat_sum[n] = e->lat_sum;
-    lat_max[n] = e->lat_max;
-    err5[n] = e->err5;
-    err4[n] = e->err4;
-    tls_cnt[n] = e->tls_cnt;
+  for (const EdgeSlot& e : edges) {
+    src[n] = e.src_slot;
+    dst[n] = e.dst_slot;
+    protocol[n] = e.protocol;
+    count[n] = e.count;
+    lat_sum[n] = e.lat_sum;
+    lat_max[n] = e.lat_max;
+    err5[n] = e.err5;
+    err4[n] = e.err4;
+    tls_cnt[n] = e.tls_cnt;
     ++n;
   }
-  ig->edges.clear();
-  if (ig->current_window != INT64_MIN) ig->closed_upto = ig->current_window;
-  ig->current_window = INT64_MIN;
+  if (acc->window_id() > ig->closed_upto) ig->closed_upto = acc->window_id();
+  ig->release(acc);
   return n;
 }
 

@@ -156,6 +156,10 @@ class Service:
         self.metrics.gauge("tcp.pending", lambda: self.tcp_queue.pending_events)
         self.metrics.gauge("windows.pending", lambda: len(self.window_queue))
         self.metrics.gauge("windows.late_dropped", lambda: self.graph_store.late_dropped)
+        # native path only: backpressure (ring-full) drops, distinct from lateness
+        self.metrics.gauge(
+            "ingest.ring_dropped", lambda: getattr(self.graph_store, "ring_dropped", 0)
+        )
 
     # -- ingestion surface (what sources call) ------------------------------
 
@@ -187,39 +191,49 @@ class Service:
         self.window_queue.put_nowait_drop([batch])
         self.metrics.counter("windows.closed").inc()
 
-    def _l7_worker(self) -> None:
+    def _consume(self, queue: BatchQueue, fn: Callable[[Any], None]) -> None:
+        """Worker loop: every successfully-gotten batch is matched with a
+        task_done (drain() hangs otherwise)."""
         while not self._stop.is_set():
-            batch = self.l7_queue.get(timeout=0.1)
+            batch = queue.get(timeout=0.1)
             if batch is None:
                 continue
+            try:
+                fn(batch)
+            finally:
+                queue.task_done()
+
+    def _l7_worker(self) -> None:
+        def handle(batch):
             out = self.aggregator.process_l7(batch)
             self.metrics.counter("edges.out").inc(int(out.shape[0]))
 
+        self._consume(self.l7_queue, handle)
+
     def _tcp_worker(self) -> None:
-        while not self._stop.is_set():
-            batch = self.tcp_queue.get(timeout=0.1)
-            if batch is not None:
-                self.aggregator.process_tcp(batch)
+        self._consume(self.tcp_queue, self.aggregator.process_tcp)
 
     def _proc_worker(self) -> None:
-        while not self._stop.is_set():
-            batch = self.proc_queue.get(timeout=0.1)
-            if batch is not None:
-                self.aggregator.process_proc(batch)
+        self._consume(self.proc_queue, self.aggregator.process_proc)
 
     def _k8s_worker(self) -> None:
-        while not self._stop.is_set():
-            msgs = self.k8s_queue.get(timeout=0.1)
-            if msgs is not None:
-                for m in msgs:
-                    self.aggregator.process_k8s(m)
+        def handle(msgs):
+            for m in msgs:
+                self.aggregator.process_k8s(m)
+
+        self._consume(self.k8s_queue, handle)
 
     def _housekeeping_worker(self) -> None:
         """Periodic gc: socket lines, h2 stream reaping, DNS purge — the
         reference's 2-minute ticker loops (data.go:177-219,1688)."""
+        import time
+
         while not self._stop.wait(self.housekeeping_interval_s):
             try:
                 self.aggregator.gc()
+                # timer-driven retry flush: requeued events must not wait
+                # for the next L7 batch to arrive (input lulls)
+                self._flush_retries_counted()
                 # channel-lag log (data.go:177-186 cadence)
                 lag = {
                     q.name: q.stats()
@@ -238,17 +252,20 @@ class Service:
             item = self.window_queue.get(timeout=0.1)
             if item is None:
                 continue
-            (batch,) = item
-            if self._score_fn is None or self.model_state is None:
-                continue
-            graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
-            out = self._score_fn(self.model_state, graph)
-            logits = np.asarray(out["edge_logits"])
-            self.scored_batches += 1
-            self.scored_edges += batch.n_edges
-            self.metrics.counter("scored.edges").inc(batch.n_edges)
-            if self.score_sink is not None:
-                self.score_sink(self._annotate(batch, logits))
+            try:
+                (batch,) = item
+                if self._score_fn is None or self.model_state is None:
+                    continue
+                graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
+                out = self._score_fn(self.model_state, graph)
+                logits = np.asarray(out["edge_logits"])
+                self.scored_batches += 1
+                self.scored_edges += batch.n_edges
+                self.metrics.counter("scored.edges").inc(batch.n_edges)
+                if self.score_sink is not None:
+                    self.score_sink(self._annotate(batch, logits))
+            finally:
+                self.window_queue.task_done()
 
     def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> List[ScoreRecord]:
         """Vectorized edge annotation: interner lookups happen once per
@@ -303,15 +320,31 @@ class Service:
         self._paused.clear()
 
     def drain(self, timeout_s: float = 10.0) -> None:
-        """Wait for queues to empty (test/shutdown helper)."""
+        """Wait until every submitted batch is fully processed, including
+        batches a worker has popped but not finished (``unfinished`` counts
+        those; plain queue-emptiness would race ``flush_windows``)."""
         import time
 
         deadline = time.monotonic() + timeout_s
-        queues = (self.l7_queue, self.tcp_queue, self.proc_queue, self.k8s_queue)
+        queues = (
+            self.l7_queue, self.tcp_queue, self.proc_queue, self.k8s_queue,
+            self.window_queue,
+        )
         while time.monotonic() < deadline:
-            if all(len(q) == 0 for q in queues) and len(self.window_queue) == 0:
-                return
+            if all(q.unfinished == 0 for q in queues):
+                if self.aggregator.pending_retries == 0:
+                    return
+                # flush due retries so the final window sees them; not-due
+                # entries come due within a few 20ms backoff periods
+                self._flush_retries_counted()
             time.sleep(0.02)
+
+    def _flush_retries_counted(self) -> None:
+        import time
+
+        out = self.aggregator.flush_retries(time.time_ns())
+        if out is not None and out.shape[0]:
+            self.metrics.counter("edges.out").inc(int(out.shape[0]))
 
     def flush_windows(self) -> None:
         self.graph_store.flush()

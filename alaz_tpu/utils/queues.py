@@ -31,6 +31,7 @@ class BatchQueue:
         self._events = 0
         self._dropped = 0
         self._put_total = 0
+        self._unfinished = 0  # enqueued batches not yet task_done()'d
         self._closed = False
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -72,6 +73,7 @@ class BatchQueue:
             self._items.append(batch)
             self._events += n
             self._put_total += n
+            self._unfinished += 1
             self._not_empty.notify()
             return True
 
@@ -87,6 +89,7 @@ class BatchQueue:
             self._items.append(batch)
             self._events += n
             self._put_total += n
+            self._unfinished += 1
             self._not_empty.notify()
             return True
 
@@ -103,12 +106,26 @@ class BatchQueue:
             self._not_full.notify()
             return batch
 
+    def task_done(self) -> None:
+        """Mark one previously-gotten batch as fully processed. A consumer
+        that calls this after each ``get`` lets ``unfinished`` distinguish
+        "queue empty" from "queue empty but a worker is mid-batch"."""
+        with self._lock:
+            if self._unfinished > 0:
+                self._unfinished -= 1
+
+    @property
+    def unfinished(self) -> int:
+        """Batches enqueued but not yet marked done (includes in-flight)."""
+        return self._unfinished
+
     def drain(self) -> list:
         """Grab everything currently queued (for batch-oriented consumers)."""
         with self._lock:
             items = list(self._items)
             self._items.clear()
             self._events = 0
+            self._unfinished -= len(items)
             self._not_full.notify_all()
             return items
 

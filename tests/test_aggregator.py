@@ -554,3 +554,97 @@ class TestH2PartialHygiene:
         dropped = asm.reap(now_ns=1000 + 2 * ONE_MINUTE_NS)
         assert dropped == 1
         assert asm._conns[(1, 2)].client_partial is None
+
+
+class TestConnStateTeardown:
+    """ADVICE round 1: h2 parser + prepared-stmt state must be torn down on
+    TCP CLOSED and proc EXIT (reference data.go:363-380,486-500), or a
+    reused (pid, fd) inherits a desynced HPACK table / the wrong SQL."""
+
+    def _agg(self):
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner,
+                         cluster=make_cluster(interner))
+        return agg
+
+    def _close_tcp(self, agg, pid, fd, ts=9_000):
+        tcp = make_tcp_events(1)
+        tcp["pid"], tcp["fd"], tcp["timestamp_ns"] = pid, fd, ts
+        tcp["type"] = TcpEventType.CLOSED
+        agg.process_tcp(tcp)
+
+    def test_tcp_close_tears_down_h2_and_stmts(self):
+        agg = self._agg()
+        agg.h2.feed(100, 7, True, b"", 1000)  # materialize conn state
+        agg.h2.feed(100, 8, True, b"", 1000)
+        agg.pg_stmts[(100, 7, "s1")] = "SELECT 1"
+        agg.pg_stmts[(100, 8, "s1")] = "SELECT 2"
+        agg.mysql_stmts[(100, 7, 5)] = "SELECT 3"
+        assert agg.h2.conn_count() == 2
+        self._close_tcp(agg, 100, 7)
+        assert agg.h2.conn_count() == 1
+        assert (100, 7) not in agg.h2._conns and (100, 8) in agg.h2._conns
+        assert agg.pg_stmts == {(100, 8, "s1"): "SELECT 2"}
+        assert agg.mysql_stmts == {}
+
+    def test_proc_exit_tears_down_all_pid_state(self):
+        from alaz_tpu.events.schema import ProcEventType, make_proc_events
+
+        agg = self._agg()
+        agg.h2.feed(100, 7, True, b"", 1000)
+        agg.h2.feed(200, 7, True, b"", 1000)
+        agg.pg_stmts[(100, 7, "s1")] = "SELECT 1"
+        agg.pg_stmts[(200, 7, "s1")] = "SELECT 2"
+        agg.mysql_stmts[(100, 9, 5)] = "SELECT 3"
+        pe = make_proc_events(1)
+        pe["pid"], pe["type"] = 100, ProcEventType.EXIT
+        agg.process_proc(pe)
+        assert agg.h2.conn_count() == 1 and (200, 7) in agg.h2._conns
+        assert agg.pg_stmts == {(200, 7, "s1"): "SELECT 2"}
+        assert agg.mysql_stmts == {}
+
+
+class TestPathCacheHygiene:
+    def test_payloads_differing_past_prefix_get_distinct_paths(self):
+        """ADVICE: two payloads identical in the first 128 bytes but
+        differing beyond must not share an interned path."""
+        agg = Aggregator(InMemDataStore(), interner=(i := Interner()),
+                         cluster=make_cluster(i))
+        _establish(agg)
+        common = b"GET /" + b"a" * 140  # shared 128-byte prefix
+        ev1 = _http_events(1, payload=common + b"/x HTTP/1.1\r\n\r\n")
+        ev2 = _http_events(1, payload=common + b"/y HTTP/1.1\r\n\r\n")
+        out1 = agg.process_l7(ev1, now_ns=10_000)
+        out2 = agg.process_l7(ev2, now_ns=10_000)
+        p1 = i.lookup(int(out1["path"][0]))
+        p2 = i.lookup(int(out2["path"][0]))
+        assert p1 != p2
+
+    def test_gc_bounds_path_cache(self):
+        from alaz_tpu.aggregator.engine import _PATH_CACHE_MAX
+
+        agg = Aggregator(InMemDataStore(), interner=(i := Interner()),
+                         cluster=make_cluster(i))
+        agg._path_cache[int(L7Protocol.HTTP)] = {
+            k: 0 for k in range(_PATH_CACHE_MAX + 1)
+        }
+        agg.gc(now_ns=1)
+        assert len(agg._path_cache[int(L7Protocol.HTTP)]) == 0
+
+
+class TestRetryTimerDriven:
+    def test_flush_retries_without_new_l7_traffic(self):
+        """ADVICE: requeued events must flush on the housekeeping timer,
+        not wait for the next L7 batch."""
+        interner = Interner()
+        ds = InMemDataStore()
+        agg = Aggregator(ds, interner=interner, cluster=make_cluster(interner))
+        ev = _http_events(3)
+        ev["saddr"] = ev["daddr"] = 0  # force the socket-line join path
+        agg.process_l7(ev, now_ns=10_000)
+        assert agg.pending_retries == 1
+        _establish(agg, ts=1_000)  # tcp state arrives late
+        # no further process_l7 call: the timer path alone must emit
+        out = agg.flush_retries(now_ns=10_000 + 50_000_000)
+        assert out is not None and out.shape[0] == 3
+        assert agg.pending_retries == 0
