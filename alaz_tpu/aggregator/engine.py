@@ -632,11 +632,12 @@ class Aggregator:
         self.h2.reap(now_ns if now_ns is not None else time.time_ns())
         self.reverse_dns.purge()  # the 10-minute purge sweep analog
         # bound the parsed-path caches: high-cardinality paths (unique
-        # URLs/query strings) must not grow them without limit. Snapshot:
-        # the L7 worker setdefault-inserts new protocol keys concurrently.
-        for cache in list(self._path_cache.values()):
-            if len(cache) > _PATH_CACHE_MAX:
-                cache.clear()
+        # URLs/query strings) must not grow them without limit. The caches
+        # belong to the L7 worker — clear under its lock.
+        with self._l7_lock:
+            for cache in list(self._path_cache.values()):
+                if len(cache) > _PATH_CACHE_MAX:
+                    cache.clear()
         # prune idle rate-limit buckets (deployments without proc events
         # never hit the EXIT cleanup; idle = 10min behind the newest pid).
         # Snapshot: the L7 worker inserts buckets concurrently.

@@ -130,6 +130,10 @@ class NativeWindowedStore:
     def ring_dropped(self) -> int:
         return self.ingest.ring_dropped
 
+    @property
+    def acc_dropped(self) -> int:
+        return self.ingest.acc_dropped
+
     def persist_requests(self, batch: np.ndarray) -> None:
         with self._lock:
             self.request_count += batch.shape[0]

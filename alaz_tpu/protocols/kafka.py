@@ -9,8 +9,10 @@ FetchResponse decode over both legacy message sets (magic 0/1) and record
 batches (magic 2), with gzip decompression (the other codecs are gated on
 optional libs, like the reference's decompress.go codec table).
 
-Non-flexible protocol versions are supported (produce v0-v8, fetch v0-v11);
-flexible (compact/tagged) versions return no messages rather than misparse.
+Both non-flexible (produce v0-v8, fetch v0-v11) and flexible/compact
+versions (KIP-482: produce v9+, fetch v12+ — compact strings/arrays,
+unsigned-varint lengths, tagged fields; fetch v13+ topic ids) decode;
+modern clients (Kafka ≥2.4) negotiate the flexible versions.
 """
 
 from __future__ import annotations
@@ -206,6 +208,55 @@ class _Reader:
         take = min(n, self.remaining())
         return self.read(take)
 
+    # -- flexible-version (KIP-482) primitives ---------------------------
+
+    def uvarint(self) -> int:
+        """Unsigned varint (compact lengths, tagged-field tags/sizes)."""
+        value = 0
+        shift = 0
+        while True:
+            b = self.read(1)[0]
+            value |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+            if shift > 63:
+                raise EOFError
+        return value
+
+    def compact_string(self) -> str | None:
+        n = self.uvarint()
+        if n == 0:
+            return None
+        return self.read(n - 1).decode("utf-8", "replace")
+
+    def compact_bytes_lenient(self) -> bytes:
+        """COMPACT_BYTES tolerating capture-window truncation."""
+        n = self.uvarint()
+        if n == 0:
+            return b""
+        take = min(n - 1, self.remaining())
+        return self.read(take)
+
+    def compact_array_len(self) -> int:
+        """Compact array length: uvarint(count + 1); -1 means null."""
+        return self.uvarint() - 1
+
+    def tagged_fields(self) -> None:
+        """Skip a tagged-field section: uvarint count, then per field
+        uvarint tag + uvarint size + bytes."""
+        n = self.uvarint()
+        for _ in range(n):
+            self.uvarint()  # tag
+            size = self.uvarint()
+            self.skip(size)
+
+    def uuid_hex(self) -> str:
+        """16-byte UUID (fetch v13+ topic ids) as canonical hex."""
+        raw = self.read(16)
+        h = raw.hex()
+        return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
+
 
 def _decompress(codec: int, data: bytes) -> bytes | None:
     """Codec table analog of decompress.go; returns None when the codec's
@@ -323,26 +374,47 @@ def decode_record_set(topic: str, partition: int, data: bytes, mtype: str) -> Li
     return out
 
 
+# First flexible api_version per api_key (versions.go analog): flexible
+# requests use header v2 (tagged fields after client_id), flexible
+# responses use header v1 (tagged fields after correlation_id).
+FLEXIBLE_SINCE = {API_KEY_PRODUCE: 9, API_KEY_FETCH: 12}
+
+
+def is_flexible(api_key: int, api_version: int) -> bool:
+    since = FLEXIBLE_SINCE.get(api_key)
+    return since is not None and api_version >= since
+
+
 def decode_produce_request(buf: bytes, api_version: int) -> List[KafkaMessage]:
     """ProduceRequest body (after the request header) → PUBLISH messages
-    (produce_request.go analog). Supports non-flexible v0-v8."""
-    if api_version > 8:
-        return []
+    (produce_request.go analog). v0-v8 classic encoding; v9+ flexible
+    (compact strings/arrays, tagged fields)."""
+    flexible = api_version >= FLEXIBLE_SINCE[API_KEY_PRODUCE]
     out: List[KafkaMessage] = []
     r = _Reader(buf)
     try:
-        if api_version >= 3:
+        if flexible:
+            r.compact_string()  # transactional_id
+        elif api_version >= 3:
             r.string()  # transactional_id
         _acks = r.i16()
         _timeout = r.i32()
-        n_topics = r.i32()
+        n_topics = r.compact_array_len() if flexible else r.i32()
         for _ in range(max(0, n_topics)):
-            topic = r.string() or ""
-            n_parts = r.i32()
+            topic = (r.compact_string() if flexible else r.string()) or ""
+            n_parts = r.compact_array_len() if flexible else r.i32()
             for _p in range(max(0, n_parts)):
                 partition = r.i32()
-                record_set = r.bytes_lenient()
+                record_set = (
+                    r.compact_bytes_lenient() if flexible else r.bytes_lenient()
+                )
                 out.extend(decode_record_set(topic, partition, record_set, PUBLISH))
+                if flexible:
+                    r.tagged_fields()  # partition tail
+            if flexible:
+                r.tagged_fields()  # topic tail
+        if flexible:
+            r.tagged_fields()  # request tail
     except (EOFError, struct.error):
         pass
     return out
@@ -351,33 +423,45 @@ def decode_produce_request(buf: bytes, api_version: int) -> List[KafkaMessage]:
 def split_request_header(buf: bytes) -> tuple[int, int, int, bytes]:
     """Full request wire bytes → (api_key, api_version, correlation_id,
     body). Header v1: size, api_key, api_version, correlation_id,
-    client_id(nullable string)."""
+    client_id (nullable non-compact string). Header v2 (flexible versions)
+    appends tagged fields; client_id stays a legacy string (KIP-482)."""
     r = _Reader(buf)
     _size = r.i32()
     api_key = r.i16()
     api_version = r.i16()
     corr = r.i32()
     r.string()  # client_id
+    if is_flexible(api_key, api_version):
+        r.tagged_fields()
     return api_key, api_version, corr, buf[r.off :]
 
 
 def decode_fetch_response(buf: bytes, api_version: int) -> List[KafkaMessage]:
     """FetchResponse body (after size+correlation_id) → CONSUME messages
-    (fetch_response.go analog). Supports non-flexible v0-v11."""
-    if api_version > 11:
-        return []
+    (fetch_response.go analog). v0-v11 classic; v12+ flexible (the
+    response-header-v1 tagged-field tail is consumed here so the caller
+    can keep slicing off size+correlation_id uniformly); v13+ carries
+    topic ids (UUID) instead of names."""
+    flexible = api_version >= FLEXIBLE_SINCE[API_KEY_FETCH]
     out: List[KafkaMessage] = []
     r = _Reader(buf)
     try:
+        if flexible:
+            r.tagged_fields()  # response header v1 tail
         if api_version >= 1:
             r.i32()  # throttle_time_ms
         if api_version >= 7:
             r.i16()  # error_code
             r.i32()  # session_id
-        n_topics = r.i32()
+        n_topics = r.compact_array_len() if flexible else r.i32()
         for _ in range(max(0, n_topics)):
-            topic = r.string() or ""
-            n_parts = r.i32()
+            if api_version >= 13:
+                topic = r.uuid_hex()  # topic_id; name resolution is broker-side
+            elif flexible:
+                topic = r.compact_string() or ""
+            else:
+                topic = r.string() or ""
+            n_parts = r.compact_array_len() if flexible else r.i32()
             for _p in range(max(0, n_parts)):
                 partition = r.i32()
                 _err = r.i16()
@@ -386,14 +470,24 @@ def decode_fetch_response(buf: bytes, api_version: int) -> List[KafkaMessage]:
                     _last_stable = r.i64()
                     if api_version >= 5:
                         _log_start = r.i64()
-                    n_aborted = r.i32()
+                    n_aborted = r.compact_array_len() if flexible else r.i32()
                     for _a in range(max(0, n_aborted)):
                         r.i64()  # producer_id
                         r.i64()  # first_offset
+                        if flexible:
+                            r.tagged_fields()
                 if api_version >= 11:
                     r.i32()  # preferred_read_replica
-                record_set = r.bytes_lenient()
+                record_set = (
+                    r.compact_bytes_lenient() if flexible else r.bytes_lenient()
+                )
                 out.extend(decode_record_set(topic, partition, record_set, CONSUME))
+                if flexible:
+                    r.tagged_fields()  # partition tail
+            if flexible:
+                r.tagged_fields()  # topic tail
+        if flexible:
+            r.tagged_fields()  # response tail
     except (EOFError, struct.error):
         pass
     return out

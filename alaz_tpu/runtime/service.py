@@ -156,9 +156,13 @@ class Service:
         self.metrics.gauge("tcp.pending", lambda: self.tcp_queue.pending_events)
         self.metrics.gauge("windows.pending", lambda: len(self.window_queue))
         self.metrics.gauge("windows.late_dropped", lambda: self.graph_store.late_dropped)
-        # native path only: backpressure (ring-full) drops, distinct from lateness
+        # native path only: backpressure (ring-full) drops and node/edge
+        # table-capacity drops, each distinct from lateness
         self.metrics.gauge(
             "ingest.ring_dropped", lambda: getattr(self.graph_store, "ring_dropped", 0)
+        )
+        self.metrics.gauge(
+            "ingest.acc_dropped", lambda: getattr(self.graph_store, "acc_dropped", 0)
         )
 
     # -- ingestion surface (what sources call) ------------------------------
@@ -226,8 +230,6 @@ class Service:
     def _housekeeping_worker(self) -> None:
         """Periodic gc: socket lines, h2 stream reaping, DNS purge — the
         reference's 2-minute ticker loops (data.go:177-219,1688)."""
-        import time
-
         while not self._stop.wait(self.housekeeping_interval_s):
             try:
                 self.aggregator.gc()

@@ -389,6 +389,166 @@ def _zigzag(n: int) -> bytes:
             return bytes(out)
 
 
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _compact_str(s: bytes | None) -> bytes:
+    if s is None:
+        return _uvarint(0)
+    return _uvarint(len(s) + 1) + s
+
+
+def _compact_bytes(b: bytes | None) -> bytes:
+    if b is None:
+        return _uvarint(0)
+    return _uvarint(len(b) + 1) + b
+
+
+_EMPTY_TAGS = _uvarint(0)
+
+
+def _record_batch(key: bytes, value: bytes) -> bytes:
+    rec_body = bytes([0]) + _zigzag(0) + _zigzag(0)
+    rec_body += _zigzag(len(key)) + key
+    rec_body += _zigzag(len(value)) + value
+    rec_body += _zigzag(0)
+    record = _zigzag(len(rec_body)) + rec_body
+    tail = struct.pack("!iBihiqqqhii", 0, 2, 0, 0, 0, 0, 0, -1, -1, -1, 1) + record
+    return struct.pack("!qi", 0, len(tail)) + tail
+
+
+class TestKafkaFlexible:
+    """KIP-482 compact/tagged encoding — produce v9+, fetch v12+ (the
+    versions modern clients negotiate; reference gates these in
+    aggregator/kafka/request.go + fetch_response.go)."""
+
+    def _flexible_produce(self, topic: bytes, key: bytes, value: bytes,
+                          api_version=9, extra_tag=False) -> bytes:
+        batch = _record_batch(key, value)
+        tags = (
+            _uvarint(1) + _uvarint(0) + _uvarint(3) + b"xyz"  # one unknown tag
+            if extra_tag
+            else _EMPTY_TAGS
+        )
+        body = _compact_str(None)  # transactional_id
+        body += struct.pack("!hi", 1, 30000)  # acks, timeout
+        body += _uvarint(1 + 1)  # topics: compact array of 1
+        body += _compact_str(topic)
+        body += _uvarint(1 + 1)  # partitions
+        body += struct.pack("!i", 0)  # partition index
+        body += _compact_bytes(batch)  # records
+        body += tags  # partition tagged fields
+        body += tags  # topic tagged fields
+        body += tags  # request tagged fields
+        header = struct.pack("!hhi", kafka.API_KEY_PRODUCE, api_version, 77)
+        header += struct.pack("!h", 4) + b"test"  # client_id (legacy string)
+        header += tags  # request header v2 tagged fields
+        wire = header + body
+        return struct.pack("!i", len(wire)) + wire
+
+    def _flexible_fetch_response(self, api_version=12, key=b"fk", value=b"fv") -> bytes:
+        batch = _record_batch(key, value)
+        body = _EMPTY_TAGS  # response header v1 tagged tail
+        body += struct.pack("!i", 0)  # throttle
+        body += struct.pack("!hi", 0, 99)  # error_code, session_id
+        body += _uvarint(1 + 1)  # topics
+        if api_version >= 13:
+            body += bytes(range(16))  # topic_id uuid
+        else:
+            body += _compact_str(b"orders")
+        body += _uvarint(1 + 1)  # partitions
+        body += struct.pack("!ihq", 0, 0, 10)  # index, err, hwm
+        body += struct.pack("!qq", 10, 0)  # last_stable, log_start
+        body += _uvarint(1 + 1)  # aborted txns: one entry
+        body += struct.pack("!qq", 5, 6) + _EMPTY_TAGS
+        body += struct.pack("!i", -1)  # preferred_read_replica
+        body += _compact_bytes(batch)
+        body += _EMPTY_TAGS  # partition tags
+        body += _EMPTY_TAGS  # topic tags
+        body += _EMPTY_TAGS  # response tags
+        return body
+
+    def test_produce_v9_roundtrip(self):
+        wire = self._flexible_produce(b"orders", b"key9", b"flexible!")
+        ok, corr, api_key, api_version = kafka.parse_request_header(wire)
+        assert ok and api_version == 9
+        api_key, api_version, corr, body = kafka.split_request_header(wire)
+        msgs = kafka.decode_produce_request(body, api_version)
+        assert len(msgs) == 1
+        assert (msgs[0].topic, msgs[0].key, msgs[0].value) == (
+            "orders", "key9", "flexible!",
+        )
+
+    def test_produce_v9_with_unknown_tagged_fields(self):
+        """Unknown tagged fields must be skipped, not break the walk."""
+        wire = self._flexible_produce(b"t", b"k", b"v", extra_tag=True)
+        _, api_version, _, body = kafka.split_request_header(wire)
+        msgs = kafka.decode_produce_request(body, api_version)
+        assert len(msgs) == 1 and msgs[0].value == "v"
+
+    def test_fetch_v12_roundtrip(self):
+        body = self._flexible_fetch_response(12)
+        msgs = kafka.decode_fetch_response(body, 12)
+        assert len(msgs) == 1
+        m = msgs[0]
+        assert (m.topic, m.partition, m.key, m.value, m.type) == (
+            "orders", 0, "fk", "fv", kafka.CONSUME,
+        )
+
+    def test_fetch_v13_topic_id(self):
+        body = self._flexible_fetch_response(13)
+        msgs = kafka.decode_fetch_response(body, 13)
+        assert len(msgs) == 1
+        assert msgs[0].topic == "00010203-0405-0607-0809-0a0b0c0d0e0f"
+        assert msgs[0].value == "fv"
+
+    def test_truncated_record_set_still_yields_nothing_bad(self):
+        """Capture-window truncation mid-record-set must not raise and not
+        fabricate messages from garbage."""
+        wire = self._flexible_produce(b"orders", b"key9", b"flexible!")
+        _, api_version, _, body = kafka.split_request_header(wire)
+        for cut in range(0, len(body)):
+            msgs = kafka.decode_produce_request(body[:cut], api_version)
+            assert isinstance(msgs, list)
+
+    def test_fetch_fuzz_truncation_and_mutation(self):
+        """compression.py-style fuzz: truncations and random byte flips
+        must never raise."""
+        import random
+
+        rng = random.Random(7)
+        body = self._flexible_fetch_response(12)
+        for cut in range(0, len(body)):
+            kafka.decode_fetch_response(body[:cut], 12)
+        for _ in range(300):
+            mutated = bytearray(body)
+            for _k in range(rng.randint(1, 6)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            kafka.decode_fetch_response(bytes(mutated), 12)
+            kafka.decode_fetch_response(bytes(mutated), 13)
+
+    def test_produce_fuzz_mutation(self):
+        import random
+
+        rng = random.Random(11)
+        wire = self._flexible_produce(b"orders", b"key9", b"flexible!")
+        _, api_version, _, body = kafka.split_request_header(wire)
+        for _ in range(300):
+            mutated = bytearray(body)
+            for _k in range(rng.randint(1, 6)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            kafka.decode_produce_request(bytes(mutated), api_version)
+
+
 class TestDispatch:
     def test_classify_chain_order(self):
         # matches l7.c:248-384 dispatch
