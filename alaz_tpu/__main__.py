@@ -96,6 +96,10 @@ def cmd_serve(args) -> int:
     svc = Service(
         config=cfg, interner=interner, model_state=params, export_backend=export_backend
     )
+    # pre-existing connections join immediately on restart (reference
+    # rebuilds state from /proc; replay configs have no live procfs)
+    if not args.config:
+        svc.aggregator.backfill_from_proc()
     svc.start()
     debug = DebugServer(svc, port=args.debug_port)
     debug.start()

@@ -133,6 +133,28 @@ class Aggregator:
         self.rate_limit: tuple[float, float] | None = None
         self._pid_buckets: dict[int, TokenBucket] = {}
 
+    def backfill_from_proc(
+        self,
+        pids: list[int] | None = None,
+        proc_root: str = "/proc",
+        now_ns: int | None = None,
+    ) -> int:
+        """Cold-start: seed socket lines for connections that predate this
+        agent from /proc/<pid>/fd + /proc/<pid>/net/tcp
+        (sock_num_line.go:223-269,352-429). Returns lines created. Called
+        once at startup so V1-joined L7 events on long-lived connections
+        attribute immediately instead of dropping until fresh TCP events
+        arrive."""
+        from alaz_tpu.aggregator.procfs import backfill_socket_lines
+
+        now_ns = now_ns if now_ns is not None else time.time_ns()
+        created = backfill_socket_lines(
+            self.socket_lines, pids=pids, proc_root=proc_root, now_ns=now_ns
+        )
+        if created:
+            log.info(f"cold-start backfill: {created} socket lines from {proc_root}")
+        return created
+
     # ------------------------------------------------------------------
     # TCP events
     # ------------------------------------------------------------------

@@ -174,8 +174,11 @@ class BatchingBackend(BaseDataStore):
 
     def persist_scores(self, records) -> None:
         """Anomaly-score edge annotations → /anomalies/ (the BASELINE.json
-        return leg: scores flow back through the dto path). Accepts
-        runtime.ScoreRecord instances (duck-typed)."""
+        return leg: scores flow back through the dto path) with the
+        fixed-arity row discipline of backend.go:819-877. Accepts a
+        runtime.ScoreBatch (whose iteration resolves uid strings once per
+        unique node using the batch's own interner) or any iterable of
+        ScoreRecord-shaped objects."""
         rows = [
             [r.window_start_ms, r.from_uid, r.to_uid, r.protocol, r.score]
             for r in records

@@ -8,7 +8,7 @@ graceful shutdown.
 
 from alaz_tpu.runtime.metrics import Metrics, Counter, Gauge
 from alaz_tpu.runtime.health import HealthChecker, HealthState
-from alaz_tpu.runtime.service import Service, ScoreRecord
+from alaz_tpu.runtime.service import Service, ScoreBatch, ScoreRecord
 
 __all__ = [
     "Metrics",
@@ -18,4 +18,5 @@ __all__ = [
     "HealthState",
     "Service",
     "ScoreRecord",
+    "ScoreBatch",
 ]

@@ -72,6 +72,7 @@ class TestService:
             interner=interner,
             score_sink=scores.extend if score else None,
             model_state=params,
+            score_threshold=0.0,  # untrained model: keep every edge
         )
         sim = Simulator(
             SimulationConfig(test_duration_s=3.0, pod_count=30, service_count=10, edge_count=15, edge_rate=200),
@@ -215,7 +216,7 @@ class TestScoreExportLeg:
         cfg = RuntimeConfig(model=ModelConfig(model="graphsage", hidden_dim=32, use_pallas=False))
         init, _ = get_model("graphsage")
         params = init(jax.random.PRNGKey(0), cfg.model)
-        svc = Service(config=cfg, interner=interner, export_backend=be, model_state=params)
+        svc = Service(config=cfg, interner=interner, export_backend=be, model_state=params, score_threshold=0.0)
         sim = Simulator(
             SimulationConfig(test_duration_s=2.0, pod_count=10, service_count=4, edge_count=6, edge_rate=100),
             interner=interner,
@@ -252,7 +253,7 @@ class TestTgnService:
         cfg = RuntimeConfig(model=ModelConfig(model="tgn", hidden_dim=32, use_pallas=False))
         params = tgn.init(jax.random.PRNGKey(0), cfg.model)
         scores = []
-        svc = Service(config=cfg, interner=interner, score_sink=scores.extend, model_state=params)
+        svc = Service(config=cfg, interner=interner, score_sink=scores.extend, model_state=params, score_threshold=0.0)
         sim = Simulator(
             SimulationConfig(test_duration_s=3.0, pod_count=15, service_count=5, edge_count=8, edge_rate=100),
             interner=interner,
@@ -288,3 +289,83 @@ class TestHousekeeping:
         time.sleep(0.4)
         svc.stop()
         assert ran["n"] >= 2
+
+
+class TestColumnarScoreLeg:
+    def test_annotate_is_columnar_and_fast(self):
+        """The return leg must sustain bench-rate edges: 1M edges annotate
+        in well under a second because no per-edge Python objects are
+        built (VERDICT r1: per-edge ScoreRecord was the ceiling)."""
+        from types import SimpleNamespace
+
+        import numpy as np
+
+        from alaz_tpu.runtime.service import Service
+
+        interner = Interner()
+        svc = Service(interner=interner, score_threshold=0.9)
+        n = 1_000_000
+        rng = np.random.default_rng(0)
+        batch = SimpleNamespace(
+            n_edges=n,
+            node_uids=np.arange(1, 1001, dtype=np.int32),
+            edge_src=rng.integers(0, 1000, n).astype(np.int32),
+            edge_dst=rng.integers(0, 1000, n).astype(np.int32),
+            edge_type=rng.integers(1, 9, n).astype(np.int32),
+            window_start_ms=1000,
+        )
+        logits = rng.normal(size=n).astype(np.float32)
+        t0 = time.perf_counter()
+        out = svc._annotate(batch, logits)
+        dt = time.perf_counter() - t0
+        assert dt < 1.0, f"annotate took {dt:.3f}s for 1M edges"
+        # threshold filters: sigmoid(x) >= 0.9 is rare for N(0,1) logits
+        assert 0 < len(out) < n // 10
+        assert out.score.dtype == np.float32
+
+    def test_score_batch_iterates_as_records(self):
+        import numpy as np
+
+        from alaz_tpu.runtime.service import ScoreBatch
+
+        interner = Interner()
+        a, b = interner.intern("pod-a"), interner.intern("svc-b")
+        sb = ScoreBatch(
+            window_start_ms=5000,
+            from_uid=np.array([a], np.int32),
+            to_uid=np.array([b], np.int32),
+            protocol=np.array([1], np.int32),
+            score=np.array([0.75], np.float32),
+            interner=interner,
+        )
+        (rec,) = list(sb)
+        assert rec.from_uid == "pod-a" and rec.to_uid == "svc-b"
+        assert rec.window_start_ms == 5000 and abs(rec.score - 0.75) < 1e-6
+
+    def test_backend_columnar_serialization(self):
+        import numpy as np
+
+        from alaz_tpu.config import BackendConfig
+        from alaz_tpu.datastore.backend import BatchingBackend
+        from alaz_tpu.runtime.service import ScoreBatch
+
+        interner = Interner()
+        calls = []
+        be = BatchingBackend(
+            lambda ep, payload: (calls.append((ep, payload)), 200)[1],
+            interner,
+            BackendConfig(batch_size=10),
+        )
+        a, b = interner.intern("pod-a"), interner.intern("svc-b")
+        be.persist_scores(ScoreBatch(
+            window_start_ms=7000,
+            from_uid=np.array([a, a], np.int32),
+            to_uid=np.array([b, b], np.int32),
+            protocol=np.array([1, 3], np.int32),
+            score=np.array([0.9, 0.8], np.float32),
+            interner=interner,
+        ))
+        be.pump(force=True)
+        (ep, payload), = [c for c in calls if c[0] == "/anomalies/"]
+        assert payload["data"][0][:4] == [7000, "pod-a", "svc-b", "HTTP"]
+        assert abs(payload["data"][1][4] - 0.8) < 1e-6

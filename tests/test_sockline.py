@@ -182,3 +182,92 @@ class TestStore:
         stop.set()
         for t in threads:
             t.join()
+
+
+class TestProcBackfill:
+    """Cold-start backfill (sock_num_line.go:223-269,352-429): on restart,
+    pre-existing connections are rebuilt from /proc so V1 L7 events join
+    without any TCP event ever being submitted."""
+
+    def _fixture_proc(self, tmp_path, pid=4242, fd=7, inode=98765,
+                      saddr="10.0.0.1", sport=4000, daddr="10.96.0.1", dport=80):
+        import os
+        import struct as _struct
+
+        from alaz_tpu.events.net import ip_to_u32
+
+        def hexaddr(ip, port):
+            le = _struct.pack("<I", ip_to_u32(ip)).hex().upper()
+            return f"{le}:{port:04X}"
+
+        proc = tmp_path / "proc"
+        fd_dir = proc / str(pid) / "fd"
+        fd_dir.mkdir(parents=True)
+        os.symlink(f"socket:[{inode}]", fd_dir / str(fd))
+        os.symlink("/dev/null", fd_dir / "1")  # non-socket fd ignored
+        net = proc / str(pid) / "net"
+        net.mkdir()
+        header = (
+            "  sl  local_address rem_address   st tx_queue rx_queue tr tm->when "
+            "retrnsmt   uid  timeout inode\n"
+        )
+        rows = [
+            f"   0: {hexaddr(saddr, sport)} {hexaddr(daddr, dport)} 01 00000000:00000000 "
+            f"00:00000000 00000000  1000        0 {inode} 1 0 20 10 -1\n",
+            # TIME_WAIT socket must be skipped (st != 01)
+            f"   1: {hexaddr(saddr, 5000)} {hexaddr(daddr, 81)} 06 00000000:00000000 "
+            f"00:00000000 00000000  1000        0 11111 1 0 20 10 -1\n",
+        ]
+        (net / "tcp").write_text(header + "".join(rows))
+        return proc
+
+    def test_backfill_parses_established_only(self, tmp_path):
+        from alaz_tpu.aggregator.procfs import backfill_socket_lines
+        from alaz_tpu.aggregator.sockline import SocketLineStore
+        from alaz_tpu.events.net import ip_to_u32
+
+        proc = self._fixture_proc(tmp_path)
+        store = SocketLineStore()
+        created = backfill_socket_lines(store, proc_root=proc, now_ns=1_000)
+        assert created == 1
+        line = store.get(4242, 7)
+        info = line.get_value(2_000)
+        assert info is not None
+        assert info.saddr == ip_to_u32("10.0.0.1") and info.sport == 4000
+        assert info.daddr == ip_to_u32("10.96.0.1") and info.dport == 80
+
+    def test_l7_joins_with_no_tcp_event_ever(self, tmp_path):
+        from alaz_tpu.aggregator import Aggregator, ClusterInfo
+        from alaz_tpu.datastore.inmem import InMemDataStore
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.events.k8s import (
+            EventType, K8sResourceMessage, Pod, ResourceType, Service,
+        )
+        from alaz_tpu.events.schema import HttpMethod, L7Protocol, make_l7_events, set_payloads
+
+        proc = self._fixture_proc(tmp_path)
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        cluster.handle_msg(K8sResourceMessage(
+            ResourceType.POD, EventType.ADD, Pod(uid="pod-a", name="a", ip="10.0.0.1")
+        ))
+        cluster.handle_msg(K8sResourceMessage(
+            ResourceType.SERVICE, EventType.ADD,
+            Service(uid="svc-x", name="x", cluster_ip="10.96.0.1"),
+        ))
+        ds = InMemDataStore(retain=True)
+        agg = Aggregator(ds, interner=interner, cluster=cluster)
+        assert agg.backfill_from_proc(proc_root=proc, now_ns=1_000) == 1
+
+        ev = make_l7_events(2)
+        ev["pid"], ev["fd"] = 4242, 7
+        ev["write_time_ns"] = 50_000
+        ev["duration_ns"] = 10
+        ev["protocol"], ev["method"], ev["status"] = L7Protocol.HTTP, HttpMethod.GET, 200
+        ev["saddr"] = ev["daddr"] = 0  # V1: no embedded addresses
+        set_payloads(ev, b"GET /cold HTTP/1.1\r\n\r\n")
+        out = agg.process_l7(ev, now_ns=60_000)
+        assert out.shape[0] == 2
+        assert interner.lookup(int(out["from_uid"][0])) == "pod-a"
+        assert interner.lookup(int(out["to_uid"][0])) == "svc-x"
+        assert agg.stats.l7_dropped_no_socket == 0 and agg.pending_retries == 0
