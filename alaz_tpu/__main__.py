@@ -98,8 +98,18 @@ def cmd_serve(args) -> int:
     )
     # pre-existing connections join immediately on restart (reference
     # rebuilds state from /proc; replay configs have no live procfs)
+    containers = None
     if not args.config:
         svc.aggregator.backfill_from_proc()
+        # live container index over CRI when a runtime socket answers
+        # (cri.go:39-73); replay mode has no runtime
+        from alaz_tpu.sources.containers import ContainerIndex
+        from alaz_tpu.sources.cri import CriContainerLister, probe_runtime_socket
+
+        cri_sock = probe_runtime_socket()
+        if cri_sock:
+            containers = ContainerIndex(lister=CriContainerLister(cri_sock))
+            containers.start(svc)
     svc.start()
     debug = DebugServer(svc, port=args.debug_port)
     debug.start()
@@ -133,6 +143,8 @@ def cmd_serve(args) -> int:
     finally:
         if src:
             src.stop()
+        if containers is not None:
+            containers.stop()
         if hc:
             hc.stop()
         debug.stop()

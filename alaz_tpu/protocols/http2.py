@@ -97,6 +97,17 @@ def iter_frames(buf: bytes) -> Iterator[Frame]:
         off += 9 + f.length
 
 
+def build_frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> bytes:
+    """Serialize one frame (the write side of parse_frame_header) — used by
+    the CRI gRPC client, which speaks HTTP/2 over the runtime socket."""
+    return (
+        len(payload).to_bytes(3, "big")
+        + bytes([ftype & 0xFF, flags & 0xFF])
+        + (stream_id & 0x7FFFFFFF).to_bytes(4, "big")
+        + payload
+    )
+
+
 def headers_block(frame: Frame) -> bytes:
     """Strip padding/priority from a HEADERS frame payload → HPACK block."""
     payload = frame.payload

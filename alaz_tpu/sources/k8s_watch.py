@@ -1,24 +1,39 @@
 """Kubernetes metadata source — the k8s/informer.go analog (G19).
 
-Live mode uses the ``kubernetes`` client's list+watch per resource kind
-with periodic full resync (informer.go:47: resync 120s), translating
-watch events into :class:`K8sResourceMessage`. Without a cluster (or the
-client library), the source runs in injected mode: tests and replay push
-messages through ``inject``. Pods additionally fan out one CONTAINER
-message per container (pod.go:48-87).
+Live mode mirrors the reference's 7 SharedInformers (informer.go:67-157):
+per kind, a LIST seeds the state (emitted as UPDATEs), then a WATCH
+stream translates ADDED/MODIFIED/DELETED into EventType.ADD/UPDATE/DELETE
+— so deletions reach the cluster IP maps immediately instead of going
+stale forever, and adds are not up to 2 minutes late. A full re-LIST
+every ``resync_interval_s`` (informer.go:47: 120s) remains the fallback
+for missed watch events. The object→DTO translation layer is pure
+functions over duck-typed client objects, unit-tested with stubs
+(tests/test_sources.py); only the client/connection plumbing needs a
+cluster. Without a cluster (or the client library) the source runs in
+injected mode: tests and replay push messages through ``inject``. Pods
+additionally fan out one CONTAINER message per container (pod.go:48-87).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Iterable, List, Optional
+import time
+from typing import Callable, Iterable, List, Optional
 
 from alaz_tpu.events.k8s import (
+    Address,
+    AddressIP,
     Container,
+    DaemonSet,
+    Deployment,
+    Endpoints,
     EventType,
     K8sResourceMessage,
     Pod,
+    ReplicaSet,
     ResourceType,
+    Service,
+    StatefulSet,
 )
 from alaz_tpu.logging import get_logger
 
@@ -33,6 +48,13 @@ _WATCH_KINDS = (
     ResourceType.DAEMONSET,
     ResourceType.STATEFULSET,
 )
+
+# watch event type → EventType (informer Add/Update/Delete handlers)
+WATCH_EVENT_MAP = {
+    "ADDED": EventType.ADD,
+    "MODIFIED": EventType.UPDATE,
+    "DELETED": EventType.DELETE,
+}
 
 
 def fan_out_containers(msg: K8sResourceMessage) -> List[K8sResourceMessage]:
@@ -52,6 +74,135 @@ def fan_out_containers(msg: K8sResourceMessage) -> List[K8sResourceMessage]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Pure translation layer (client object → DTO) — stub-testable
+# ---------------------------------------------------------------------------
+
+
+def pod_from_obj(pod) -> Pod:
+    return Pod(
+        uid=pod.metadata.uid,
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        ip=(pod.status.pod_ip or "") if pod.status else "",
+        image=(
+            pod.spec.containers[0].image
+            if pod.spec and pod.spec.containers
+            else ""
+        ),
+    )
+
+
+def service_from_obj(svc) -> Service:
+    spec = svc.spec
+    return Service(
+        uid=svc.metadata.uid,
+        name=svc.metadata.name,
+        namespace=svc.metadata.namespace,
+        type=(spec.type or "") if spec else "",
+        cluster_ip=(spec.cluster_ip or "") if spec else "",
+        cluster_ips=list(getattr(spec, "cluster_i_ps", None) or []) if spec else [],
+        ports=[
+            (
+                p.name or "",
+                int(p.port),
+                int(p.target_port or 0) if str(p.target_port or "").isdigit() else 0,
+                p.protocol or "TCP",
+            )
+            for p in ((spec.ports if spec else None) or [])
+        ],
+    )
+
+
+def endpoints_from_obj(ep) -> Endpoints:
+    addresses = []
+    for subset in ep.subsets or []:
+        ips = [
+            AddressIP(
+                type="pod" if a.target_ref and a.target_ref.kind == "Pod" else "external",
+                id=(a.target_ref.uid if a.target_ref else ""),
+                name=(a.target_ref.name if a.target_ref else ""),
+                namespace=ep.metadata.namespace,
+                ip=a.ip,
+            )
+            for a in (subset.addresses or [])
+        ]
+        addresses.append(Address(ips=ips))
+    return Endpoints(
+        uid=ep.metadata.uid,
+        name=ep.metadata.name,
+        namespace=ep.metadata.namespace,
+        addresses=addresses,
+    )
+
+
+def _workload_from_obj(obj, cls):
+    kwargs = dict(
+        uid=obj.metadata.uid, name=obj.metadata.name, namespace=obj.metadata.namespace
+    )
+    if cls in (ReplicaSet, Deployment) and getattr(obj.spec, "replicas", None) is not None:
+        kwargs["replicas"] = int(obj.spec.replicas)
+    return cls(**kwargs)
+
+
+TRANSLATORS: dict[ResourceType, Callable] = {
+    ResourceType.POD: pod_from_obj,
+    ResourceType.SERVICE: service_from_obj,
+    ResourceType.ENDPOINTS: endpoints_from_obj,
+    ResourceType.REPLICASET: lambda o: _workload_from_obj(o, ReplicaSet),
+    ResourceType.DEPLOYMENT: lambda o: _workload_from_obj(o, Deployment),
+    ResourceType.DAEMONSET: lambda o: _workload_from_obj(o, DaemonSet),
+    ResourceType.STATEFULSET: lambda o: _workload_from_obj(o, StatefulSet),
+}
+
+
+def translate_watch_event(kind: ResourceType, raw_event: dict) -> K8sResourceMessage | None:
+    """One watch-stream event → K8sResourceMessage (the informer
+    Add/Update/Delete handler body). Unknown event types (BOOKMARK, ERROR)
+    return None."""
+    etype = WATCH_EVENT_MAP.get(raw_event.get("type", ""))
+    if etype is None:
+        return None
+    obj = raw_event.get("object")
+    if obj is None or getattr(obj, "metadata", None) is None:
+        return None
+    try:
+        dto = TRANSLATORS[kind](obj)
+    except (AttributeError, TypeError, ValueError) as exc:
+        log.warning(f"k8s translate failed for {kind}: {exc}")
+        return None
+    return K8sResourceMessage(kind, etype, dto)
+
+
+def translate_list(kind: ResourceType, items) -> List[K8sResourceMessage]:
+    """A LIST response's items → UPDATE messages (resync semantics)."""
+    out = []
+    for obj in items:
+        msg = translate_watch_event(kind, {"type": "MODIFIED", "object": obj})
+        if msg is not None:
+            out.append(msg)
+    return out
+
+
+def reconcile_list(
+    kind: ResourceType,
+    msgs: List[K8sResourceMessage],
+    known: dict[str, object],
+) -> tuple[List[K8sResourceMessage], dict[str, object]]:
+    """Diff a re-LIST against the previously-known objects and synthesize
+    DELETEs for objects that vanished while the watch was down — the
+    DeltaFIFO Replace semantics of a real informer. Without this, a pod
+    deleted during a watch outage keeps its IP in the cluster maps
+    forever. Returns (delete messages, new known map)."""
+    new_known = {m.object.uid: m.object for m in msgs if getattr(m.object, "uid", "")}
+    deletes = [
+        K8sResourceMessage(kind, EventType.DELETE, dto)
+        for uid, dto in known.items()
+        if uid not in new_known
+    ]
+    return deletes, new_known
+
+
 class K8sWatchSource:
     def __init__(
         self,
@@ -63,7 +214,7 @@ class K8sWatchSource:
         self.resync_interval_s = resync_interval_s
         self.in_cluster = in_cluster
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self._service = None
         self.live = False
 
@@ -91,10 +242,18 @@ class K8sWatchSource:
         except ImportError:
             log.info("kubernetes client unavailable; k8s source in injected mode")
             return
-        self._thread = threading.Thread(target=self._watch_loop, name="alaz-k8s", daemon=True)
-        self._thread.start()
+        listers = self._make_listers()
+        for kind in _WATCH_KINDS:
+            t = threading.Thread(
+                target=self._kind_loop,
+                args=(kind, listers[kind]),
+                name=f"alaz-k8s-{kind.value}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
 
-    def _watch_loop(self) -> None:  # pragma: no cover - needs a cluster
+    def _make_listers(self) -> dict:  # pragma: no cover - needs a cluster
         import kubernetes as k8s  # type: ignore
 
         if self.in_cluster:
@@ -103,99 +262,67 @@ class K8sWatchSource:
             k8s.config.load_kube_config()
         v1 = k8s.client.CoreV1Api()
         apps = k8s.client.AppsV1Api()
+        return {
+            ResourceType.POD: v1.list_pod_for_all_namespaces,
+            ResourceType.SERVICE: v1.list_service_for_all_namespaces,
+            ResourceType.ENDPOINTS: v1.list_endpoints_for_all_namespaces,
+            ResourceType.REPLICASET: apps.list_replica_set_for_all_namespaces,
+            ResourceType.DEPLOYMENT: apps.list_deployment_for_all_namespaces,
+            ResourceType.DAEMONSET: apps.list_daemon_set_for_all_namespaces,
+            ResourceType.STATEFULSET: apps.list_stateful_set_for_all_namespaces,
+        }
+
+    def _kind_loop(self, kind: ResourceType, lister) -> None:  # pragma: no cover - needs a cluster
+        """One informer: LIST (seed + resync, with vanished-object DELETE
+        reconciliation), then WATCH re-established from the last-seen
+        resourceVersion until the resync deadline; only then re-LIST
+        (informer.go:67-157; a LIST is the expensive call, so the stream's
+        30s server timeout must NOT trigger one)."""
+        import kubernetes as k8s  # type: ignore
+
+        known: dict[str, object] = {}
         while not self._stop.is_set():
             try:
-                self._resync_core(v1)
-                self._resync_apps(apps)
+                resp = lister(timeout_seconds=30)
+                msgs = translate_list(kind, resp.items)
+                deletes, known = reconcile_list(kind, msgs, known)
+                for msg in deletes:
+                    self.inject(msg)
+                for msg in msgs:
+                    self.inject(msg)
+                rv = resp.metadata.resource_version
+                deadline = time.monotonic() + self.resync_interval_s
+                while not self._stop.is_set() and time.monotonic() < deadline:
+                    w = k8s.watch.Watch()
+                    try:
+                        for raw in w.stream(
+                            lister, resource_version=rv, timeout_seconds=30
+                        ):
+                            obj = raw.get("object")
+                            new_rv = getattr(
+                                getattr(obj, "metadata", None), "resource_version", None
+                            )
+                            if new_rv:
+                                rv = new_rv
+                            msg = translate_watch_event(kind, raw)
+                            if msg is not None:
+                                uid = getattr(msg.object, "uid", "")
+                                if msg.event_type == EventType.DELETE:
+                                    known.pop(uid, None)
+                                elif uid:
+                                    known[uid] = msg.object
+                                self.inject(msg)
+                            if self._stop.is_set():
+                                break
+                    finally:
+                        w.stop()
+                    # stream timeout: loop re-watches from the last rv
             except Exception as exc:
-                log.warning(f"k8s resync failed: {exc}")
-            self._stop.wait(self.resync_interval_s)
-
-    def _resync_core(self, v1) -> None:  # pragma: no cover - needs a cluster
-        from alaz_tpu.events.k8s import Address, AddressIP, Endpoints, Service
-
-        for pod in v1.list_pod_for_all_namespaces(timeout_seconds=30).items:
-            self.inject(
-                K8sResourceMessage(
-                    ResourceType.POD,
-                    EventType.UPDATE,
-                    Pod(
-                        uid=pod.metadata.uid,
-                        name=pod.metadata.name,
-                        namespace=pod.metadata.namespace,
-                        ip=pod.status.pod_ip or "",
-                        image=(pod.spec.containers[0].image if pod.spec.containers else ""),
-                    ),
-                )
-            )
-        for svc in v1.list_service_for_all_namespaces(timeout_seconds=30).items:
-            self.inject(
-                K8sResourceMessage(
-                    ResourceType.SERVICE,
-                    EventType.UPDATE,
-                    Service(
-                        uid=svc.metadata.uid,
-                        name=svc.metadata.name,
-                        namespace=svc.metadata.namespace,
-                        type=svc.spec.type or "",
-                        cluster_ip=svc.spec.cluster_ip or "",
-                        cluster_ips=list(svc.spec.cluster_i_ps or []),
-                        ports=[
-                            (p.name or "", int(p.port), int(p.target_port or 0) if str(p.target_port or "").isdigit() else 0, p.protocol or "TCP")
-                            for p in (svc.spec.ports or [])
-                        ],
-                    ),
-                )
-            )
-        for ep in v1.list_endpoints_for_all_namespaces(timeout_seconds=30).items:
-            addresses = []
-            for subset in ep.subsets or []:
-                ips = [
-                    AddressIP(
-                        type="pod" if a.target_ref and a.target_ref.kind == "Pod" else "external",
-                        id=(a.target_ref.uid if a.target_ref else ""),
-                        name=(a.target_ref.name if a.target_ref else ""),
-                        namespace=ep.metadata.namespace,
-                        ip=a.ip,
-                    )
-                    for a in (subset.addresses or [])
-                ]
-                addresses.append(Address(ips=ips))
-            self.inject(
-                K8sResourceMessage(
-                    ResourceType.ENDPOINTS,
-                    EventType.UPDATE,
-                    Endpoints(
-                        uid=ep.metadata.uid,
-                        name=ep.metadata.name,
-                        namespace=ep.metadata.namespace,
-                        addresses=addresses,
-                    ),
-                )
-            )
-
-    def _resync_apps(self, apps) -> None:  # pragma: no cover - needs a cluster
-        from alaz_tpu.events.k8s import DaemonSet, Deployment, ReplicaSet, StatefulSet
-
-        kinds = [
-            (apps.list_replica_set_for_all_namespaces, ResourceType.REPLICASET, ReplicaSet),
-            (apps.list_deployment_for_all_namespaces, ResourceType.DEPLOYMENT, Deployment),
-            (apps.list_daemon_set_for_all_namespaces, ResourceType.DAEMONSET, DaemonSet),
-            (apps.list_stateful_set_for_all_namespaces, ResourceType.STATEFULSET, StatefulSet),
-        ]
-        for lister, rtype, cls in kinds:
-            for obj in lister(timeout_seconds=30).items:
-                kwargs = dict(
-                    uid=obj.metadata.uid,
-                    name=obj.metadata.name,
-                    namespace=obj.metadata.namespace,
-                )
-                if cls in (ReplicaSet, Deployment) and getattr(obj.spec, "replicas", None) is not None:
-                    kwargs["replicas"] = int(obj.spec.replicas)
-                self.inject(K8sResourceMessage(rtype, EventType.UPDATE, cls(**kwargs)))
+                log.warning(f"k8s watch {kind.value} failed: {exc}")
+                self._stop.wait(5.0)
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()

@@ -282,3 +282,329 @@ class TestCodeReviewRegressions:
         assert len(c.links) == 10  # bounded
         rows = c.export_rows()
         assert len(rows) == 10 and len(c.links) == 0  # drained
+
+
+class TestK8sWatchTranslation:
+    """Live-path translation (informer.go:67-157 handlers + pod.go:48-87)
+    exercised with stub client objects — no cluster needed."""
+
+    @staticmethod
+    def _stub_pod(uid="pod-1", name="web", ns="default", ip="10.0.0.5", image="nginx:1"):
+        from types import SimpleNamespace as NS
+
+        return NS(
+            metadata=NS(uid=uid, name=name, namespace=ns),
+            status=NS(pod_ip=ip),
+            spec=NS(containers=[NS(image=image)]),
+        )
+
+    @staticmethod
+    def _stub_service(uid="svc-1", name="api", ns="default", cluster_ip="10.96.0.7"):
+        from types import SimpleNamespace as NS
+
+        return NS(
+            metadata=NS(uid=uid, name=name, namespace=ns),
+            spec=NS(
+                type="ClusterIP",
+                cluster_ip=cluster_ip,
+                cluster_i_ps=[cluster_ip],
+                ports=[NS(name="http", port=80, target_port=8080, protocol="TCP")],
+            ),
+        )
+
+    def test_watch_event_type_mapping(self):
+        from alaz_tpu.events.k8s import EventType, ResourceType
+        from alaz_tpu.sources.k8s_watch import translate_watch_event
+
+        pod = self._stub_pod()
+        for raw, expected in (
+            ("ADDED", EventType.ADD),
+            ("MODIFIED", EventType.UPDATE),
+            ("DELETED", EventType.DELETE),
+        ):
+            msg = translate_watch_event(ResourceType.POD, {"type": raw, "object": pod})
+            assert msg is not None and msg.event_type == expected
+            assert msg.object.uid == "pod-1" and msg.object.ip == "10.0.0.5"
+        # BOOKMARK/ERROR and malformed events are ignored
+        assert translate_watch_event(ResourceType.POD, {"type": "BOOKMARK", "object": pod}) is None
+        assert translate_watch_event(ResourceType.POD, {"type": "ADDED"}) is None
+
+    def test_service_and_workload_translation(self):
+        from alaz_tpu.events.k8s import ResourceType
+        from alaz_tpu.sources.k8s_watch import translate_watch_event
+        from types import SimpleNamespace as NS
+
+        msg = translate_watch_event(
+            ResourceType.SERVICE, {"type": "ADDED", "object": self._stub_service()}
+        )
+        assert msg.object.cluster_ip == "10.96.0.7"
+        assert msg.object.ports == [("http", 80, 8080, "TCP")]
+
+        rs = NS(metadata=NS(uid="rs-1", name="web-rs", namespace="default"), spec=NS(replicas=3))
+        msg = translate_watch_event(ResourceType.REPLICASET, {"type": "MODIFIED", "object": rs})
+        assert msg.object.replicas == 3
+
+    def test_endpoints_translation(self):
+        from alaz_tpu.events.k8s import ResourceType
+        from alaz_tpu.sources.k8s_watch import translate_watch_event
+        from types import SimpleNamespace as NS
+
+        ep = NS(
+            metadata=NS(uid="ep-1", name="api", namespace="default"),
+            subsets=[
+                NS(addresses=[
+                    NS(ip="10.0.0.5", target_ref=NS(kind="Pod", uid="pod-1", name="web")),
+                    NS(ip="1.2.3.4", target_ref=None),
+                ])
+            ],
+        )
+        msg = translate_watch_event(ResourceType.ENDPOINTS, {"type": "ADDED", "object": ep})
+        ips = msg.object.addresses[0].ips
+        assert (ips[0].type, ips[0].id) == ("pod", "pod-1")
+        assert ips[1].type == "external"
+
+    def test_list_resync_emits_updates(self):
+        from alaz_tpu.events.k8s import EventType, ResourceType
+        from alaz_tpu.sources.k8s_watch import translate_list
+
+        msgs = translate_list(ResourceType.POD, [self._stub_pod(), self._stub_pod(uid="pod-2")])
+        assert len(msgs) == 2
+        assert all(m.event_type == EventType.UPDATE for m in msgs)
+
+    def test_pod_delete_removes_ip_from_cluster_info(self):
+        """The round-1 gap: a DELETED watch event must reach the cluster
+        IP maps (stale pod→uid attribution otherwise persists forever)."""
+        import numpy as np
+
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.datastore.dto import EP_OUTBOUND, EP_POD
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.events.k8s import ResourceType
+        from alaz_tpu.events.net import ip_to_u32
+        from alaz_tpu.sources.k8s_watch import translate_watch_event
+
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        pod = self._stub_pod()
+        cluster.handle_msg(
+            translate_watch_event(ResourceType.POD, {"type": "ADDED", "object": pod})
+        )
+        ips = np.array([ip_to_u32("10.0.0.5")], dtype=np.uint32)
+        t, u = cluster.attribute(ips)
+        assert t[0] == EP_POD and interner.lookup(int(u[0])) == "pod-1"
+        cluster.handle_msg(
+            translate_watch_event(ResourceType.POD, {"type": "DELETED", "object": pod})
+        )
+        t, _ = cluster.attribute(ips)
+        assert t[0] == EP_OUTBOUND
+
+
+class FakeCriServer:
+    """Minimal CRI gRPC server over a unix socket (HTTP/2 + HPACK via the
+    repo codecs) serving canned ListContainers/ContainerStatus/Version
+    responses — the recorded-fixture integration test for the client."""
+
+    def __init__(self, sock_path, responses):
+        import socket as socketlib
+        import threading
+
+        self.path = str(sock_path)
+        self.responses = responses  # rpc name -> protobuf bytes
+        self._srv = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        self._srv.bind(self.path)
+        self._srv.listen(2)
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        from alaz_tpu.protocols import hpack, http2
+
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                buf = b""
+                while len(buf) < 24:
+                    buf += conn.recv(4096)
+                assert buf[:24] == http2.MAGIC
+                buf = buf[24:]
+                conn.sendall(http2.build_frame(http2.FRAME_SETTINGS, 0, 0))
+                enc, dec = hpack.Encoder(), hpack.Decoder()
+                paths = {}
+                while True:
+                    while True:
+                        if len(buf) >= 9:
+                            ln = int.from_bytes(buf[:3], "big")
+                            if len(buf) >= 9 + ln:
+                                break
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            return
+                        buf += chunk
+                    f = http2.parse_frame_header(buf)
+                    buf = buf[9 + f.length :]
+                    if f.type == http2.FRAME_SETTINGS and not f.flags & 1:
+                        conn.sendall(http2.build_frame(http2.FRAME_SETTINGS, 1, 0))
+                    elif f.type == http2.FRAME_HEADERS:
+                        hdrs = dict(dec.decode(http2.headers_block(f)))
+                        paths[f.stream_id] = hdrs.get(":path", "")
+                    elif f.type == http2.FRAME_DATA and f.flags & http2.FLAG_END_STREAM:
+                        rpc = paths.get(f.stream_id, "").rsplit("/", 1)[-1]
+                        msg = self.responses.get(rpc, b"")
+                        import struct as st
+
+                        grpc_frame = b"\x00" + st.pack("!I", len(msg)) + msg
+                        conn.sendall(
+                            http2.build_frame(
+                                http2.FRAME_HEADERS, http2.FLAG_END_HEADERS, f.stream_id,
+                                enc.encode([(":status", "200"), ("content-type", "application/grpc")]),
+                            )
+                            + http2.build_frame(http2.FRAME_DATA, 0, f.stream_id, grpc_frame)
+                            + http2.build_frame(
+                                http2.FRAME_HEADERS,
+                                http2.FLAG_END_HEADERS | http2.FLAG_END_STREAM,
+                                f.stream_id,
+                                enc.encode([("grpc-status", "0")]),
+                            )
+                        )
+            except (AssertionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+class TestCriClient:
+    def _responses(self):
+        import json
+
+        from alaz_tpu.sources.cri import (
+            LABEL_CONTAINER_NAME, LABEL_POD_NAME, LABEL_POD_NAMESPACE,
+            LABEL_POD_UID, pb_len, pb_str, pb_varint,
+        )
+
+        def label(k, v):
+            return pb_len(8, pb_str(1, k) + pb_str(2, v))
+
+        container = pb_len(
+            1,
+            pb_str(1, "abc123def456")
+            + pb_len(3, pb_str(1, "web"))
+            + label(LABEL_POD_UID, "pod-uid-9")
+            + label(LABEL_POD_NAME, "web-0")
+            + label(LABEL_POD_NAMESPACE, "prod")
+            + label(LABEL_CONTAINER_NAME, "web"),
+        )
+        status = pb_len(1, pb_str(15, "/var/log/pods/prod_web-0/web/0.log")) + pb_len(
+            2, pb_str(1, "info") + pb_str(2, json.dumps({"pid": 4321}))
+        )
+        version = pb_str(2, "fakecri") + pb_str(3, "1.0")
+        return {
+            "ListContainers": container,
+            "ContainerStatus": status,
+            "Version": version,
+        }
+
+    def test_client_roundtrip_over_unix_socket(self, tmp_path):
+        from alaz_tpu.sources.cri import CriClient
+
+        srv = FakeCriServer(tmp_path / "cri.sock", self._responses())
+        try:
+            client = CriClient(str(tmp_path / "cri.sock"), timeout_s=5)
+            assert client.version() == "fakecri 1.0"
+            (c,) = client.list_containers()
+            assert (c.id, c.name, c.pod_uid, c.pod_namespace) == (
+                "abc123def456", "web", "pod-uid-9", "prod",
+            )
+            pid, log_path, _ = client.container_status(c.id)
+            assert pid == 4321
+            assert log_path == "/var/log/pods/prod_web-0/web/0.log"
+            client.close()
+        finally:
+            srv.close()
+
+    def test_probe_finds_answering_socket(self, tmp_path):
+        from alaz_tpu.sources.cri import probe_runtime_socket
+
+        srv = FakeCriServer(tmp_path / "containerd.sock", self._responses())
+        try:
+            found = probe_runtime_socket(
+                [str(tmp_path / "missing.sock"), str(tmp_path / "containerd.sock")],
+                timeout_s=5,
+            )
+            assert found == str(tmp_path / "containerd.sock")
+            assert probe_runtime_socket([str(tmp_path / "missing.sock")]) is None
+        finally:
+            srv.close()
+
+    def test_lister_resolves_pids_via_cgroup_walk(self, tmp_path):
+        from alaz_tpu.sources.containers import ContainerIndex
+        from alaz_tpu.sources.cri import CriContainerLister
+
+        # host-root fixture: main pid 4321 in a v2 cgroup with two pids
+        host = tmp_path / "hostroot"
+        (host / "proc" / "4321").mkdir(parents=True)
+        (host / "proc" / "4321" / "cgroup").write_text("0::/kubepods/pod9\n")
+        cg = host / "sys" / "fs" / "cgroup" / "kubepods" / "pod9"
+        cg.mkdir(parents=True)
+        (cg / "cgroup.procs").write_text("4321\n4322\n")
+
+        srv = FakeCriServer(tmp_path / "cri.sock", self._responses())
+        try:
+            lister = CriContainerLister(
+                str(tmp_path / "cri.sock"), host_root=str(host), timeout_s=5
+            )
+            index = ContainerIndex(lister=lister, exclude_namespaces=("kube-system",))
+            index.sync_once()
+            assert index.get_pids_running_on_containers() == {4321, 4322}
+            info = index.containers["abc123def456"]
+            assert info.namespace == "prod" and info.pod_uid == "pod-uid-9"
+            assert info.log_path.endswith("/var/log/pods/prod_web-0/web/0.log")
+            assert info.log_path.startswith(str(host))
+            lister.close()
+        finally:
+            srv.close()
+
+
+class TestK8sRelistReconciliation:
+    def test_relist_synthesizes_deletes_for_vanished_objects(self):
+        """DeltaFIFO-Replace semantics: a pod deleted while the watch was
+        down must get a synthesized DELETE on the next re-LIST, removing
+        its IP from the cluster maps."""
+        import numpy as np
+
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.datastore.dto import EP_OUTBOUND, EP_POD
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.events.k8s import EventType, ResourceType
+        from alaz_tpu.events.net import ip_to_u32
+        from alaz_tpu.sources.k8s_watch import reconcile_list, translate_list
+
+        stub = TestK8sWatchTranslation._stub_pod
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+
+        msgs = translate_list(ResourceType.POD, [stub(), stub(uid="pod-2", ip="10.0.0.6")])
+        deletes, known = reconcile_list(ResourceType.POD, msgs, {})
+        assert deletes == [] and set(known) == {"pod-1", "pod-2"}
+        for m in msgs:
+            cluster.handle_msg(m)
+        ips = np.array([ip_to_u32("10.0.0.6")], dtype=np.uint32)
+        assert cluster.attribute(ips)[0][0] == EP_POD
+
+        # pod-2 vanished during a watch outage; re-LIST sees only pod-1
+        msgs2 = translate_list(ResourceType.POD, [stub()])
+        deletes2, known2 = reconcile_list(ResourceType.POD, msgs2, known)
+        assert [ (d.event_type, d.object.uid) for d in deletes2 ] == [
+            (EventType.DELETE, "pod-2")
+        ]
+        assert set(known2) == {"pod-1"}
+        for m in deletes2:
+            cluster.handle_msg(m)
+        assert cluster.attribute(ips)[0][0] == EP_OUTBOUND
