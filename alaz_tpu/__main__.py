@@ -10,8 +10,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+
+def _honor_jax_platforms() -> None:
+    """Make JAX_PLATFORMS effective even when a site plugin pre-imported
+    jax (plugin environments register their backend at interpreter start,
+    so the env var alone is too late — force it via config)."""
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if not plat or plat == "axon":
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
 
 
 def _sim_config(path: str | None):
@@ -162,6 +178,7 @@ def cmd_bench(args) -> int:
 
 
 def main(argv=None) -> int:
+    _honor_jax_platforms()
     p = argparse.ArgumentParser(prog="alaz_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 

@@ -40,6 +40,7 @@ EP_CONNECTIONS = "/connections/"
 EP_KAFKA = "/events/kafka/"
 EP_HEALTHCHECK = "/healthcheck/"
 EP_ANOMALIES = "/anomalies/"
+EP_METRICS = "/metrics/scrape/"  # backend.go:504
 _RESOURCE_EP = {
     ResourceType.POD: "/pod/",
     ResourceType.SERVICE: "/svc/",
@@ -125,6 +126,11 @@ class BatchingBackend(BaseDataStore):
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._warned_endpoints: set = set()
+        # metrics scrape-and-push leg (backend.go:340-392): a render
+        # function (Prometheus text) polled every metrics_export_interval_s
+        self._metrics_render: Optional[Callable[[], str]] = None
+        self._metrics_last_push = now
+        self.metrics_pushed = 0
 
     # -- DataStore surface -------------------------------------------------
 
@@ -198,9 +204,39 @@ class BatchingBackend(BaseDataStore):
         with self._lock:
             stream.pending.extend(rows)
 
+    def attach_metrics(self, render_fn: Callable[[], str]) -> None:
+        """Register the metrics source for the scrape-and-push leg — the
+        reference scrapes its embedded exporters and POSTs the Prometheus
+        text to /metrics/scrape/ on a ticker (backend.go:355-392,503-530)."""
+        self._metrics_render = render_fn
+
+    def _push_metrics(self) -> None:
+        endpoint = (
+            f"{EP_METRICS}?instance={self.cfg.node_id}"
+            f"&monitoring_id={self.cfg.monitoring_id}"
+        )
+        try:
+            text = self._metrics_render()
+            status = self.transport(endpoint, {"text": text})
+        except Exception as exc:
+            log.warning(f"metrics push failed: {exc}")
+            return
+        if status < 400:
+            self.metrics_pushed += 1
+        else:
+            log.warning(f"metrics push not success: {status}")
+
     def pump(self, force: bool = False) -> None:
-        """Flush every stream that hit its batch size or cadence."""
+        """Flush every stream that hit its batch size or cadence; push the
+        metrics scrape when its interval elapses."""
         now = self.time_fn()
+        if (
+            self._metrics_render is not None
+            and self.cfg.metrics_export
+            and (force or now - self._metrics_last_push >= self.cfg.metrics_export_interval_s)
+        ):
+            self._metrics_last_push = now
+            self._push_metrics()
         for stream in list(self._streams.values()) + list(self._resource_streams.values()):
             with self._lock:
                 due = (

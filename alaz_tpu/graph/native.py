@@ -347,6 +347,10 @@ class NativeIngest:
         ef[:, 4] = err4 / np.maximum(count, 1.0)
         ef[:, 5] = tls / np.maximum(count, 1.0)
         ef[:, 6] = np.log1p(count / window_s)
+        # slots 7..15: protocol one-hot (matches GraphBuilder; saves a
+        # per-edge embedding gather on device)
+        proto_idx = np.clip(self._proto[:n].astype(np.int64), 0, 8)
+        ef[np.arange(n), 7 + proto_idx] = 1.0
 
         n_nodes = uids.shape[0]
         nf = np.zeros((n_nodes, NODE_FEATURE_DIM), dtype=np.float32)

@@ -79,14 +79,24 @@ def edge_head_init(key, hidden: int, edge_feat_dim: int) -> list[dict]:
     return mlp_init(key, [2 * hidden + edge_feat_dim, hidden, 1])
 
 
-def edge_head(params, h, graph, dtype) -> jnp.ndarray:
-    """Per-edge anomaly logit from [h_src, h_dst, edge_feats]."""
-    z = jnp.concatenate(
-        [
-            h[graph["edge_src"]],
-            h[graph["edge_dst"]],
-            graph["edge_feats"].astype(dtype),
-        ],
-        axis=-1,
-    )
-    return mlp(params, z)[:, 0]
+def edge_head(params, h, graph, dtype, use_pallas: bool | str = False) -> jnp.ndarray:
+    """Per-edge anomaly logit from [h_src, h_dst, edge_feats].
+
+    Computed as the split form of ``mlp(params, concat([h[src], h[dst],
+    ef]))``: the first layer's weight rows are partitioned into
+    (src, dst, ef) blocks, the node-side products run on [N, H] node
+    states *before* the per-edge gathers, and no [E, 2H+F] concat is ever
+    materialized — identical math and identical params, but the E-row
+    matmul (the step's FLOP peak) becomes two N-row matmuls. The dst-side
+    expand additionally rides the sorted-segment Pallas kernel (edges are
+    dst-sorted), dodging a row-op-bound XLA gather."""
+    w1 = params[0]["w"].astype(dtype)
+    hdim = h.shape[-1]
+    u = h @ w1[:hdim]  # [N, H'] src-side projection
+    v = h @ w1[hdim : 2 * hdim]  # [N, H'] dst-side projection
+    efp = graph["edge_feats"].astype(dtype) @ w1[2 * hdim :]
+    from alaz_tpu.ops.segment import expand_dst
+
+    v_e = expand_dst(v, graph["edge_dst"], h.shape[0], use_pallas)
+    z = u[graph["edge_src"]] + v_e + efp + params[0]["b"].astype(dtype)
+    return mlp(params[1:], jax.nn.gelu(z))[:, 0]

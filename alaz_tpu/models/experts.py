@@ -94,7 +94,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
         h = (h + h_new) * node_mask[:, None]
 
-    edge_logits = edge_head(params["edge_head"], h, graph, dtype)
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas)
     node_logits = mlp(params["node_head"], h)[:, 0]
     return {
         "node_h": h,

@@ -1,11 +1,13 @@
-"""GAT with edge-type embeddings — BASELINE.json config 3 (10k-pod mixed
+"""GAT with typed attention — BASELINE.json config 3 (10k-pod mixed
 HTTP/gRPC/Postgres/Kafka edges).
 
 Multi-head additive attention over incoming edges; attention logits are
-conditioned on source, destination, edge features, and the edge-type
-embedding (the reference's per-protocol handler dispatch, SURVEY §2.3 P5,
-re-expressed as typed attention). Per-destination normalization uses
-masked segment softmax.
+conditioned on source, destination, and edge features — which carry the
+protocol one-hot in slots 7..15 (the reference's per-protocol handler
+dispatch, SURVEY §2.3 P5, re-expressed as typed attention; the one-hot
+is folded into edge_feats at build time so no per-edge embedding gather
+runs on device). Per-destination normalization uses masked segment
+softmax with the sorted-expand kernel for its broadcasts.
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ from alaz_tpu.models.common import (
     mlp_init,
     scatter_messages,
 )
-from alaz_tpu.ops.segment import segment_softmax
+from alaz_tpu.ops.segment import expand_dst, segment_softmax
 
 Params = Dict[str, Any]
 
@@ -40,7 +42,6 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     keys = jax.random.split(key, 4 + 6 * cfg.num_layers)
     params: Params = {
         "embed": dense_init(keys[0], cfg.node_feature_dim, h),
-        "type_emb": jax.random.normal(keys[1], (cfg.num_edge_types, h), jnp.float32) * 0.02,
         "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
         "node_head": mlp_init(keys[3], [h, h, 1]),
         "layers": [],
@@ -70,25 +71,34 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     src, dst = graph["edge_src"], graph["edge_dst"]
 
     h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
-    e_type_emb = params["type_emb"].astype(dtype)[graph["edge_type"]]
+    # edge-type conditioning rides the protocol one-hot in edge_feats
+    # slots 7..15 (builder.py), learned through edge_proj — no per-edge
+    # embedding gather (row-op bound on TPU)
     ef = graph["edge_feats"].astype(dtype)
 
     def layer_fn(layer, h):
+        # attention logit = a·[q_dst, kv_src, e_feat] re-associated into
+        # per-node/per-edge partial dot products: the dst-side partial
+        # rides the sorted expand, only the src side stays a row gather
+        attn = layer["attn"].astype(dtype)  # [nh, 3hd]
+        a_q, a_k, a_e = attn[:, :hd], attn[:, hd : 2 * hd], attn[:, 2 * hd :]
         q = dense(layer["q"], h).reshape(n, nh, hd)
         kv = dense(layer["kv"], h).reshape(n, nh, hd)
-        e_feat = (dense(layer["edge_proj"], ef) + e_type_emb).reshape(-1, nh, hd)
+        e_feat = dense(layer["edge_proj"], ef).reshape(-1, nh, hd)
 
-        # additive attention logit per edge per head
-        z = jnp.concatenate([q[dst], kv[src], e_feat], axis=-1)  # [E, nh, 3hd]
-        logits = jnp.einsum(
-            "ehd,hd->eh", z, layer["attn"].astype(dtype)
+        q_part = jnp.einsum("nhd,hd->nh", q, a_q)  # [N, nh]
+        e_part = jnp.einsum("ehd,hd->eh", e_feat, a_e)  # [E, nh]
+        kv_src = kv[src]  # the one irreducible src gather per layer
+        k_src = jnp.einsum("ehd,hd->eh", kv_src, a_k)
+        logits = (
+            expand_dst(q_part, dst, n, cfg.use_pallas) + k_src + e_part
         ).astype(jnp.float32)
         logits = jax.nn.leaky_relu(logits, 0.2)
-        alpha = jax.vmap(
-            lambda lg: segment_softmax(lg, dst, n, mask=edge_mask), in_axes=1, out_axes=1
-        )(logits).astype(dtype)  # [E, nh]
+        alpha = segment_softmax(
+            logits, dst, n, mask=edge_mask, use_pallas=cfg.use_pallas
+        ).astype(dtype)  # [E, nh]
 
-        msgs = ((kv[src] + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
+        msgs = ((kv_src + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
         agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas)
         h_new = dense(layer["out"], agg.astype(dtype))
         return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
@@ -98,7 +108,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     for layer in params["layers"]:
         h = layer_fn(layer, h)
 
-    edge_logits = edge_head(params["edge_head"], h, graph, dtype)
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas)
     node_logits = mlp(params["node_head"], h)[:, 0]
     return {
         "node_h": h,

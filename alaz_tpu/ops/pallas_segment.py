@@ -24,8 +24,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 128  # destination rows per grid step (= MXU width)
-TILE_E = 128  # edges per inner chunk (multiple of 128)
-_DST_ROWS = TILE_E // 128  # dst ids ship as [E/128, 128] rows
+TILE_E = 512  # edges per inner chunk (multiple of 128)
+_DST_ROWS = TILE_E // 128  # 128-edge sub-rows per chunk
 
 
 def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_scratch, sems):
@@ -38,6 +38,11 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
     out_ref[:] = jnp.zeros_like(out_ref)
 
     def make_dmas(slot, c):
+        # One big msgs DMA per chunk; dst ids as _DST_ROWS separate
+        # [1,128] row DMAs (int32 HBM slices tile at (8,128): only
+        # single-row 128-wide slices lower — wider single rows hit the
+        # same dim-0 alignment rejection). TILE_E=512 amortizes the
+        # DMA-issue cost the kernel is actually bound by.
         dmas = [
             pltpu.make_async_copy(
                 msgs_hbm.at[pl.ds(c * TILE_E, TILE_E), :],
@@ -45,8 +50,6 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
                 sems.at[slot, 0],
             )
         ]
-        # int32 HBM slices tile at (8,128): a [k,128] slice with k<8 only
-        # lowers when k==1, so dst ids move as _DST_ROWS separate row DMAs
         for r in range(_DST_ROWS):
             dmas.append(
                 pltpu.make_async_copy(
@@ -84,13 +87,21 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
                 onehot = (
                     dst_local
                     == jax.lax.broadcasted_iota(jnp.int32, (128, TILE_N), 1)
-                ).astype(jnp.float32)
+                ).astype(msg_scratch.dtype)
+                # HIGHEST forces fp32 contract precision, which Mosaic
+                # rejects for bf16 operands; bf16 inputs with an f32
+                # preferred type already accumulate exactly (onehot rows)
+                precision = (
+                    jax.lax.Precision.HIGHEST
+                    if msg_scratch.dtype == jnp.float32
+                    else jax.lax.Precision.DEFAULT
+                )
                 acc = acc + jax.lax.dot_general(
                     onehot,
                     msg_scratch[slot, r * 128 : (r + 1) * 128, :],
                     dimension_numbers=(((0,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST,
+                    precision=precision,
                 )
             out_ref[:] += acc
             return 0
@@ -99,6 +110,8 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
 
 
 def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, interpret: bool = False) -> jnp.ndarray:
+    """msgs may be float32 or bfloat16 — bf16 halves the DMA bytes (the
+    kernel's bound) while the MXU accumulates in f32 either way."""
     e, f = msgs.shape
     assert e % 128 == 0 and num_nodes % TILE_N == 0, (
         f"pad edges/nodes to 128/{TILE_N} multiples (GraphBatch buckets do)"
@@ -125,11 +138,12 @@ def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, in
             (TILE_N, f), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, TILE_E, f), jnp.float32),
+            pltpu.VMEM((2, TILE_E, f), msgs.dtype),
             pltpu.VMEM((2, _DST_ROWS, 128), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 1 + _DST_ROWS)),
         ],
     )
+    itemsize = msgs.dtype.itemsize
     return pl.pallas_call(
         _scatter_kernel,
         out_shape=jax.ShapeDtypeStruct((num_nodes, f), jnp.float32),
@@ -137,7 +151,7 @@ def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, in
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=2 * e * TILE_N * f,
-            bytes_accessed=e * f * 4 + e * 4 + num_nodes * f * 4,
+            bytes_accessed=e * f * itemsize + e * 4 + num_nodes * f * 4,
             transcendentals=0,
         ),
     )(row_start, msgs, dst2d)
@@ -152,7 +166,8 @@ def scatter_sum_sorted(msgs, edge_dst, num_nodes):
 
 def _scatter_fwd_impl(msgs, edge_dst, num_nodes):
     dtype = msgs.dtype
-    msgs = msgs.astype(jnp.float32)
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        msgs = msgs.astype(jnp.float32)
     f = msgs.shape[1]
     f_pad = ((f + 127) // 128) * 128
     if f_pad != f:
@@ -174,6 +189,160 @@ def _scatter_vjp_bwd(num_nodes, residuals, g):
 scatter_sum_sorted.defvjp(_scatter_vjp_fwd, _scatter_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Sorted segment expand: out[e] = v[dst[e]] for dst-SORTED edges.
+#
+# An XLA row gather is row-op bound (~9 ns/row on v5e — measured identical
+# for f32/bf16, sorted/unsorted, and even a 9-row table), so a [1M]-edge
+# gather costs ~9 ms no matter what. For dst-sorted edges the rows needed
+# by each TILE_E-edge chunk lie in the contiguous window
+# [dst[c·T], dst[(c+1)·T]] — DMA 128-row windows of v and expand with a
+# one-hot MXU matmul. Total DMAs ≈ E/TILE_E + N/128 instead of one row op
+# per edge. The op is linear; its VJP is the scatter kernel.
+# ---------------------------------------------------------------------------
+
+
+def _expand_kernel(row_lo_ref, v_hbm, dst_hbm, out_ref, v_scratch, dst_scratch, sems):
+    c = pl.program_id(0)
+    lo = (row_lo_ref[c] // 128) * 128  # align the window start
+    hi = row_lo_ref[c + 1]  # first dst row of the next chunk bounds this one
+    nw = (hi - lo) // 128 + 1
+
+    for r in range(_DST_ROWS):
+        pltpu.make_async_copy(
+            dst_hbm.at[pl.ds(c * _DST_ROWS + r, 1), :],
+            dst_scratch.at[pl.ds(r, 1)],
+            sems.at[2, r],
+        ).start()
+
+    def win_dma(slot, w):
+        return pltpu.make_async_copy(
+            v_hbm.at[pl.ds(lo + w * 128, 128), :],
+            v_scratch.at[slot],
+            sems.at[slot, 0],
+        )
+
+    win_dma(0, 0).start()
+    for r in range(_DST_ROWS):
+        pltpu.make_async_copy(
+            dst_hbm.at[pl.ds(c * _DST_ROWS + r, 1), :],
+            dst_scratch.at[pl.ds(r, 1)],
+            sems.at[2, r],
+        ).wait()
+
+    precision = (
+        jax.lax.Precision.HIGHEST
+        if v_scratch.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(w, _):
+        slot = jax.lax.rem(w, 2)
+
+        @pl.when(w + 1 < nw)
+        def _():
+            win_dma(1 - slot, w + 1).start()
+
+        win_dma(slot, w).wait()
+        win0 = lo + w * 128
+        for r in range(_DST_ROWS):
+            dst_local = dst_scratch[r, :].reshape(128, 1) - win0
+            onehot = (
+                dst_local == jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            ).astype(v_scratch.dtype)
+            contrib = jax.lax.dot_general(
+                onehot,
+                v_scratch[slot],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision,
+            )
+            out_ref[r * 128 : (r + 1) * 128, :] += contrib.astype(out_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, nw, body, 0)
+
+
+def _expand_sorted(v: jnp.ndarray, edge_dst: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    n, f = v.shape
+    e = edge_dst.shape[0]
+    assert e % TILE_E == 0 and n % 128 == 0
+    n_chunks = e // TILE_E
+    dst2d = edge_dst.reshape(e // 128, 128).astype(jnp.int32)
+    # per-chunk window start: first dst of each chunk; the sentinel keeps
+    # the last chunk's window end in range
+    lo = edge_dst[:: TILE_E].astype(jnp.int32)
+    row_lo = jnp.concatenate([lo, jnp.asarray([n - 1], jnp.int32)])
+    row_lo = jnp.minimum(row_lo, n - 128)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM; DMA'd
+            pl.BlockSpec(memory_space=pl.ANY),  # dst ids
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_E, f), lambda c, *_: (c, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 128, f), v.dtype),
+            pltpu.VMEM((_DST_ROWS, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((3, max(2, _DST_ROWS))),
+        ],
+    )
+    return pl.pallas_call(
+        _expand_kernel,
+        out_shape=jax.ShapeDtypeStruct((e, f), v.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * e * 128 * f,
+            bytes_accessed=e * f * v.dtype.itemsize * 2 + e * 4,
+            transcendentals=0,
+        ),
+    )(row_lo, v, dst2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_expand_sorted(v, edge_dst, num_nodes):
+    """out[e] = v[dst[e]] with edges sorted by dst (the GraphBatch
+    layout). ``num_nodes`` rides along for the backward scatter."""
+    return _expand_fwd_impl(v, edge_dst)
+
+
+def _expand_fwd_impl(v, edge_dst):
+    dtype = v.dtype
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        v = v.astype(jnp.float32)
+    f = v.shape[1]
+    f_pad = ((f + 127) // 128) * 128
+    if f_pad != f:
+        v = jnp.pad(v, ((0, 0), (0, f_pad - f)))
+    e = edge_dst.shape[0]
+    e_pad = ((e + TILE_E - 1) // TILE_E) * TILE_E
+    if e_pad != e:
+        edge_dst = jnp.pad(edge_dst, (0, e_pad - e), constant_values=v.shape[0] - 1)
+    interpret = jax.default_backend() != "tpu"
+    out = _expand_sorted(v, edge_dst, interpret=interpret)
+    return out[:e, :f].astype(dtype)
+
+
+def _expand_vjp_fwd(v, edge_dst, num_nodes):
+    return _expand_fwd_impl(v, edge_dst), (edge_dst,)
+
+
+def _expand_vjp_bwd(num_nodes, residuals, g):
+    (edge_dst,) = residuals
+    # dv[d] = Σ_{e: dst[e]=d} g[e] — exactly the dst-sorted scatter
+    return (scatter_sum_sorted(g, edge_dst, num_nodes), None)
+
+
+segment_expand_sorted.defvjp(_expand_vjp_fwd, _expand_vjp_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight=None):
     """out[d] = Σ_{e: dst[e]=d} w[e]·x[src[e]], edges sorted by dst."""
@@ -181,9 +350,11 @@ def pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight=None
 
 
 def _forward(x, edge_src, edge_dst, num_nodes, edge_weight):
-    msgs = x[edge_src].astype(jnp.float32)
+    msgs = x[edge_src]
+    if msgs.dtype not in (jnp.float32, jnp.bfloat16):
+        msgs = msgs.astype(jnp.float32)
     if edge_weight is not None:
-        msgs = msgs * edge_weight[:, None].astype(jnp.float32)
+        msgs = msgs * edge_weight[:, None].astype(msgs.dtype)
     # VMEM slices must be 128-lane aligned: pad the feature dim up
     f = msgs.shape[1]
     f_pad = ((f + 127) // 128) * 128

@@ -9,6 +9,7 @@ snapshot dict for the health/metrics push path.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict
@@ -56,8 +57,19 @@ class Metrics:
     def __init__(self) -> None:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
+        self._infos: Dict[str, Dict[str, str]] = {}
         self._lock = threading.Lock()
         self.started_at = time.time()
+
+    def info(self, name: str, **labels: str) -> None:
+        """Static labeled info metric (the gpu_info/gpu_driver pattern,
+        gpu/collector.go:95-100: a gauge fixed at 1 carrying labels)."""
+        with self._lock:
+            self._infos[name] = dict(labels)
+
+    def infos(self) -> Dict[str, Dict[str, str]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._infos.items()}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -91,14 +103,21 @@ class Metrics:
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
+        for name, labels in sorted(self.infos().items()):
+            metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
+            label_str = ",".join(
+                f'{k}="{v}"' for k, v in sorted(labels.items())
+            )
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric}{{{label_str}}} 1")
         return "\n".join(lines) + "\n"
 
 
 def host_gauges(metrics: Metrics) -> None:
-    """Node metrics (the embedded node_exporter scrape analog,
-    backend.go:1038-1105): process RSS, host memory, load average from
-    /proc — pushed with the health payload like the reference pushes its
-    scrape."""
+    """Node metrics — the embedded node_exporter scrape analog
+    (backend.go:1038-1105): process, memory, load, cpu, network, disk and
+    fd gauges from /proc, pushed to the backend via the metrics-scrape
+    leg and with the health payload."""
 
     def rss_bytes() -> float:
         try:
@@ -120,29 +139,130 @@ def host_gauges(metrics: Metrics) -> None:
             pass
         return 0.0
 
-    def load1() -> float:
+    def loadavg(idx: int) -> float:
         try:
-            return float(open("/proc/loadavg").read().split()[0])
+            return float(open("/proc/loadavg").read().split()[idx])
+        except OSError:
+            return 0.0
+
+    def stat_field(prefix: str, idx: int, scale: float = 1.0) -> float:
+        """One numeric column of a /proc/stat line (cpu jiffies → seconds
+        via USER_HZ=100, the node_exporter cpu collector fields)."""
+        try:
+            with open("/proc/stat") as f:
+                for line in f:
+                    if line.startswith(prefix + " ") or line.startswith(prefix + "  "):
+                        return float(line.split()[idx]) * scale
+        except OSError:
+            pass
+        return 0.0
+
+    def net_bytes(col: int) -> float:
+        """Sum of rx (col 1) / tx (col 9) bytes over non-loopback
+        interfaces (/proc/net/dev; the netdev collector)."""
+        total = 0.0
+        try:
+            with open("/proc/net/dev") as f:
+                for line in f.readlines()[2:]:
+                    name, _, rest = line.partition(":")
+                    if name.strip() == "lo":
+                        continue
+                    cols = rest.split()
+                    if len(cols) > col:
+                        total += float(cols[col])
+        except OSError:
+            return 0.0
+        return total
+
+    def disk(field: str) -> float:
+        try:
+            st = os.statvfs("/")
+        except OSError:
+            return 0.0
+        if field == "total":
+            return float(st.f_blocks * st.f_frsize)
+        return float((st.f_blocks - st.f_bfree) * st.f_frsize)
+
+    def open_fds() -> float:
+        try:
+            return float(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            return 0.0
+
+    def boot_uptime() -> float:
+        try:
+            return float(open("/proc/uptime").read().split()[0])
         except OSError:
             return 0.0
 
     metrics.gauge("host.process_rss_bytes", rss_bytes)
     metrics.gauge("host.mem_available_bytes", lambda: meminfo("MemAvailable"))
-    metrics.gauge("host.load1", load1)
+    metrics.gauge("host.mem_total_bytes", lambda: meminfo("MemTotal"))
+    metrics.gauge("host.load1", lambda: loadavg(0))
+    metrics.gauge("host.load5", lambda: loadavg(1))
+    metrics.gauge("host.load15", lambda: loadavg(2))
+    metrics.gauge("host.cpu_user_s", lambda: stat_field("cpu", 1, 0.01))
+    metrics.gauge("host.cpu_system_s", lambda: stat_field("cpu", 3, 0.01))
+    metrics.gauge("host.cpu_idle_s", lambda: stat_field("cpu", 4, 0.01))
+    metrics.gauge("host.context_switches", lambda: stat_field("ctxt", 1))
+    metrics.gauge("host.procs_running", lambda: stat_field("procs_running", 1))
+    metrics.gauge("host.net_rx_bytes", lambda: net_bytes(0))
+    metrics.gauge("host.net_tx_bytes", lambda: net_bytes(8))
+    metrics.gauge("host.disk_used_bytes", lambda: disk("used"))
+    metrics.gauge("host.disk_total_bytes", lambda: disk("total"))
+    metrics.gauge("host.open_fds", open_fds)
+    metrics.gauge("host.boot_uptime_s", boot_uptime)
+
+
+# memory_stats keys exported per device when the runtime provides them —
+# the TPU-side analog of the NVML total/used/free/bar1 memory gauges
+_DEVICE_MEM_KEYS = (
+    ("bytes_in_use", "hbm_bytes_in_use"),
+    ("peak_bytes_in_use", "hbm_peak_bytes_in_use"),
+    ("bytes_limit", "hbm_bytes_limit"),
+    ("bytes_reservable_limit", "hbm_bytes_reservable_limit"),
+    ("largest_free_block_bytes", "hbm_largest_free_block_bytes"),
+    ("largest_alloc_size", "hbm_largest_alloc_bytes"),
+    ("num_allocs", "num_allocs"),
+    ("pool_bytes", "pool_bytes"),
+)
 
 
 def device_gauges(metrics: Metrics) -> None:
-    """Register accelerator gauges (the gpu/ NVML collector analog,
-    SURVEY §2.2 G22): per-device HBM usage from the JAX runtime."""
+    """Accelerator gauges (the gpu/ NVML collector analog, SURVEY §2.2
+    G22, ~19 gauges): per-device memory-stat gauges, an HBM-utilization
+    percentage (the mem_utz analog), and device identity info (the
+    gpu_info/gpu_driver analog). Power/clock/fan have no TPU runtime
+    surface here; the compute-side utilization analog is the scorer
+    duty-cycle gauge the service registers."""
     try:
         import jax
 
         for i, dev in enumerate(jax.local_devices()):
-            def mem_fn(d=dev):
-                stats = d.memory_stats() or {}
-                return stats.get("bytes_in_use", 0)
+            for stat_key, gauge_name in _DEVICE_MEM_KEYS:
+                def mem_fn(d=dev, k=stat_key):
+                    stats = d.memory_stats() or {}
+                    return stats.get(k, 0)
 
-            metrics.gauge(f"device{i}.hbm_bytes_in_use", mem_fn)
+                metrics.gauge(f"device{i}.{gauge_name}", mem_fn)
+
+            def utz_fn(d=dev):
+                stats = d.memory_stats() or {}
+                limit = stats.get("bytes_limit", 0)
+                return 100.0 * stats.get("bytes_in_use", 0) / limit if limit else 0.0
+
+            metrics.gauge(f"device{i}.hbm_utilization_pct", utz_fn)
+            metrics.info(
+                f"device{i}.info",
+                kind=getattr(dev, "device_kind", "unknown"),
+                platform=getattr(dev, "platform", "unknown"),
+                id=str(getattr(dev, "id", i)),
+            )
         metrics.gauge("device.count", lambda: len(jax.local_devices()))
+        metrics.info(
+            "device.runtime",
+            backend=jax.default_backend(),
+            jax_version=jax.__version__,
+        )
     except Exception:  # no accelerator runtime present
         pass

@@ -14,6 +14,7 @@ Pause/resume hooks match the health checker's stop/resume protocol.
 from __future__ import annotations
 
 import threading
+import time as time_module
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional
 
@@ -202,6 +203,18 @@ class Service:
         self.metrics.gauge(
             "ingest.acc_dropped", lambda: getattr(self.graph_store, "acc_dropped", 0)
         )
+        # the TPU analog of the NVML gpu_utz gauge: fraction of wall time
+        # the scorer spends in device compute (includes host→device feed)
+        self._scorer_busy_s = 0.0
+        self.metrics.gauge(
+            "scorer.duty_cycle_pct",
+            lambda: 100.0
+            * self._scorer_busy_s
+            / max(time_module.time() - self.metrics.started_at, 1e-9),
+        )
+        # metrics scrape-and-push leg (backend.go:340-392,1038-1105)
+        if export_backend is not None and hasattr(export_backend, "attach_metrics"):
+            export_backend.attach_metrics(self.metrics.render_prometheus)
 
     # -- ingestion surface (what sources call) ------------------------------
 
@@ -296,9 +309,11 @@ class Service:
                 (batch,) = item
                 if self._score_fn is None or self.model_state is None:
                     continue
+                t0 = time_module.perf_counter()
                 graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
                 out = self._score_fn(self.model_state, graph)
                 logits = np.asarray(out["edge_logits"])
+                self._scorer_busy_s += time_module.perf_counter() - t0
                 self.scored_batches += 1
                 self.scored_edges += batch.n_edges
                 self.metrics.counter("scored.edges").inc(batch.n_edges)

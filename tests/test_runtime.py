@@ -369,3 +369,71 @@ class TestColumnarScoreLeg:
         (ep, payload), = [c for c in calls if c[0] == "/anomalies/"]
         assert payload["data"][0][:4] == [7000, "pod-a", "svc-b", "HTTP"]
         assert abs(payload["data"][1][4] - 0.8) < 1e-6
+
+
+class TestMetricsDepth:
+    def test_host_gauges_node_exporter_subset(self):
+        from alaz_tpu.runtime.metrics import Metrics, host_gauges
+
+        m = Metrics()
+        host_gauges(m)
+        snap = m.snapshot()
+        expected = [
+            "host.process_rss_bytes", "host.mem_available_bytes",
+            "host.mem_total_bytes", "host.load1", "host.load5", "host.load15",
+            "host.cpu_user_s", "host.cpu_system_s", "host.cpu_idle_s",
+            "host.context_switches", "host.procs_running",
+            "host.net_rx_bytes", "host.net_tx_bytes",
+            "host.disk_used_bytes", "host.disk_total_bytes",
+            "host.open_fds", "host.boot_uptime_s",
+        ]
+        for name in expected:
+            assert name in snap, name
+        # live procfs: these must be real numbers on linux
+        assert snap["host.mem_total_bytes"] > 0
+        assert snap["host.cpu_user_s"] > 0
+        assert snap["host.open_fds"] > 0
+
+    def test_device_gauges_and_info(self):
+        from alaz_tpu.runtime.metrics import Metrics, device_gauges
+
+        m = Metrics()
+        device_gauges(m)
+        snap = m.snapshot()
+        assert snap.get("device.count", 0) >= 1
+        assert "device0.hbm_bytes_in_use" in snap
+        assert "device0.hbm_utilization_pct" in snap
+        infos = m.infos()
+        assert "device.runtime" in infos and "jax_version" in infos["device.runtime"]
+        text = m.render_prometheus()
+        assert "alaz_tpu_device_runtime{" in text
+
+    def test_metrics_push_leg(self):
+        from alaz_tpu.config import BackendConfig
+        from alaz_tpu.datastore.backend import BatchingBackend
+        from alaz_tpu.runtime.metrics import Metrics
+
+        calls = []
+        clock = {"t": 0.0}
+        be = BatchingBackend(
+            lambda ep, payload: (calls.append((ep, payload)), 200)[1],
+            Interner(),
+            BackendConfig(metrics_export=True, metrics_export_interval_s=10.0,
+                          node_id="node-7", monitoring_id="mon-1"),
+            time_fn=lambda: clock["t"],
+        )
+        m = Metrics()
+        m.gauge("x").set(42.0)
+        be.attach_metrics(m.render_prometheus)
+        be.pump()  # interval not elapsed, no push
+        assert not calls
+        clock["t"] = 11.0
+        be.pump()
+        (ep, payload), = calls
+        assert ep.startswith("/metrics/scrape/?instance=node-7")
+        assert "alaz_tpu_x 42.0" in payload["text"]
+        assert be.metrics_pushed == 1
+
+    def test_scorer_duty_cycle_gauge_registered(self):
+        svc = Service(interner=Interner())
+        assert "scorer.duty_cycle_pct" in svc.metrics.snapshot()
