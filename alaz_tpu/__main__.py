@@ -92,6 +92,10 @@ def cmd_serve(args) -> int:
     from alaz_tpu.sources.replay import ReplaySource
 
     cfg = RuntimeConfig.from_env()
+    if not args.config:
+        # no replay source: events come from agents on THIS node, so pids
+        # are local — the procfs backfill and zombie reaper apply
+        cfg.local_pids = True
     interner = Interner()
     params = None
     if args.ckpt:
@@ -115,7 +119,7 @@ def cmd_serve(args) -> int:
     # pre-existing connections join immediately on restart (reference
     # rebuilds state from /proc; replay configs have no live procfs)
     containers = None
-    if not args.config:
+    if cfg.local_pids:
         svc.aggregator.backfill_from_proc()
         # live container index over CRI when a runtime socket answers
         # (cri.go:39-73); replay mode has no runtime

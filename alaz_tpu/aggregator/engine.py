@@ -49,6 +49,7 @@ from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import K8sResourceMessage
 from alaz_tpu.events.net import u32_to_ip
 from alaz_tpu.events.schema import (
+    PROC_EVENT_DTYPE,
     AmqpMethod,
     Http2Method,
     L7Protocol,
@@ -227,6 +228,32 @@ class Aggregator:
         out["from_type"], out["from_uid"] = ft, fu
         out["to_type"], out["to_uid"] = tt, tu
         self.ds.persist_alive_connections(out)
+
+    def reap_zombies(self, kill_fn=None) -> list[int]:
+        """Probe every tracked pid with signal 0 and tear down the state
+        of processes that died without an EXIT event — the 2-minute
+        zombie reaper (data.go:192-219). ``kill_fn`` is injectable for
+        tests; defaults to os.kill."""
+        import os as os_mod
+
+        if kill_fn is None:
+            kill_fn = os_mod.kill
+        dead: list[int] = []
+        for pid in list(self.live_pids):
+            try:
+                kill_fn(pid, 0)
+            except ProcessLookupError:
+                dead.append(pid)
+            except PermissionError:
+                pass  # exists but owned elsewhere: alive
+            except OSError:
+                pass
+        if dead:
+            ev = np.zeros(len(dead), dtype=PROC_EVENT_DTYPE)
+            ev["pid"] = dead
+            ev["type"] = ProcEventType.EXIT
+            self.process_proc(ev)
+        return dead
 
     # ------------------------------------------------------------------
     # Proc events

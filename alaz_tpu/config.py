@@ -170,6 +170,10 @@ class ModelConfig:
     dtype: str = "bfloat16"
     use_pallas: bool = True
     remat: bool = False  # jax.checkpoint each GNN layer (FLOPs for memory)
+    # tgn only: pre-size node memory to the largest expected bucket so a
+    # growing fleet doesn't pay a serving-time recompile per
+    # (bucket, memory-shape) pair
+    tgn_max_nodes: int = 4096
 
     @classmethod
     def from_env(cls) -> "ModelConfig":
@@ -179,6 +183,7 @@ class ModelConfig:
             num_layers=env_int("NUM_LAYERS", 2),
             use_pallas=env_bool("USE_PALLAS", True),
             remat=env_bool("REMAT", False),
+            tgn_max_nodes=env_int("TGN_MAX_NODES", 4096),
         )
 
 
@@ -216,6 +221,10 @@ class RuntimeConfig:
     k8s_enabled: bool = True
     exclude_namespaces: str = ""
     send_alive_tcp_connections: bool = False
+    # True only when tracked pids are processes of THIS host (live-agent
+    # mode): gates the kill(pid,0) zombie reaper — replayed/remote pids
+    # must never be probed against the service host's process table
+    local_pids: bool = False
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -228,4 +237,5 @@ class RuntimeConfig:
             k8s_enabled=env_bool("K8S_COLLECTOR_ENABLED", True),
             exclude_namespaces=env_str("EXCLUDE_NAMESPACES", ""),
             send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
+            local_pids=env_bool("LOCAL_PIDS", False),
         )

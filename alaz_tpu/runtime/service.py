@@ -167,10 +167,14 @@ class Service:
 
                 from alaz_tpu.models import tgn
 
-                self._tgn_memory = tgn.init_memory(self.config.model, max_nodes=128)
+                # pre-size memory to the largest configured bucket so a
+                # growing fleet never pays a serving-time recompile for a
+                # new (bucket, memory-shape) pair (tgn.step still
+                # zero-extends as a fallback if the bucket outgrows it)
+                self._tgn_memory = tgn.init_memory(
+                    self.config.model, max_nodes=self.config.model.tgn_max_nodes
+                )
                 cfg = self.config.model
-                # tgn.step zero-extends memory internally when the node
-                # bucket grows; one compile per (bucket, memory-shape) pair
                 jitted_step = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
 
                 def tgn_score(params, graph):
@@ -287,6 +291,12 @@ class Service:
                 # timer-driven retry flush: requeued events must not wait
                 # for the next L7 batch to arrive (input lulls)
                 self._flush_retries_counted()
+                # zombie reaper: processes that died without an EXIT event
+                # (the kill(pid,0) sweep, data.go:192-219). Valid ONLY when
+                # tracked pids belong to this host — replayed/remote pids
+                # would all look dead and lose their join state.
+                if self.config.local_pids:
+                    self.aggregator.reap_zombies()
                 # channel-lag log (data.go:177-186 cadence)
                 lag = {
                     q.name: q.stats()

@@ -67,6 +67,11 @@ class TlsAttachTracker:
         self.on_attach = on_attach
         self.proc_root = Path(proc_root)
         self.attached: Dict[int, dict] = {}
+        # pids whose exe was already checked and is NOT a Go TLS user: a
+        # process's binary never gains buildinfo later, so the negative
+        # result is permanent (unlike libssl, which can dlopen late) —
+        # without this every retried signal re-reads a up-to-200MB exe
+        self._not_go: set[int] = set()
         self._lock = threading.Lock()
 
     def signal(self, pid: int) -> bool:
@@ -93,6 +98,7 @@ class TlsAttachTracker:
     def detach(self, pid: int) -> None:
         with self._lock:
             self.attached.pop(pid, None)
+            self._not_go.discard(pid)  # a reused pid is a different exe
 
     def _discover(self, pid: int) -> dict:
         maps_path = self.proc_root / str(pid) / "maps"
@@ -101,7 +107,26 @@ class TlsAttachTracker:
         except OSError:
             return {}
         lib = find_ssl_lib(text)
-        if lib is None:
+        if lib is not None:
+            lib["family"] = ssl_version_family(lib["version"])
+            return lib
+        # no libssl mapped: maybe a Go binary using crypto/tls — resolve
+        # the uprobe plan from the executable's ELF (collector.go:319-516)
+        with self._lock:
+            if pid in self._not_go:
+                return {}
+        from alaz_tpu.sources.gotls import discover_go_tls
+
+        exe = self.proc_root / str(pid) / "exe"
+        plan = discover_go_tls(exe) if exe.exists() else None
+        if plan is None:
+            with self._lock:
+                self._not_go.add(pid)
             return {}
-        lib["family"] = ssl_version_family(lib["version"])
-        return lib
+        return {
+            "path": str(exe),
+            "version": plan.go_version,
+            "deleted": False,
+            "family": "go-tls",
+            "plan": plan,
+        }

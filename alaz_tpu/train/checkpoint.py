@@ -12,6 +12,16 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from alaz_tpu.logging import get_logger
+
+log = get_logger("alaz_tpu.checkpoint")
+
+# Bump when the model's parameter/feature contract changes incompatibly.
+# v2: edge-type embeddings moved into edge-feature one-hot slots 7..15
+# (type_emb removed; edge_proj rows 7..15 now carry learned type offsets)
+# — restoring a v1 checkpoint would silently inject untrained weights.
+SCHEMA_VERSION = 2
+
 
 def _manager(directory: str | Path, max_to_keep: int = 3):
     import orbax.checkpoint as ocp
@@ -32,7 +42,7 @@ def save(
 ) -> None:
     import orbax.checkpoint as ocp
 
-    state = {"params": params}
+    state = {"params": params, "schema_version": np.int64(SCHEMA_VERSION)}
     if opt_state is not None:
         state["opt_state"] = opt_state
     if memory is not None:
@@ -53,7 +63,16 @@ def restore(directory: str | Path, step: Optional[int] = None) -> tuple[int, dic
         if target is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
         state = mgr.restore(target)
-        return int(target), jax.tree.map(np.asarray, state)
+        state = jax.tree.map(np.asarray, state)
+        found = int(state.pop("schema_version", 1))
+        if found != SCHEMA_VERSION:
+            raise ValueError(
+                f"checkpoint {directory} has schema v{found}, this build "
+                f"needs v{SCHEMA_VERSION} (the model feature contract "
+                "changed — retrain or convert; restoring would silently "
+                "degrade scores)"
+            )
+        return int(target), state
     finally:
         mgr.close()
 

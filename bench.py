@@ -238,6 +238,9 @@ def bench_e2e(args) -> dict:
 
 
 def main() -> None:
+    from alaz_tpu.__main__ import _honor_jax_platforms
+
+    _honor_jax_platforms()  # JAX_PLATFORMS=cpu must win over site plugins
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="graphsage",
                    choices=["graphsage", "gat", "experts", "tgn"])

@@ -648,3 +648,43 @@ class TestRetryTimerDriven:
         out = agg.flush_retries(now_ns=10_000 + 50_000_000)
         assert out is not None and out.shape[0] == 3
         assert agg.pending_retries == 0
+
+
+class TestZombieReaper:
+    def test_dead_pids_torn_down(self):
+        """kill(pid,0) sweep (data.go:192-219): a process that died
+        without an EXIT event loses its socket lines, h2 state, and stmt
+        caches."""
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner,
+                         cluster=make_cluster(interner))
+        _establish(agg, pid=100, fd=7)
+        _establish(agg, pid=200, fd=8)
+        agg.h2.feed(100, 7, True, b"", 1000)
+        agg.pg_stmts[(100, 7, "s")] = "SELECT 1"
+        alive = {200}
+
+        def fake_kill(pid, sig):
+            assert sig == 0
+            if pid not in alive:
+                raise ProcessLookupError
+
+        dead = agg.reap_zombies(kill_fn=fake_kill)
+        assert dead == [100]
+        assert 100 not in agg.live_pids and 200 in agg.live_pids
+        assert agg.socket_lines.get(100, 7) is None
+        assert agg.socket_lines.get(200, 8) is not None
+        assert agg.h2.conn_count() == 0
+        assert agg.pg_stmts == {}
+
+    def test_permission_error_means_alive(self):
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner,
+                         cluster=make_cluster(interner))
+        _establish(agg, pid=300, fd=9)
+
+        def fake_kill(pid, sig):
+            raise PermissionError  # exists, owned by another user
+
+        assert agg.reap_zombies(kill_fn=fake_kill) == []
+        assert 300 in agg.live_pids

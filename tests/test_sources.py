@@ -608,3 +608,114 @@ class TestK8sRelistReconciliation:
         for m in deletes2:
             cluster.handle_msg(m)
         assert cluster.attribute(ips)[0][0] == EP_OUTBOUND
+
+
+GO_FIXTURE_ASM = r"""
+.section .go.buildinfo,"a"
+.byte 0xff
+.ascii " Go buildinf:"
+.byte 8
+.byte 2
+.zero 16
+.byte 8
+.ascii "go1.21.5"
+
+.text
+.globl "crypto/tls.(*Conn).Write"
+.type "crypto/tls.(*Conn).Write",@function
+"crypto/tls.(*Conn).Write":
+    nop
+    ret
+.size "crypto/tls.(*Conn).Write", .-"crypto/tls.(*Conn).Write"
+
+.globl "crypto/tls.(*Conn).Read"
+.type "crypto/tls.(*Conn).Read",@function
+"crypto/tls.(*Conn).Read":
+    nop
+    cmpq $0, %rdi
+    je 1f
+    movl $0xc3c3c3c3, %eax
+    ret
+1:  nop
+    ret
+.size "crypto/tls.(*Conn).Read", .-"crypto/tls.(*Conn).Read"
+"""
+
+
+def _build_go_fixture(tmp_path):
+    import platform
+    import subprocess
+
+    if platform.machine() != "x86_64":
+        pytest.skip("x86_64 fixture")
+    src = tmp_path / "fixture.s"
+    src.write_text(GO_FIXTURE_ASM)
+    out = tmp_path / "gofixture"
+    r = subprocess.run(
+        ["gcc", "-shared", "-nostdlib", str(src), "-o", str(out)],
+        capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"toolchain unavailable: {r.stderr[-200:]}")
+    return out
+
+
+class TestGoTlsDiscovery:
+    """G4: ELF symbol + buildinfo + RET-offset discovery for Go TLS
+    uprobes (collector.go:319-516; uretprobes crash Go, so every RET of
+    Read gets its own exit probe)."""
+
+    def test_full_plan(self, tmp_path):
+        from alaz_tpu.sources.gotls import (
+            GO_READ_SYMBOL, GO_WRITE_SYMBOL, discover_go_tls,
+        )
+
+        exe = _build_go_fixture(tmp_path)
+        plan = discover_go_tls(exe)
+        assert plan is not None
+        assert plan.go_version == "go1.21.5"
+        assert plan.write.name == GO_WRITE_SYMBOL and plan.write.size > 0
+        assert plan.read.name == GO_READ_SYMBOL
+        # two real RETs; the 0xc3 bytes inside the mov immediate must NOT
+        # be counted (that is why a disassembler, not a byte scan)
+        assert len(plan.read_ret_offsets) == 2
+        data = exe.read_bytes()
+        for off in plan.read_ret_offsets:
+            assert data[off] == 0xC3
+            assert plan.read.file_offset <= off < plan.read.file_offset + plan.read.size
+
+    def test_old_go_rejected(self, tmp_path):
+        from alaz_tpu.sources.gotls import discover_go_tls
+
+        exe = _build_go_fixture(tmp_path)
+        patched = tmp_path / "oldgo"
+        patched.write_bytes(exe.read_bytes().replace(b"go1.21.5", b"go1.16.9"))
+        assert discover_go_tls(patched) is None
+
+    def test_non_go_binary_rejected(self, tmp_path):
+        from alaz_tpu.sources.gotls import discover_go_tls, go_build_version
+
+        not_go = tmp_path / "notgo"
+        not_go.write_bytes(b"\x7fELF" + b"\x00" * 100)
+        assert go_build_version(not_go) is None
+        assert discover_go_tls(not_go) is None
+
+    def test_tracker_falls_back_to_go_tls(self, tmp_path):
+        from alaz_tpu.sources.tlsattach import TlsAttachTracker
+
+        exe = _build_go_fixture(tmp_path)
+        pid_dir = tmp_path / "proc" / "321"
+        pid_dir.mkdir(parents=True)
+        (pid_dir / "maps").write_text("00400000-00452000 r-xp 0 08:02 1 /usr/bin/app\n")
+        import shutil
+
+        shutil.copy(exe, pid_dir / "exe")
+        attached = []
+        tr = TlsAttachTracker(
+            on_attach=lambda pid, info: attached.append((pid, info)),
+            proc_root=tmp_path / "proc",
+        )
+        assert tr.signal(321)
+        ((pid, info),) = attached
+        assert pid == 321 and info["family"] == "go-tls"
+        assert info["plan"].read_ret_offsets
