@@ -5,8 +5,10 @@
                   sharded train/score steps (DP over windows, TP over
                   hidden dims; XLA inserts the collectives)
 - ``halo``      — ring halo exchange for node-sharded graphs (SP/CP)
+- ``gpipe``     — GPipe microbatch pipeline via ppermute hops (PP)
 """
 
+from alaz_tpu.parallel.gpipe import make_pipeline
 from alaz_tpu.parallel.mesh import make_mesh, mesh_shape_for
 from alaz_tpu.parallel.sharding import (
     graph_pspec,
@@ -16,6 +18,7 @@ from alaz_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "make_pipeline",
     "make_mesh",
     "mesh_shape_for",
     "graph_pspec",
