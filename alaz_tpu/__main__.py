@@ -63,19 +63,51 @@ def cmd_train(args) -> int:
     from alaz_tpu.replay.scenario import run_anomaly_scenario
     from alaz_tpu.train import checkpoint
     from alaz_tpu.train.metrics import auroc
-    from alaz_tpu.train.trainstep import make_score_fn, score_batch, train_on_batches
+    from alaz_tpu.train.trainstep import (
+        make_score_fn,
+        score_batch,
+        train_on_batches,
+        train_tgn_unrolled,
+    )
 
     sim_cfg = _sim_config(args.config)
     cfg = ModelConfig(model=args.model)
     data = run_anomaly_scenario(sim_cfg, n_windows=args.windows, fault_fraction=0.15, seed=args.seed)
-    state, losses = train_on_batches(cfg, data.train, epochs=args.epochs)
-    fn = make_score_fn(cfg)
+    if args.model == "tgn":
+        # temporal model: unroll windows with memory threaded so the
+        # GRU/memory params train (epochs here = unrolled update steps)
+        state, losses = train_tgn_unrolled(
+            cfg, data.train, epochs=max(args.epochs * 3, 20)
+        )
+    else:
+        state, losses = train_on_batches(cfg, data.train, epochs=args.epochs)
     scores, labels, masks = [], [], []
-    for b in data.eval:
-        out = score_batch(cfg, state.params, b, fn)
-        scores.append(out["edge_logits"])
-        labels.append(b.edge_label)
-        masks.append(b.edge_mask)
+    if args.model == "tgn":
+        # stream chronologically with memory threaded (service semantics)
+        import jax
+        import jax.numpy as jnp
+
+        from alaz_tpu.models import tgn
+
+        mem = tgn.init_memory(
+            cfg, max(cfg.tgn_max_nodes, max(b.n_pad for b in data.all_batches))
+        )
+        jstep = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
+        eval_ids = {id(b) for b in data.eval}
+        for b in data.all_batches:
+            g = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+            out, mem = jstep(state.params, g, mem)
+            if id(b) in eval_ids:
+                scores.append(np.asarray(out["edge_logits"]))
+                labels.append(b.edge_label)
+                masks.append(b.edge_mask)
+    else:
+        fn = make_score_fn(cfg)
+        for b in data.eval:
+            out = score_batch(cfg, state.params, b, fn)
+            scores.append(out["edge_logits"])
+            labels.append(b.edge_label)
+            masks.append(b.edge_mask)
     a = auroc(np.concatenate(scores), np.concatenate(labels), np.concatenate(masks))
     if args.ckpt:
         checkpoint.save(args.ckpt, step=state.step, params=state.params)

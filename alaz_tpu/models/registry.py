@@ -13,7 +13,8 @@ def get_model(name: str):
     if name == "tgn":
         from alaz_tpu.models import tgn
 
-        return tgn.init, tgn.step
+        # 3-arg apply (cold memory); temporal callers use tgn.step directly
+        return tgn.init, tgn.apply
     if name == "experts":
         from alaz_tpu.models import experts
 

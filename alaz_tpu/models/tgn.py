@@ -47,6 +47,18 @@ def init_memory(cfg: ModelConfig, max_nodes: int) -> jnp.ndarray:
     return jnp.zeros((max_nodes, cfg.hidden_dim), dtype=jnp.float32)
 
 
+def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
+    """Memoryless single-window forward (cold-start memory): the 3-arg
+    apply surface the registry/score paths expect. Streaming callers
+    thread temporal memory via ``step`` (runtime/service.py does), and
+    TRAINING must use ``train_tgn_unrolled`` — through this cold-start
+    path the GRU/memory parameters receive no gradient (the updated
+    memory is discarded), so only the snapshot encoder would learn."""
+    memory = init_memory(cfg, max_nodes=graph["node_feats"].shape[0])
+    out, _ = step(params, graph, memory, cfg)
+    return out
+
+
 def step(params: Params, graph: dict, memory: jnp.ndarray, cfg: ModelConfig) -> tuple[dict, jnp.ndarray]:
     """One window: encode snapshot conditioned on memory, emit scores,
     return updated memory (zero-extended if the node bucket grew)."""

@@ -71,6 +71,59 @@ def train_on_batches(
     return TrainState(params=params, opt_state=opt_state, step=n_steps), losses
 
 
+def train_tgn_unrolled(
+    cfg: ModelConfig,
+    batches: Iterable[GraphBatch],
+    epochs: int = 5,
+    lr: float = 3e-3,
+    pos_weight: float = 10.0,
+    seed: int = 0,
+) -> tuple[TrainState, List[float]]:
+    """Temporal training for TGN: unroll ``step`` across the window
+    sequence with memory threaded through, so the GRU/memory parameters
+    receive gradient (the memoryless registry ``apply`` trains only the
+    snapshot encoder — its memory path stays at init). One jitted program
+    over the whole unroll; all windows must share a shape bucket."""
+    from alaz_tpu.models import tgn
+
+    batch_list = list(batches)
+    assert batch_list, "no training windows"
+    assert len({(b.n_pad, b.e_pad) for b in batch_list}) == 1, "mixed shape buckets"
+    params = tgn.init(jax.random.PRNGKey(seed), cfg)
+    optimizer = optax.adamw(lr, weight_decay=1e-4)
+    opt_state = optimizer.init(params)
+    max_nodes = max(cfg.tgn_max_nodes, batch_list[0].n_pad)
+
+    graphs = [
+        {k: jnp.asarray(v) for k, v in b.device_arrays().items()} for b in batch_list
+    ]
+    labels = [jnp.asarray(b.edge_label) for b in batch_list]
+
+    @jax.jit
+    def unrolled_step(params, opt_state, graphs, labels, memory0):
+        def loss_fn(p):
+            mem = memory0
+            total = 0.0
+            for g, lbl in zip(graphs, labels):
+                out, mem = tgn.step(p, g, mem, cfg)
+                total = total + edge_bce_loss(
+                    out["edge_logits"], lbl, g["edge_mask"].astype(jnp.float32), pos_weight
+                )
+            return total / len(graphs)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    memory0 = tgn.init_memory(cfg, max_nodes)
+    losses: List[float] = []
+    for _ in range(epochs):
+        params, opt_state, loss = unrolled_step(params, opt_state, graphs, labels, memory0)
+        losses.append(float(loss))
+    return TrainState(params=params, opt_state=opt_state, step=len(losses)), losses
+
+
 def make_score_fn(cfg: ModelConfig) -> Callable:
     """Jitted inference fn (one compile per shape bucket)."""
     _, apply = get_model(cfg.model)
