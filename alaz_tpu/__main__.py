@@ -75,10 +75,15 @@ def cmd_train(args) -> int:
     data = run_anomaly_scenario(sim_cfg, n_windows=args.windows, fault_fraction=0.15, seed=args.seed)
     if args.model == "tgn":
         # temporal model: unroll windows with memory threaded so the
-        # GRU/memory params train (epochs here = unrolled update steps)
-        state, losses = train_tgn_unrolled(
-            cfg, data.train, epochs=max(args.epochs * 3, 20)
+        # GRU/memory params train. One update per epoch covers the whole
+        # sequence, so the step count is scaled and reported.
+        tgn_steps = max(args.epochs * 3, 20)
+        print(
+            f"tgn: {tgn_steps} unrolled update steps over "
+            f"{len(data.train)} windows (from --epochs {args.epochs})",
+            file=sys.stderr,
         )
+        state, losses = train_tgn_unrolled(cfg, data.train, epochs=tgn_steps)
     else:
         state, losses = train_on_batches(cfg, data.train, epochs=args.epochs)
     scores, labels, masks = [], [], []

@@ -6,6 +6,7 @@
                   hidden dims; XLA inserts the collectives)
 - ``halo``      — ring halo exchange for node-sharded graphs (SP/CP)
 - ``gpipe``     — GPipe microbatch pipeline via ppermute hops (PP)
+- ``sharded_model`` — node-sharded GraphSAGE forward (config-5 serving)
 """
 
 from alaz_tpu.parallel.gpipe import make_pipeline

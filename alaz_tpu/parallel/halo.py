@@ -62,6 +62,66 @@ def ring_gather_scatter(
     return acc
 
 
+def ring_gather_edges(
+    h_local: jnp.ndarray,  # [n_loc, F] this shard's node states
+    edge_src: jnp.ndarray,  # [e_loc] GLOBAL src ids of local-dst edges
+    edge_mask: jnp.ndarray,  # [e_loc]
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Per-edge ``h[src[e]]`` with h sharded over ``axis`` — the ring
+    counterpart of a cross-shard gather: node blocks rotate and each
+    device fills in the rows whose src lives in the block it currently
+    holds (D steps, one ppermute per step, peak memory one block). Used
+    by the node-sharded edge head, where every edge needs its (possibly
+    remote) source state, not an aggregate."""
+    n_loc = h_local.shape[0]
+    d = jax.lax.axis_size(axis)
+    my_idx = jax.lax.axis_index(axis)
+
+    src_owner = edge_src // n_loc
+    src_local = edge_src % n_loc
+
+    def body(k, carry):
+        out, blk = carry
+        owner = jax.lax.rem(my_idx - k + d, d)
+        sel = (src_owner == owner) & edge_mask
+        out = jnp.where(sel[:, None], blk[src_local], out)
+        blk = ring_shift(blk, axis, shift=1)
+        return out, blk
+
+    # derive the zero init from the sharded input so its varying-axes
+    # annotation matches the loop body's output under shard_map
+    out0 = h_local[src_local] * jnp.zeros((), h_local.dtype)
+    out, _ = jax.lax.fori_loop(0, d, body, (out0, h_local))
+    return out
+
+
+def partition_edges_by_dst(
+    edge_dst: np.ndarray,
+    n_nodes: int,
+    n_shards: int,
+    edge_mask: np.ndarray | None = None,
+) -> tuple[list[np.ndarray], int, int]:
+    """The shared shard-layout core: contiguous node ownership, per-shard
+    dst-sorted edge index lists, common 128-rounded edge budget. Returns
+    (per-shard global edge indices in dst order, e_budget, n_loc). Both
+    ``shard_graph`` and ``sharded_model.shard_graph_batch`` build on this
+    so the ring kernels see one layout contract."""
+    assert n_nodes % n_shards == 0, "pad node count to a multiple of n_shards"
+    n_loc = n_nodes // n_shards
+    owner = edge_dst // n_loc
+    keep = np.ones(edge_dst.shape[0], bool) if edge_mask is None else edge_mask.astype(bool)
+    per_shard = []
+    e_budget = 0
+    for s in range(n_shards):
+        sel = np.flatnonzero((owner == s) & keep)
+        sel = sel[np.argsort(edge_dst[sel], kind="stable")]
+        per_shard.append(sel)
+        e_budget = max(e_budget, sel.shape[0])
+    e_budget = max(128, ((e_budget + 127) // 128) * 128)
+    return per_shard, e_budget, n_loc
+
+
 def shard_graph(
     node_feats: np.ndarray,
     edge_src: np.ndarray,
@@ -76,27 +136,16 @@ def shard_graph(
     multiple of ``n_shards``; per-shard edge budget is the max shard edge
     count rounded up to 128."""
     n = node_feats.shape[0]
-    assert n % n_shards == 0, "pad node count to a multiple of n_shards"
-    n_loc = n // n_shards
-
-    owner = edge_dst // n_loc
-    e_budget = 0
-    per_shard = []
-    for s in range(n_shards):
-        sel = owner == s
-        per_shard.append((edge_src[sel], edge_dst[sel] - s * n_loc))
-        e_budget = max(e_budget, int(sel.sum()))
-    e_budget = max(128, ((e_budget + 127) // 128) * 128)
+    per_shard, e_budget, n_loc = partition_edges_by_dst(edge_dst, n, n_shards)
 
     h = node_feats.reshape(n_shards, n_loc, -1)
     src = np.zeros((n_shards, e_budget), dtype=np.int32)
     dst_local = np.full((n_shards, e_budget), n_loc - 1, dtype=np.int32)
     mask = np.zeros((n_shards, e_budget), dtype=bool)
-    for s, (es, ed) in enumerate(per_shard):
-        order = np.argsort(ed, kind="stable")
-        k = es.shape[0]
-        src[s, :k] = es[order]
-        dst_local[s, :k] = ed[order]
+    for s, idx in enumerate(per_shard):
+        k = idx.shape[0]
+        src[s, :k] = edge_src[idx]
+        dst_local[s, :k] = edge_dst[idx] - s * n_loc
         mask[s, :k] = True
     return h, src, dst_local, mask
 

@@ -11,6 +11,7 @@ from typing import Any, Callable, Iterable, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from alaz_tpu.config import ModelConfig
@@ -71,6 +72,24 @@ def train_on_batches(
     return TrainState(params=params, opt_state=opt_state, step=n_steps), losses
 
 
+def _pad_graph_field(name: str, v, n_t: int, e_t: int):
+    """Zero/mask-pad one device-array field up to target buckets. Padding
+    edges point at the last node slot (keeps the dst-sorted invariant)
+    with mask 0, so they contribute nothing."""
+    v = np.asarray(v)
+    if name.startswith("node_"):
+        pad = n_t - v.shape[0]
+        widths = ((0, pad),) + ((0, 0),) * (v.ndim - 1)
+        return np.pad(v, widths)
+    pad = e_t - v.shape[0]
+    if pad == 0:
+        return v
+    if name in ("edge_src", "edge_dst"):
+        return np.pad(v, (0, pad), constant_values=n_t - 1)
+    widths = ((0, pad),) + ((0, 0),) * (v.ndim - 1)
+    return np.pad(v, widths)
+
+
 def train_tgn_unrolled(
     cfg: ModelConfig,
     batches: Iterable[GraphBatch],
@@ -88,16 +107,26 @@ def train_tgn_unrolled(
 
     batch_list = list(batches)
     assert batch_list, "no training windows"
-    assert len({(b.n_pad, b.e_pad) for b in batch_list}) == 1, "mixed shape buckets"
     params = tgn.init(jax.random.PRNGKey(seed), cfg)
     optimizer = optax.adamw(lr, weight_decay=1e-4)
     opt_state = optimizer.init(params)
-    max_nodes = max(cfg.tgn_max_nodes, batch_list[0].n_pad)
+    # the unroll is one program, so every window is padded up to the
+    # largest bucket present (Poisson traffic routinely straddles bucket
+    # boundaries between windows)
+    n_t = max(b.n_pad for b in batch_list)
+    e_t = max(b.e_pad for b in batch_list)
+    max_nodes = max(cfg.tgn_max_nodes, n_t)
 
     graphs = [
-        {k: jnp.asarray(v) for k, v in b.device_arrays().items()} for b in batch_list
+        {
+            k: jnp.asarray(_pad_graph_field(k, v, n_t, e_t))
+            for k, v in b.device_arrays().items()
+        }
+        for b in batch_list
     ]
-    labels = [jnp.asarray(b.edge_label) for b in batch_list]
+    labels = [
+        jnp.asarray(np.pad(b.edge_label, (0, e_t - b.e_pad))) for b in batch_list
+    ]
 
     @jax.jit
     def unrolled_step(params, opt_state, graphs, labels, memory0):
