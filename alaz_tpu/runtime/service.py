@@ -311,16 +311,16 @@ class Service:
 
         from alaz_tpu.models.registry import get_model  # noqa: F401 (jit cache warm)
 
-        while not self._stop.is_set():
-            item = self.window_queue.get(timeout=0.1)
-            if item is None:
-                continue
+        # double buffering (SURVEY §2.3 P3): window N+1's host→device
+        # transfer is staged (JAX transfers are async) before window N is
+        # scored, so the feed overlaps the compute. FIFO order is kept —
+        # the temporal model's memory threading depends on it.
+        staged: Optional[tuple] = None  # (batch, device arrays)
+
+        def score_one(batch, graph) -> None:
+            """Score one window; always settles its task_done."""
             try:
-                (batch,) = item
-                if self._score_fn is None or self.model_state is None:
-                    continue
                 t0 = time_module.perf_counter()
-                graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
                 out = self._score_fn(self.model_state, graph)
                 logits = np.asarray(out["edge_logits"])
                 self._scorer_busy_s += time_module.perf_counter() - t0
@@ -332,6 +332,33 @@ class Service:
                     if len(annotated):
                         self.score_sink(annotated)
             finally:
+                self.window_queue.task_done()
+
+        try:
+            while not self._stop.is_set():
+                item = self.window_queue.get(timeout=0.05)
+                if item is None:
+                    if staged is not None:  # idle: don't hold a window
+                        prev, staged = staged, None
+                        score_one(*prev)
+                    continue
+                (batch,) = item
+                if self._score_fn is None or self.model_state is None:
+                    self.window_queue.task_done()
+                    continue
+                t0 = time_module.perf_counter()
+                graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
+                self._scorer_busy_s += time_module.perf_counter() - t0
+                prev, staged = staged, (batch, graph)
+                if prev is not None:
+                    score_one(*prev)  # scores N; N+1's transfer in flight
+            if staged is not None:
+                prev, staged = staged, None
+                score_one(*prev)
+        finally:
+            # worker dying (or stopping) with a window still staged:
+            # settle its accounting so drain() doesn't burn its timeout
+            if staged is not None:
                 self.window_queue.task_done()
 
     def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> ScoreBatch:
