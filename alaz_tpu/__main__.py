@@ -168,6 +168,13 @@ def cmd_serve(args) -> int:
             containers = ContainerIndex(lister=CriContainerLister(cri_sock))
             containers.start(svc)
     svc.start()
+    ingest_srv = None
+    if args.ingest_socket:
+        from alaz_tpu.sources.ingest_server import IngestServer
+
+        ingest_srv = IngestServer(svc, path=args.ingest_socket)
+        ingest_srv.start()
+        print(f"ingest socket at {args.ingest_socket}", file=sys.stderr)
     debug = DebugServer(svc, port=args.debug_port)
     debug.start()
     hc = None
@@ -200,6 +207,8 @@ def cmd_serve(args) -> int:
     finally:
         if src:
             src.stop()
+        if ingest_srv is not None:
+            ingest_srv.stop()
         if containers is not None:
             containers.stop()
         if hc:
@@ -241,6 +250,11 @@ def main(argv=None) -> int:
     ps.add_argument("--ckpt", default=None)
     ps.add_argument("--debug-port", type=int, default=8181)
     ps.add_argument("--flat-out", action="store_true")
+    ps.add_argument(
+        "--ingest-socket", default=os.environ.get("INGEST_SOCKET", ""),
+        help="unix socket for out-of-process agents (frame protocol in "
+        "sources/ingest_server.py)",
+    )
     ps.set_defaults(fn=cmd_serve)
 
     pb = sub.add_parser("bench", help="headline benchmark")

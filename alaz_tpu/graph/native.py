@@ -144,6 +144,20 @@ class NativeWindowedStore:
                     break
                 self._emit(out)
 
+    def push_records(self, rows: np.ndarray) -> int:
+        """Pre-packed NATIVE_RECORD_DTYPE rows (the socket fast path:
+        agents ship AlzRecord wire bytes, no REQUEST_DTYPE conversion).
+        Returns accepted count; closed windows emit as usual."""
+        with self._lock:
+            self.request_count += rows.shape[0]
+            accepted = self.ingest.push_records(rows)
+            while True:
+                out = self.ingest.poll()
+                if out is None:
+                    break
+                self._emit(out)
+            return accepted
+
     def persist_kafka_events(self, batch: np.ndarray) -> None:
         pass
 
@@ -265,6 +279,13 @@ class NativeIngest:
         if not self._h:
             return 0
         recs = self.to_records(np.ascontiguousarray(rows))
+        return self.push_records(recs)
+
+    def push_records(self, recs: np.ndarray) -> int:
+        """Push already-packed NATIVE_RECORD_DTYPE rows."""
+        if not self._h:
+            return 0
+        recs = np.ascontiguousarray(recs)
         return int(
             self._lib.alz_push(
                 self._h, recs.ctypes.data_as(ctypes.c_void_p), recs.shape[0]

@@ -346,9 +346,16 @@ class Service:
                 if self._score_fn is None or self.model_state is None:
                     self.window_queue.task_done()
                     continue
-                t0 = time_module.perf_counter()
-                graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
-                self._scorer_busy_s += time_module.perf_counter() - t0
+                try:
+                    t0 = time_module.perf_counter()
+                    graph = {
+                        k: jnp.asarray(v) for k, v in batch.device_arrays().items()
+                    }
+                    self._scorer_busy_s += time_module.perf_counter() - t0
+                except Exception:
+                    # the popped window still owes its accounting
+                    self.window_queue.task_done()
+                    raise
                 prev, staged = staged, (batch, graph)
                 if prev is not None:
                     score_one(*prev)  # scores N; N+1's transfer in flight

@@ -394,7 +394,10 @@ def probe_runtime_socket(
     if env:
         paths.insert(0, env.removeprefix("unix://"))
     for path in paths:
-        if not Path(path).exists():
+        try:
+            if not Path(path).exists():
+                continue
+        except OSError:  # /proc/1/root may deny traversal in containers
             continue
         try:
             client = CriClient(path, timeout_s=timeout_s)

@@ -1,0 +1,253 @@
+"""Socket ingest plane for out-of-process agents — the process boundary
+of SURVEY §2.3 P8 (the reference's kernel↔userspace perf-buffer seam,
+re-drawn as agent↔service).
+
+INTEGRATION.md's contract is "ship the event dtypes as raw bytes over
+any transport"; this is that transport: a length-prefixed binary frame
+protocol over a unix or TCP socket that a C/C++/Go agent can emit with
+one writev per batch and zero serialization (numpy structured arrays are
+fixed-layout).
+
+Frame layout (little-endian, 16-byte header):
+
+    u32 magic   = 0x414C5A31  ("ALZ1")
+    u8  kind    = 1 l7 | 2 tcp | 3 proc | 4 native (AlzRecord rows)
+    u8  _pad[3]
+    u32 count   = number of records
+    u32 length  = payload bytes (must equal count * itemsize)
+    ...payload  = `count` packed records of the kind's dtype
+
+kind 4 bypasses the aggregator: records are the 32-byte AlzRecord wire
+format (graph/native.py) for pre-attributed edges pushed straight at the
+windowed graph store — the "native fast path" of INTEGRATION.md over a
+socket instead of in-process ctypes.
+
+Malformed frames (bad magic, length mismatch, unknown kind) drop the
+connection — the agent is the untrusted side. Backpressure follows the
+service contract: submit_* drop-not-block, so a flooding agent loses
+events rather than stalling the socket reader into TCP backpressure.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from alaz_tpu.events.schema import (
+    L7_EVENT_DTYPE,
+    PROC_EVENT_DTYPE,
+    TCP_EVENT_DTYPE,
+)
+from alaz_tpu.logging import get_logger
+
+log = get_logger("alaz_tpu.ingest_server")
+
+MAGIC = 0x414C5A31
+_HEADER = struct.Struct("<IB3xII")
+
+KIND_L7 = 1
+KIND_TCP = 2
+KIND_PROC = 3
+KIND_NATIVE = 4
+
+_KIND_DTYPE = {
+    KIND_L7: L7_EVENT_DTYPE,
+    KIND_TCP: TCP_EVENT_DTYPE,
+    KIND_PROC: PROC_EVENT_DTYPE,
+}
+
+MAX_FRAME_BYTES = 64 * 1024 * 1024  # one frame must fit in memory comfortably
+
+
+def pack_frame(kind: int, batch: np.ndarray) -> bytes:
+    """Client-side helper: one event batch → one wire frame."""
+    payload = np.ascontiguousarray(batch).tobytes()
+    return _HEADER.pack(MAGIC, kind, batch.shape[0], len(payload)) + payload
+
+
+class IngestServer:
+    """Accepts agent connections and feeds their frames into a Service.
+
+    ``path`` starts a unix-domain listener; ``port`` a TCP one (use the
+    loopback/TLS-terminating sidecar of your deployment for anything
+    off-host — the reference's log streamer does the same)."""
+
+    def __init__(
+        self,
+        service,
+        path: str | Path | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.service = service
+        self.frames = 0
+        self.records = 0
+        self.bad_frames = 0
+        self.unsupported_frames = 0
+        self._counter_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._unix_path: Optional[Path] = None
+        if path is not None:
+            self._unix_path = Path(path)
+            self.address: str | tuple = str(path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            if self._unix_path.exists():
+                # a stale socket file from a previous run blocks bind
+                self._unix_path.unlink()
+            self._sock.bind(str(path))
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.bind((host, port))
+            self.address = self._sock.getsockname()
+        self._sock.listen(16)
+        self._sock.settimeout(0.2)
+        # KIND_NATIVE needs the C++ ring (push_records); the numpy store
+        # doesn't speak the wire record format
+        store = getattr(service, "graph_store", None)
+        self._native_store = store if hasattr(store, "push_records") else None
+        self._warned_no_native = False
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._accept_loop, name="alaz-ingest-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._unix_path is not None:
+            try:
+                self._unix_path.unlink()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+
+    # -- internals -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), name="alaz-ingest-conn", daemon=True
+            )
+            t.start()
+            # track only live connections (per-batch clients would
+            # otherwise grow this list without bound)
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except socket.timeout:
+                if self._stop.is_set():
+                    return None
+                continue
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                header = self._recv_exact(conn, _HEADER.size)
+                if header is None:
+                    return
+                magic, kind, count, length = _HEADER.unpack(header)
+                if magic != MAGIC or length > MAX_FRAME_BYTES:
+                    with self._counter_lock:
+                        self.bad_frames += 1
+                    log.warning("bad frame header; dropping connection")
+                    return
+                payload = self._recv_exact(conn, length)
+                if payload is None:
+                    return
+                ok = self._dispatch(kind, count, payload)
+                if ok is None:
+                    # well-formed but unsupported here (native frame on a
+                    # numpy-store service): config mismatch, not protocol
+                    # corruption — keep the connection, drop the frame
+                    with self._counter_lock:
+                        self.unsupported_frames += 1
+                    continue
+                if not ok:
+                    with self._counter_lock:
+                        self.bad_frames += 1
+                    log.warning(f"malformed frame kind={kind}; dropping connection")
+                    return
+                with self._counter_lock:
+                    self.frames += 1
+                    self.records += count
+        finally:
+            conn.close()
+
+    def _dispatch(self, kind: int, count: int, payload: bytes) -> bool | None:
+        """True = accepted; False = malformed (drop connection); None =
+        well-formed but unsupported by this service's configuration."""
+        if kind == KIND_NATIVE:
+            from alaz_tpu.graph.native import NATIVE_RECORD_DTYPE
+
+            if count * NATIVE_RECORD_DTYPE.itemsize != len(payload):
+                return False
+            if self._native_store is None:
+                if not self._warned_no_native:
+                    self._warned_no_native = True
+                    log.warning(
+                        "agent sent native frames but the service runs the "
+                        "numpy store — start with use_native_ingest=True "
+                        "(and build libalaz_ingest.so) to accept them"
+                    )
+                return None
+            rows = np.frombuffer(payload, dtype=NATIVE_RECORD_DTYPE)
+            # pre-attributed edges go straight into the native ring
+            self._native_store.push_records(rows)
+            return True
+        dtype = _KIND_DTYPE.get(kind)
+        if dtype is None or count * dtype.itemsize != len(payload):
+            return False
+        batch = np.frombuffer(payload, dtype=dtype)
+        if kind == KIND_L7:
+            self.service.submit_l7(batch)
+        elif kind == KIND_TCP:
+            self.service.submit_tcp(batch)
+        else:
+            self.service.submit_proc(batch)
+        return True
+
+
+def send_batches(
+    address: str | tuple, frames: list[tuple[int, np.ndarray]]
+) -> None:
+    """Client-side helper (tests / Python agents): connect, send, close."""
+    if isinstance(address, str):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    else:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.connect(address)
+    try:
+        for kind, batch in frames:
+            sock.sendall(pack_frame(kind, batch))
+    finally:
+        sock.close()
