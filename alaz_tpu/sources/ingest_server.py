@@ -141,8 +141,14 @@ class IngestServer:
                 conn, _ = self._sock.accept()
             except socket.timeout:
                 continue
-            except OSError:
-                return
+            except OSError as exc:
+                if self._stop.is_set():
+                    return  # shutdown closed the listener
+                # transient accept failure (EMFILE under connection
+                # floods, ECONNABORTED): keep the listener alive
+                log.warning(f"accept failed: {exc}")
+                self._stop.wait(0.1)
+                continue
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), name="alaz-ingest-conn", daemon=True
             )
@@ -153,20 +159,22 @@ class IngestServer:
             self._threads.append(t)
 
     def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
             try:
-                chunk = conn.recv(n - len(buf))
+                k = conn.recv_into(view[got:], n - got)
             except socket.timeout:
                 if self._stop.is_set():
                     return None
                 continue
             except OSError:
                 return None
-            if not chunk:
+            if k == 0:
                 return None
-            buf += chunk
-        return buf
+            got += k
+        return bytes(buf)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(0.5)

@@ -863,3 +863,38 @@ class TestIngestServer:
         svc, srv = self._service_and_server(tmp_path)
         srv.stop()
         assert not (tmp_path / "ingest.sock").exists()
+
+    def test_cpp_agent_example_end_to_end(self, tmp_path):
+        """The reference native agent (`make agent`) ships AlzRecord
+        frames from a separate process into the C++ ring."""
+        import subprocess
+        import time
+
+        from alaz_tpu.graph import native as native_mod
+        from alaz_tpu.graph.native import _LIB_DIR
+
+        if not native_mod.available():
+            pytest.skip("native lib not built")
+        build = subprocess.run(
+            ["make", "-C", str(_LIB_DIR), "agent"], capture_output=True, text=True
+        )
+        if build.returncode != 0:
+            pytest.skip(f"agent build unavailable: {build.stderr[-200:]}")
+        svc, srv = self._service_and_server(tmp_path, use_native_ingest=True)
+        try:
+            run = subprocess.run(
+                [str(_LIB_DIR / "agent_example"), str(tmp_path / "ingest.sock"), "5000"],
+                capture_output=True, text=True, timeout=30,
+            )
+            assert run.returncode == 0, run.stderr
+            deadline = time.time() + 5
+            while time.time() < deadline and srv.records < 5000:
+                time.sleep(0.01)
+            assert srv.records == 5000 and srv.bad_frames == 0
+            assert svc.graph_store.request_count == 5000
+            svc.flush_windows()
+            total = len(svc.window_queue) + len(getattr(svc.graph_store, "batches", []))
+            assert total >= 2  # records span three 1s windows
+        finally:
+            srv.stop()
+            svc.graph_store.close()
