@@ -51,7 +51,13 @@ int main(int argc, char** argv) {
     return 2;
   }
   const char* path = argv[1];
-  uint32_t n = argc > 2 ? static_cast<uint32_t>(std::atoi(argv[2])) : 1000;
+  unsigned long n_arg = argc > 2 ? std::strtoul(argv[2], nullptr, 10) : 1000;
+  // header length is u32 bytes: cap so count * 32 cannot overflow it
+  if (n_arg == 0 || n_arg > (UINT32_MAX / sizeof(AlzRecord))) {
+    std::fprintf(stderr, "n_records out of range: %s\n", argv[2]);
+    return 2;
+  }
+  uint32_t n = static_cast<uint32_t>(n_arg);
   int64_t t0 = argc > 3 ? std::atoll(argv[3]) : 1000;
 
   std::vector<AlzRecord> recs(n);

@@ -158,7 +158,9 @@ class IngestServer:
             self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
-    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytes]:
+    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytearray]:
+        """Read exactly n bytes into one preallocated buffer (no copies:
+        struct.unpack and np.frombuffer consume the bytearray directly)."""
         buf = bytearray(n)
         view = memoryview(buf)
         got = 0
@@ -174,7 +176,7 @@ class IngestServer:
             if k == 0:
                 return None
             got += k
-        return bytes(buf)
+        return buf
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(0.5)

@@ -132,3 +132,42 @@ def test_token_bucket_fractional_refill_not_burned():
         t += 0.01
         admitted += tb.admit(5, t)
     assert 9 <= admitted <= 11
+
+
+class TestQueueTaskAccounting:
+    """task_done/unfinished — the seam Service.drain uses to see batches
+    a worker popped but hasn't finished (plain emptiness raced
+    flush_windows in round 1)."""
+
+    def test_unfinished_tracks_through_lifecycle(self):
+        q = BatchQueue(100, "acct")
+        assert q.unfinished == 0
+        q.put_nowait_drop([1, 2, 3])
+        q.put([4])
+        assert q.unfinished == 2
+        assert q.get(timeout=0.1) == [1, 2, 3]
+        # popped but not done: still unfinished
+        assert q.unfinished == 2
+        q.task_done()
+        assert q.unfinished == 1
+        q.get(timeout=0.1)
+        q.task_done()
+        assert q.unfinished == 0
+        # extra task_done never goes negative
+        q.task_done()
+        assert q.unfinished == 0
+
+    def test_drain_settles_accounting(self):
+        q = BatchQueue(100, "acct2")
+        q.put_nowait_drop([1])
+        q.put_nowait_drop([2])
+        items = q.drain()
+        assert len(items) == 2
+        assert q.unfinished == 0
+
+    def test_dropped_batches_not_counted(self):
+        q = BatchQueue(2, "tiny")  # capacity in events
+        assert q.put_nowait_drop([1, 2])
+        assert not q.put_nowait_drop([3, 4, 5])  # over capacity: dropped
+        assert q.unfinished == 1
+        assert q.dropped == 3
