@@ -173,7 +173,7 @@ def cmd_serve(args) -> int:
         from alaz_tpu.sources.ingest_server import IngestServer
 
         ingest_srv = IngestServer(svc, path=args.ingest_socket)
-        ingest_srv.start()
+        ingest_srv.start()  # self-registers its ingest_socket.* gauges
         print(f"ingest socket at {args.ingest_socket}", file=sys.stderr)
     debug = DebugServer(svc, port=args.debug_port)
     debug.start()

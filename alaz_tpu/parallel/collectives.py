@@ -40,6 +40,20 @@ def all_to_all(x: jnp.ndarray, axis: str, split_axis: int, concat_axis: int) -> 
     return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
+def nodes_to_features(h_local: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """[n_loc, F] node-sharded → [N, F/D] feature-sharded, one all-to-all
+    (the P6 reshard between a halo/ring layer, which wants whole feature
+    rows per node block, and a TP dense layer, which wants whole node
+    columns per feature block). Inside shard_map only; F must divide by
+    the axis size."""
+    return all_to_all(h_local, axis, split_axis=1, concat_axis=0)
+
+
+def features_to_nodes(h_local: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Inverse of nodes_to_features: [N, F/D] → [n_loc, F]."""
+    return all_to_all(h_local, axis, split_axis=0, concat_axis=1)
+
+
 def axis_index(axis: str) -> jnp.ndarray:
     return jax.lax.axis_index(axis)
 

@@ -114,6 +114,15 @@ class IngestServer:
         self._warned_no_native = False
 
     def start(self) -> None:
+        # self-register observability like every other component
+        metrics = getattr(self.service, "metrics", None)
+        if metrics is not None:
+            metrics.gauge("ingest_socket.frames", lambda: self.frames)
+            metrics.gauge("ingest_socket.records", lambda: self.records)
+            metrics.gauge("ingest_socket.bad_frames", lambda: self.bad_frames)
+            metrics.gauge(
+                "ingest_socket.unsupported_frames", lambda: self.unsupported_frames
+            )
         t = threading.Thread(target=self._accept_loop, name="alaz-ingest-accept", daemon=True)
         t.start()
         self._threads.append(t)
@@ -213,7 +222,7 @@ class IngestServer:
         finally:
             conn.close()
 
-    def _dispatch(self, kind: int, count: int, payload: bytes) -> bool | None:
+    def _dispatch(self, kind: int, count: int, payload: bytes | bytearray) -> bool | None:
         """True = accepted; False = malformed (drop connection); None =
         well-formed but unsupported by this service's configuration."""
         if kind == KIND_NATIVE:

@@ -447,9 +447,11 @@ class TestServiceSoak:
         caches, path caches, rate-limit buckets, retry queue — plus RSS
         growth within a sane envelope (the reference harness tracks RSS
         over the run, main_benchmark_test.go:152-290)."""
+        import resource
+
         def current_rss() -> int:
             with open("/proc/self/statm") as f:
-                return int(f.read().split()[1]) * 4096  # pages → bytes
+                return int(f.read().split()[1]) * resource.getpagesize()
 
         interner = Interner()
         svc = Service(interner=interner)
