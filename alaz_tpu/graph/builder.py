@@ -15,6 +15,7 @@ vectorized from that window's edges.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -207,6 +208,7 @@ class WindowedGraphStore(BaseDataStore):
         self.batches: List[GraphBatch] = []
         self.request_count = 0
         self.late_dropped = 0
+        self.last_persist_monotonic: float | None = None
         self._pending: dict[int, List[np.ndarray]] = {}
         self._watermark = -1
         self._closed_upto = -1
@@ -216,6 +218,7 @@ class WindowedGraphStore(BaseDataStore):
 
     def persist_requests(self, batch: np.ndarray) -> None:
         with self._lock:
+            self.last_persist_monotonic = time.monotonic()
             self.request_count += batch.shape[0]
             wids = batch["start_time_ms"] // self.window_ms
             for w in np.unique(wids):

@@ -14,6 +14,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from pathlib import Path
 from typing import Optional
 
@@ -118,6 +119,7 @@ class NativeWindowedStore:
         self.on_batch = on_batch
         self.batches: list[GraphBatch] = []
         self.request_count = 0
+        self.last_persist_monotonic: float | None = None
         # the C++ side is single-consumer (alz_drain/alz_close_window share
         # ring tail + export buffers); serialize like WindowedGraphStore does
         self._lock = threading.Lock()
@@ -136,6 +138,7 @@ class NativeWindowedStore:
 
     def persist_requests(self, batch: np.ndarray) -> None:
         with self._lock:
+            self.last_persist_monotonic = time.monotonic()
             self.request_count += batch.shape[0]
             self.ingest.push(batch)
             while True:
@@ -149,6 +152,7 @@ class NativeWindowedStore:
         agents ship AlzRecord wire bytes, no REQUEST_DTYPE conversion).
         Returns accepted count; closed windows emit as usual."""
         with self._lock:
+            self.last_persist_monotonic = time.monotonic()
             self.request_count += rows.shape[0]
             accepted = self.ingest.push_records(rows)
             while True:
@@ -291,6 +295,13 @@ class NativeIngest:
                 self._h, recs.ctypes.data_as(ctypes.c_void_p), recs.shape[0]
             )
         )
+
+    def oldest_window(self) -> Optional[int]:
+        """Oldest open window id, or None."""
+        if not self._h:
+            return None
+        w = int(self._lib.alz_current_window(self._h))
+        return None if w == _INT64_MIN else w
 
     def poll(self) -> Optional[GraphBatch]:
         """Drain the ring; if a window closed, build and return its batch."""

@@ -297,6 +297,16 @@ class Service:
                 # would all look dead and lose their join state.
                 if self.config.local_pids:
                     self.aggregator.reap_zombies()
+                # traffic-lull liveness: with no newer event the watermark
+                # never advances, so the last window would sit open
+                # forever. Ingest idleness (not event time — replay clocks
+                # are synthetic) triggers the flush: no persists for a
+                # grace period means nothing more is coming for the open
+                # windows.
+                last = getattr(self.graph_store, "last_persist_monotonic", None)
+                grace_s = max(2 * self.config.window_s, 5.0)
+                if last is not None and time_module.monotonic() - last > grace_s:
+                    self.graph_store.flush()
                 # channel-lag log (data.go:177-186 cadence)
                 lag = {
                     q.name: q.stats()
