@@ -114,9 +114,30 @@ def cmd_train(args) -> int:
             labels.append(b.edge_label)
             masks.append(b.edge_mask)
     a = auroc(np.concatenate(scores), np.concatenate(labels), np.concatenate(masks))
+    # per-failure-class breakdown (README taxonomy: latency_spike /
+    # error_burst / zombie) — a blended number can hide a blind class
+    from alaz_tpu.replay.faults import FAULT_KINDS
+    from alaz_tpu.train.metrics import auroc_by_kind
+
+    kind_arrays = [getattr(b, "edge_fault_kind", None) for b in data.eval]
+    by_kind = {}
+    if all(k is not None for k in kind_arrays) and kind_arrays:
+        by_kind = {
+            k: (round(v, 4) if v == v else None)  # NaN → null
+            for k, v in auroc_by_kind(
+                np.concatenate(scores),
+                np.concatenate(kind_arrays),
+                FAULT_KINDS,
+                np.concatenate(masks),
+            ).items()
+        }
     if args.ckpt:
         checkpoint.save(args.ckpt, step=state.step, params=state.params)
-    print(json.dumps({"model": args.model, "auroc": round(float(a), 4), "loss_final": round(losses[-1], 4), "steps": state.step}))
+    print(json.dumps({
+        "model": args.model, "auroc": round(float(a), 4),
+        "auroc_by_kind": by_kind,
+        "loss_final": round(losses[-1], 4), "steps": state.step,
+    }))
     return 0 if a >= 0.9 else 1
 
 

@@ -225,6 +225,12 @@ class RuntimeConfig:
     # mode): gates the kill(pid,0) zombie reaper — replayed/remote pids
     # must never be probed against the service host's process table
     local_pids: bool = False
+    # ingest-idle grace before open windows flush (traffic-lull liveness).
+    # Deliberately much larger than a window: a flush during an upstream
+    # delivery STALL (agent buffering through a network hiccup) drops the
+    # stalled rows as late when they arrive — size this above the longest
+    # stall worth riding out, not at the window length.
+    idle_flush_grace_s: float = 30.0
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -238,4 +244,5 @@ class RuntimeConfig:
             exclude_namespaces=env_str("EXCLUDE_NAMESPACES", ""),
             send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
             local_pids=env_bool("LOCAL_PIDS", False),
+            idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
         )

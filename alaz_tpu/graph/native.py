@@ -296,13 +296,6 @@ class NativeIngest:
             )
         )
 
-    def oldest_window(self) -> Optional[int]:
-        """Oldest open window id, or None."""
-        if not self._h:
-            return None
-        w = int(self._lib.alz_current_window(self._h))
-        return None if w == _INT64_MIN else w
-
     def poll(self) -> Optional[GraphBatch]:
         """Drain the ring; if a window closed, build and return its batch."""
         if not self._h:

@@ -112,6 +112,31 @@ def _pack_pairs(fu: np.ndarray, tu: np.ndarray) -> np.ndarray:
     return fu.astype(np.int64) << 32 | tu.astype(np.int64)
 
 
+def label_batch_kinds(batch, plan: FaultPlan, kind_names: tuple = FAULT_KINDS) -> np.ndarray:
+    """Per-edge fault KIND labels: 0 = clean, else 1 + index into
+    ``kind_names``. Lets evaluation break AUROC out per failure class —
+    a model that only catches error bursts must not hide behind a
+    blended number. Plan kinds outside ``kind_names`` stay 0 here (the
+    binary oracle still labels them faulty); vectorized with one np.isin
+    pass per kind like label_batch_edges."""
+    kinds = np.zeros(batch.e_pad, dtype=np.int32)
+    if batch.node_uids is None or not plan.active(batch.window_start_ms) or not plan.edges:
+        return kinds
+    uids = batch.node_uids
+    edge_keys = _pack_pairs(uids[batch.edge_src], uids[batch.edge_dst])
+    for i, name in enumerate(kind_names):
+        keys = np.array(
+            [int(fu) << 32 | int(tu) for (fu, tu), k in plan.edges.items() if k == name],
+            dtype=np.int64,
+        )
+        if keys.size == 0:
+            continue
+        hit = np.isin(edge_keys, keys)
+        hit[batch.n_edges :] = False
+        kinds[hit] = i + 1
+    return kinds
+
+
 def label_batch_edges(batch, plan: FaultPlan) -> np.ndarray:
     """Oracle labels for an aggregated GraphBatch: edge is faulty iff its
     (src_uid, dst_uid) is in the plan and the window overlaps the span.

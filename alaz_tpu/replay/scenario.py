@@ -101,6 +101,8 @@ def run_anomaly_scenario(
     batches = store.batches
     for b in batches:
         b.edge_label = faults_mod.label_batch_edges(b, plan)
+        # per-class oracle for kind-broken-out AUROC (metrics.auroc_by_kind)
+        b.edge_fault_kind = faults_mod.label_batch_kinds(b, plan)
 
     n_train = max(1, int(len(batches) * train_frac))
     return ScenarioData(

@@ -300,11 +300,11 @@ class Service:
                 # traffic-lull liveness: with no newer event the watermark
                 # never advances, so the last window would sit open
                 # forever. Ingest idleness (not event time — replay clocks
-                # are synthetic) triggers the flush: no persists for a
-                # grace period means nothing more is coming for the open
-                # windows.
+                # are synthetic) triggers the flush. The grace knob trades
+                # staleness against upstream delivery stalls: rows that
+                # arrive after their window was idle-flushed drop as late.
                 last = getattr(self.graph_store, "last_persist_monotonic", None)
-                grace_s = max(2 * self.config.window_s, 5.0)
+                grace_s = max(self.config.idle_flush_grace_s, 2 * self.config.window_s)
                 if last is not None and time_module.monotonic() - last > grace_s:
                     self.graph_store.flush()
                 # channel-lag log (data.go:177-186 cadence)

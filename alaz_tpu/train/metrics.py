@@ -34,3 +34,24 @@ def auroc(scores: np.ndarray, labels: np.ndarray, mask: np.ndarray | None = None
     pos_rank_sum = ranks[labels].sum()
     u = pos_rank_sum - n_pos * (n_pos + 1) / 2.0
     return float(u / (n_pos * n_neg))
+
+
+def auroc_by_kind(
+    scores: np.ndarray,
+    kind_labels: np.ndarray,
+    kind_names: tuple,
+    mask: np.ndarray | None = None,
+) -> dict:
+    """Per-failure-class AUROC: each kind k scored one-vs-clean (edges of
+    OTHER fault kinds excluded, so classes don't dilute each other).
+    ``kind_labels``: 0 = clean, else 1 + index into ``kind_names``
+    (replay.faults.label_batch_kinds). NaN for kinds absent from the
+    eval set."""
+    scores = np.asarray(scores, dtype=np.float64)
+    kinds = np.asarray(kind_labels)
+    keep = np.ones(scores.shape[0], bool) if mask is None else np.asarray(mask, bool)
+    out = {}
+    for i, name in enumerate(kind_names):
+        sel = keep & ((kinds == 0) | (kinds == i + 1))
+        out[name] = auroc(scores[sel], (kinds[sel] == i + 1).astype(np.float32))
+    return out

@@ -147,3 +147,45 @@ class TestAnomalyEndToEnd:
                 masks.append(b.edge_mask)
         a = auroc(np.concatenate(scores), np.concatenate(lbls), np.concatenate(masks))
         assert a >= 0.85, f"TGN AUROC {a:.3f}"
+
+
+class TestAurocByKind:
+    def test_one_vs_clean_per_kind(self):
+        import numpy as np
+
+        from alaz_tpu.train.metrics import auroc_by_kind
+
+        kinds = np.array([0, 0, 0, 1, 1, 2, 2, 3])
+        # model separates kind-1 perfectly, kind-2 not at all, kind-3 inverted
+        scores = np.array([0.1, 0.2, 0.15, 0.9, 0.95, 0.1, 0.2, 0.01])
+        out = auroc_by_kind(scores, kinds, ("a", "b", "c"))
+        assert out["a"] == 1.0
+        assert 0.3 < out["b"] < 0.8  # indistinguishable from clean
+        assert out["c"] == 0.0
+
+    def test_absent_kind_is_nan(self):
+        import numpy as np
+
+        from alaz_tpu.train.metrics import auroc_by_kind
+
+        out = auroc_by_kind(np.array([0.5, 0.6]), np.array([0, 1]), ("a", "b"))
+        assert out["a"] == 1.0 or out["a"] == 0.0 or 0 <= out["a"] <= 1
+        assert out["b"] != out["b"]  # NaN: no kind-b edges
+
+    def test_scenario_batches_carry_kind_labels(self):
+        import numpy as np
+
+        from alaz_tpu.config import SimulationConfig
+        from alaz_tpu.replay.faults import FAULT_KINDS
+        from alaz_tpu.replay.scenario import run_anomaly_scenario
+
+        data = run_anomaly_scenario(
+            SimulationConfig(pod_count=20, service_count=8, edge_count=15, edge_rate=100),
+            n_windows=4, fault_fraction=0.3, seed=5,
+        )
+        for b in data.train + data.eval:
+            kinds = b.edge_fault_kind
+            assert kinds.shape[0] == b.e_pad
+            # kind labels agree with the binary oracle
+            np.testing.assert_array_equal((kinds > 0).astype(np.float32), b.edge_label)
+            assert kinds.max() <= len(FAULT_KINDS)
