@@ -237,6 +237,36 @@ def bench_e2e(args) -> dict:
     }
 
 
+def _arm_watchdog(seconds: float, metric: str, unit: str):
+    """A wedged accelerator tunnel can hang device ops forever; emit the
+    one-JSON-line contract (for the metric actually being run) with an
+    error marker and hard-exit instead of eating the caller's whole
+    budget. Returns the timer so a finishing run can cancel it."""
+    import os
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": 0,
+                    "unit": unit,
+                    "vs_baseline": 0.0,
+                    "error": f"bench watchdog fired after {seconds:.0f}s "
+                    "(accelerator unreachable or hung)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
     from alaz_tpu.__main__ import _honor_jax_platforms
 
@@ -252,9 +282,24 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--profile", default="")
     p.add_argument("--e2e", action="store_true")
+    p.add_argument("--watchdog-s", type=float, default=900.0,
+                   help="hard exit with an error JSON line after this long")
     args = p.parse_args()
+    watchdog = None
+    if args.watchdog_s > 0:
+        if args.e2e:
+            metric, unit = "e2e_ingest_to_score_rows_per_sec", "rows/s"
+        elif args.model != "graphsage":
+            metric, unit = (
+                f"gnn_inference_edges_per_sec_per_chip[{args.model}]", "edges/s"
+            )
+        else:
+            metric, unit = "gnn_inference_edges_per_sec_per_chip", "edges/s"
+        watchdog = _arm_watchdog(args.watchdog_s, metric, unit)
 
     out = bench_e2e(args) if args.e2e else bench_model(args)
+    if watchdog is not None:
+        watchdog.cancel()
     print(json.dumps(out))
 
 
