@@ -153,12 +153,11 @@ def bench_model(args) -> dict:
         f"flops/step={flops_per_step/1e9:.2f}G peak={peak/1e12:.0f}T",
         file=sys.stderr,
     )
+    metric, unit = _metric_for(args)
     return {
-        "metric": f"gnn_inference_edges_per_sec_per_chip[{cfg.model}]"
-        if args.model != "graphsage"
-        else "gnn_inference_edges_per_sec_per_chip",
+        "metric": metric,
         "value": round(edges_per_s),
-        "unit": "edges/s",
+        "unit": unit,
         "vs_baseline": round(edges_per_s / 1_000_000, 3),
         "mfu": round(mfu, 4),
         "step_ms": round(best_dt * 1e3, 3),
@@ -181,7 +180,8 @@ def bench_e2e(args) -> dict:
 
     if not native.available():
         print("# native ingest unavailable; e2e bench needs libalaz_ingest.so", file=sys.stderr)
-        return {"metric": "e2e_rows_per_sec", "value": 0, "unit": "rows/s", "vs_baseline": 0.0}
+        metric, unit = _metric_for(args)
+        return {"metric": metric, "value": 0, "unit": unit, "vs_baseline": 0.0}
 
     cfg = ModelConfig(model="graphsage", hidden_dim=args.hidden, num_layers=2)
     init, apply = get_model(cfg.model)
@@ -229,12 +229,23 @@ def bench_e2e(args) -> dict:
         f"wall={dt*1e3:.1f}ms",
         file=sys.stderr,
     )
+    metric, unit = _metric_for(args)
     return {
-        "metric": "e2e_ingest_to_score_rows_per_sec",
+        "metric": metric,
         "value": round(rows_per_s),
-        "unit": "rows/s",
+        "unit": unit,
         "vs_baseline": round(rows_per_s / 200_000, 3),  # reference: 200k req/s bar
     }
+
+
+def _metric_for(args) -> tuple[str, str]:
+    """The single source of the (metric, unit) names the run will print —
+    shared by the result payloads and the watchdog's error line."""
+    if args.e2e:
+        return "e2e_ingest_to_score_rows_per_sec", "rows/s"
+    if args.model != "graphsage":
+        return f"gnn_inference_edges_per_sec_per_chip[{args.model}]", "edges/s"
+    return "gnn_inference_edges_per_sec_per_chip", "edges/s"
 
 
 def _arm_watchdog(seconds: float, metric: str, unit: str):
@@ -287,15 +298,7 @@ def main() -> None:
     args = p.parse_args()
     watchdog = None
     if args.watchdog_s > 0:
-        if args.e2e:
-            metric, unit = "e2e_ingest_to_score_rows_per_sec", "rows/s"
-        elif args.model != "graphsage":
-            metric, unit = (
-                f"gnn_inference_edges_per_sec_per_chip[{args.model}]", "edges/s"
-            )
-        else:
-            metric, unit = "gnn_inference_edges_per_sec_per_chip", "edges/s"
-        watchdog = _arm_watchdog(args.watchdog_s, metric, unit)
+        watchdog = _arm_watchdog(args.watchdog_s, *_metric_for(args))
 
     out = bench_e2e(args) if args.e2e else bench_model(args)
     if watchdog is not None:
