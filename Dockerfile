@@ -1,0 +1,58 @@
+# Two-stage build for the alaz-tpu scorer image (the reference ships the
+# same shape: toolchain stage compiles the native artifact, a slim runtime
+# stage carries only the binary — Dockerfile:1-12, Dockerfile.default).
+#
+#   docker build -t alaz-tpu:latest .
+#   docker build --build-arg JAX_VARIANT=cpu -t alaz-tpu:cpu .   # data-plane-only
+#
+# resources/alaz-tpu.yaml deploys this image; entry is `python -m alaz_tpu
+# serve` (env-driven, main.go:28-188 analog).
+
+FROM python:3.11-slim-bookworm AS builder
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY alaz_tpu/native/ alaz_tpu/native/
+# libalaz_ingest.so (ring + window accumulators) and the example
+# out-of-process agent that speaks the ingest-socket frame protocol
+RUN make -C alaz_tpu/native clean && make -C alaz_tpu/native all agent
+
+FROM python:3.11-slim-bookworm
+# procps: procfs backfill + node gauges read /proc with ps-style tools
+# available for debugging; ca-certificates: TLS legs (backend datastore,
+# log streamer); zstd ships libzstd for the Kafka codec's ctypes binding
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends procps ca-certificates zstd \
+    && rm -rf /var/lib/apt/lists/*
+
+# TPU nodes: jax[tpu] pulls libtpu via the Google releases index.
+# JAX_VARIANT=cpu builds a CPU-only image for data-plane nodes.
+ARG JAX_VARIANT=tpu
+# kubernetes: the live LIST+WATCH collector (k8s_watch.py) downgrades to
+# injected mode without it — the manifest's RBAC exists for this client
+RUN pip install --no-cache-dir \
+    "jax[${JAX_VARIANT}]" \
+    flax \
+    optax \
+    orbax-checkpoint \
+    einops \
+    numpy \
+    kubernetes
+
+WORKDIR /app
+COPY alaz_tpu/ alaz_tpu/
+COPY testconfig/ testconfig/
+COPY bench.py README.md ./
+# native artifacts from the builder stage; graph/native.py loads the
+# prebuilt .so directly when no toolchain is present
+COPY --from=builder /src/alaz_tpu/native/libalaz_ingest.so alaz_tpu/native/
+COPY --from=builder /src/alaz_tpu/native/agent_example alaz_tpu/native/
+
+ENV PYTHONUNBUFFERED=1
+# sanity: the package imports and the CLI parses before the image ships
+RUN python -c "import alaz_tpu.__main__" \
+    && python -m alaz_tpu --help >/dev/null
+
+ENTRYPOINT ["python", "-m", "alaz_tpu"]
+CMD ["serve"]

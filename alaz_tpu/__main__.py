@@ -150,10 +150,17 @@ def cmd_serve(args) -> int:
     from alaz_tpu.sources.replay import ReplaySource
 
     cfg = RuntimeConfig.from_env()
-    if not args.config:
-        # no replay source: events come from agents on THIS node, so pids
-        # are local — the procfs backfill and zombie reaper apply
-        cfg.local_pids = True
+    if not args.config and not cfg.local_pids:
+        # Live serve without LOCAL_PIDS: the procfs backfill and zombie
+        # reaper stay off. They are explicit opt-in (LOCAL_PIDS=1, with
+        # PROC_ROOT=/host/proc when containerized) because probing agent
+        # pids against the wrong pid namespace tears down live join state.
+        print(
+            "serve: LOCAL_PIDS not set — procfs backfill and zombie "
+            "reaper disabled (set LOCAL_PIDS=1 and PROC_ROOT if agent "
+            "pids are resolvable on this node)",
+            file=sys.stderr,
+        )
     interner = Interner()
     params = None
     if args.ckpt:

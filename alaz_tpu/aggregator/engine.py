@@ -108,10 +108,19 @@ class Aggregator:
         interner: Optional[Interner] = None,
         config: Optional[RuntimeConfig] = None,
         cluster: Optional[ClusterInfo] = None,
+        proc_root: str | None = None,
     ):
         self.ds = ds
         self.interner = interner if interner is not None else Interner()
         self.config = config if config is not None else RuntimeConfig()
+        # where tracked pids live: /proc by default, /host/proc when the
+        # service runs containerized with the host procfs mounted. All
+        # liveness probes go through this root, never the service's own
+        # pid namespace (see reap_zombies). Derives from config unless a
+        # caller overrides it directly (tests).
+        self.proc_root = (
+            proc_root if proc_root is not None else self.config.proc_root
+        )
         self.cluster = cluster if cluster is not None else ClusterInfo(self.interner)
         self.socket_lines = SocketLineStore()
         self.h2 = Http2Assembler()
@@ -137,7 +146,7 @@ class Aggregator:
     def backfill_from_proc(
         self,
         pids: list[int] | None = None,
-        proc_root: str = "/proc",
+        proc_root: str | None = None,
         now_ns: int | None = None,
     ) -> int:
         """Cold-start: seed socket lines for connections that predate this
@@ -148,6 +157,7 @@ class Aggregator:
         arrive."""
         from alaz_tpu.aggregator.procfs import backfill_socket_lines
 
+        proc_root = proc_root if proc_root is not None else self.proc_root
         now_ns = now_ns if now_ns is not None else time.time_ns()
         created = backfill_socket_lines(
             self.socket_lines, pids=pids, proc_root=proc_root, now_ns=now_ns
@@ -230,14 +240,33 @@ class Aggregator:
         self.ds.persist_alive_connections(out)
 
     def reap_zombies(self, kill_fn=None) -> list[int]:
-        """Probe every tracked pid with signal 0 and tear down the state
-        of processes that died without an EXIT event — the 2-minute
-        zombie reaper (data.go:192-219). ``kill_fn`` is injectable for
-        tests; defaults to os.kill."""
+        """Tear down the state of processes that died without an EXIT
+        event — the 2-minute zombie reaper (data.go:192-219). The
+        default probe is existence of ``<proc_root>/<pid>``, NOT
+        ``kill(pid, 0)``: tracked pids come from agents on the node and
+        are host pids, while this service may run in a container with
+        its own pid namespace — kill() would consult the wrong process
+        table and reap every live pid. ``kill_fn`` is injectable for
+        tests and for callers that really do share a pid namespace."""
         import os as os_mod
 
         if kill_fn is None:
-            kill_fn = os_mod.kill
+            root = self.proc_root
+            if not os_mod.path.isdir(root):
+                # an unmounted/typoed proc root would read as "every pid
+                # is dead" and tear down ALL join state each sweep — a
+                # destructive misconfiguration that must be loud, not a
+                # silent purge
+                log.error(
+                    f"zombie reaper: proc root {root!r} does not exist; "
+                    "skipping sweep (check PROC_ROOT / the procfs mount)"
+                )
+                return []
+
+            def kill_fn(pid, _sig, _root=root):
+                if not os_mod.path.isdir(os_mod.path.join(_root, str(pid))):
+                    raise ProcessLookupError(pid)
+
         dead: list[int] = []
         for pid in list(self.live_pids):
             try:

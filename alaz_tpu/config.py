@@ -21,15 +21,35 @@ from typing import Any, Mapping
 _PREFIX = "ALAZ_TPU_"
 
 
+def lookup_env(name: str, default: str | None = None, env=None) -> str | None:
+    """The prefix-aware lookup (ALAZ_TPU_NAME wins over NAME) against an
+    arbitrary mapping — for modules that take an injectable env."""
+    if env is None:
+        env = os.environ
+    return env.get(_PREFIX + name, env.get(name, default))
+
+
 def _env(name: str, default: str | None = None) -> str | None:
-    return os.environ.get(_PREFIX + name, os.environ.get(name, default))
+    return lookup_env(name, default)
+
+
+def parse_bool(v: str | None, default: bool = False) -> bool:
+    """One accepted-token set for every boolean knob. An unrecognized
+    token keeps the DEFAULT rather than reading as False — a typo in a
+    default-True security knob (LOG_BACKEND_TLS) must not silently
+    disable it."""
+    if v is None:
+        return default
+    t = v.strip().lower()
+    if t in ("1", "true", "yes", "on"):
+        return True
+    if t in ("0", "false", "no", "off"):
+        return False
+    return default
 
 
 def env_bool(name: str, default: bool = False) -> bool:
-    v = _env(name)
-    if v is None:
-        return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    return parse_bool(_env(name), default)
 
 
 def env_int(name: str, default: int) -> int:
@@ -221,10 +241,14 @@ class RuntimeConfig:
     k8s_enabled: bool = True
     exclude_namespaces: str = ""
     send_alive_tcp_connections: bool = False
-    # True only when tracked pids are processes of THIS host (live-agent
-    # mode): gates the kill(pid,0) zombie reaper — replayed/remote pids
-    # must never be probed against the service host's process table
+    # True only when tracked pids are processes of THIS node (live-agent
+    # mode): gates the zombie reaper's <proc_root>/<pid> existence probe
+    # and the cold-start backfill — replayed/remote pids must never be
+    # probed against this node's procfs
     local_pids: bool = False
+    # procfs root for pid liveness probes and cold-start backfill:
+    # /host/proc when containerized with the host procfs mounted
+    proc_root: str = "/proc"
     # ingest-idle grace before open windows flush (traffic-lull liveness).
     # Deliberately much larger than a window: a flush during an upstream
     # delivery STALL (agent buffering through a network hiccup) drops the
@@ -244,5 +268,6 @@ class RuntimeConfig:
             exclude_namespaces=env_str("EXCLUDE_NAMESPACES", ""),
             send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
             local_pids=env_bool("LOCAL_PIDS", False),
+            proc_root=env_str("PROC_ROOT", "/proc"),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
         )

@@ -282,11 +282,12 @@ def _decompress(codec: int, data: bytes) -> bytes | None:
             return lz4_frame_decompress(data)
         except Exception:
             return None
-    if codec == 4:  # zstd
+    if codec == 4:  # zstd (system libzstd via ctypes → wheel fallback;
+        # never silently absent — decompress.go:87 decodes unconditionally)
         try:
-            import zstandard  # type: ignore
+            from alaz_tpu.protocols.compression import zstd_decompress
 
-            return zstandard.ZstdDecompressor().decompress(data)
+            return zstd_decompress(data)
         except Exception:
             return None
     return None

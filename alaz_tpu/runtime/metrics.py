@@ -103,10 +103,20 @@ class Metrics:
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
+        def esc(v) -> str:
+            # exposition format: backslash, double-quote and newline must
+            # be escaped inside label values or the scrape line is invalid
+            return (
+                str(v)
+                .replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
         for name, labels in sorted(self.infos().items()):
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             label_str = ",".join(
-                f'{k}="{v}"' for k, v in sorted(labels.items())
+                f'{k}="{esc(v)}"' for k, v in sorted(labels.items())
             )
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric}{{{label_str}}} 1")

@@ -152,7 +152,9 @@ class Service:
         if export_backend is not None:
             sinks.append(export_backend)
         self.datastore = FanoutDataStore(sinks)
-        self.aggregator = Aggregator(self.datastore, interner=self.interner, config=self.config)
+        self.aggregator = Aggregator(
+            self.datastore, interner=self.interner, config=self.config
+        )
 
         self.score_sink = score_sink
         if self.score_sink is None and export_backend is not None and hasattr(export_backend, "persist_scores"):
@@ -192,6 +194,9 @@ class Service:
         self.scored_edges = 0
         self._paused = threading.Event()
         self._stop = threading.Event()
+        # persist timestamp the idle flush already drained (liveness
+        # flush fires once per idle period, not every housekeeping tick)
+        self._idle_flushed_for: float | None = None
         self._threads: List[threading.Thread] = []
 
         self.metrics.gauge("l7.pending", lambda: self.l7_queue.pending_events)
@@ -292,8 +297,9 @@ class Service:
                 # for the next L7 batch to arrive (input lulls)
                 self._flush_retries_counted()
                 # zombie reaper: processes that died without an EXIT event
-                # (the kill(pid,0) sweep, data.go:192-219). Valid ONLY when
-                # tracked pids belong to this host — replayed/remote pids
+                # (data.go:192-219; probes <proc_root>/<pid> existence, NOT
+                # kill(pid,0) — see engine.reap_zombies). Valid ONLY when
+                # tracked pids belong to this node — replayed/remote pids
                 # would all look dead and lose their join state.
                 if self.config.local_pids:
                     self.aggregator.reap_zombies()
@@ -305,8 +311,16 @@ class Service:
                 # arrive after their window was idle-flushed drop as late.
                 last = getattr(self.graph_store, "last_persist_monotonic", None)
                 grace_s = max(self.config.idle_flush_grace_s, 2 * self.config.window_s)
-                if last is not None and time_module.monotonic() - last > grace_s:
+                if (
+                    last is not None
+                    and last != self._idle_flushed_for
+                    and time_module.monotonic() - last > grace_s
+                ):
                     self.graph_store.flush()
+                    # one flush per idle period: until a new persist moves
+                    # the timestamp there is nothing more to drain, so
+                    # don't re-take the store lock every tick
+                    self._idle_flushed_for = last
                 # channel-lag log (data.go:177-186 cadence)
                 lag = {
                     q.name: q.stats()

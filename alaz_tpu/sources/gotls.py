@@ -157,7 +157,17 @@ def go_version_at_least(version: str, major: int, minor: int) -> bool:
     return (int(m.group(1)), int(m.group(2))) >= (major, minor)
 
 
-_RET_LINE = re.compile(r"^\s*([0-9a-f]+):\s+c3\s+ret", re.IGNORECASE)
+# Match the disassembly line of any return instruction. The byte column
+# may carry prefixes before the final c3 ("f3 c3  repz ret" from some
+# toolchains/cgo objects, "f2 c3  bnd ret" with CET) and the mnemonic
+# varies (ret/retq/repz ret); arm64 objdump prints one 8-hex word
+# ("d65f03c0  ret"). Keying on the mnemonic containing a ret token —
+# not on a lone "c3 ret" — keeps exit uprobes on every encoding.
+_RET_LINE = re.compile(
+    r"^\s*([0-9a-f]+):\s+(?:[0-9a-f]{2}\s+)*(?:[0-9a-f]{8}\s+)?"
+    r"(?:(?:repz?|bnd)\s+)?retq?\b",
+    re.IGNORECASE,
+)
 
 
 def find_ret_offsets(

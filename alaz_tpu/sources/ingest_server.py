@@ -98,8 +98,38 @@ class IngestServer:
             self.address: str | tuple = str(path)
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             if self._unix_path.exists():
-                # a stale socket file from a previous run blocks bind
-                self._unix_path.unlink()
+                # A stale socket file from a previous run blocks bind —
+                # but only unlink if nothing answers: silently stealing a
+                # LIVE instance's listener would redirect its agents here.
+                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    probe.settimeout(0.5)
+                    probe.connect(str(path))
+                except (ConnectionRefusedError, FileNotFoundError):
+                    # nothing accepting: stale file from a dead process.
+                    # missing_ok: a concurrently-restarting sibling may
+                    # have reclaimed it first — bind() then reports the
+                    # conflict cleanly
+                    self._unix_path.unlink(missing_ok=True)
+                except (socket.timeout, BlockingIOError):
+                    # a full backlog on a stalled-but-live listener shows
+                    # as EAGAIN (BlockingIOError; AF_UNIX connect under
+                    # settimeout is non-blocking) or as a timeout —
+                    # ambiguity must favor NOT stealing
+                    self._sock.close()
+                    raise OSError(
+                        f"ingest socket {path} did not answer a connect "
+                        "probe but may be live (backlog full?); refusing "
+                        "to steal it — remove the file manually if stale"
+                    ) from None
+                else:
+                    self._sock.close()
+                    raise OSError(
+                        f"ingest socket {path} is in use by a live process; "
+                        "refusing to steal its listener"
+                    )
+                finally:
+                    probe.close()
             self._sock.bind(str(path))
         else:
             self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

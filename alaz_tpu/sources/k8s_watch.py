@@ -209,10 +209,12 @@ class K8sWatchSource:
         exclude_namespaces: Iterable[str] = (),
         resync_interval_s: float = 120.0,
         in_cluster: bool = True,
+        error_backoff_s: float = 5.0,
     ):
         self.exclude = set(exclude_namespaces)
         self.resync_interval_s = resync_interval_s
         self.in_cluster = in_cluster
+        self.error_backoff_s = error_backoff_s
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._service = None
@@ -272,13 +274,20 @@ class K8sWatchSource:
             ResourceType.STATEFULSET: apps.list_stateful_set_for_all_namespaces,
         }
 
-    def _kind_loop(self, kind: ResourceType, lister) -> None:  # pragma: no cover - needs a cluster
+    def _kind_loop(self, kind: ResourceType, lister, watch_factory=None) -> None:
         """One informer: LIST (seed + resync, with vanished-object DELETE
         reconciliation), then WATCH re-established from the last-seen
         resourceVersion until the resync deadline; only then re-LIST
         (informer.go:67-157; a LIST is the expensive call, so the stream's
-        30s server timeout must NOT trigger one)."""
-        import kubernetes as k8s  # type: ignore
+        30s server timeout must NOT trigger one). A 410 Gone from the
+        watch means the resourceVersion expired server-side — that IS a
+        re-LIST trigger, taken immediately without the error backoff.
+        ``watch_factory`` is the client seam: the kubernetes package's
+        Watch by default, protocol-faithful fakes in tests."""
+        if watch_factory is None:  # pragma: no cover - needs the client
+            import kubernetes as k8s  # type: ignore
+
+            watch_factory = k8s.watch.Watch
 
         known: dict[str, object] = {}
         while not self._stop.is_set():
@@ -292,8 +301,13 @@ class K8sWatchSource:
                     self.inject(msg)
                 rv = resp.metadata.resource_version
                 deadline = time.monotonic() + self.resync_interval_s
-                while not self._stop.is_set() and time.monotonic() < deadline:
-                    w = k8s.watch.Watch()
+                expired = False
+                while (
+                    not expired
+                    and not self._stop.is_set()
+                    and time.monotonic() < deadline
+                ):
+                    w = watch_factory()
                     try:
                         for raw in w.stream(
                             lister, resource_version=rv, timeout_seconds=30
@@ -314,12 +328,20 @@ class K8sWatchSource:
                                 self.inject(msg)
                             if self._stop.is_set():
                                 break
+                    except Exception as exc:
+                        if getattr(exc, "status", None) == 410:
+                            # expired rv: the server forgot this history
+                            # window; re-seed via LIST right away
+                            log.info(f"k8s watch {kind.value}: 410 Gone, re-listing")
+                            expired = True
+                        else:
+                            raise
                     finally:
                         w.stop()
                     # stream timeout: loop re-watches from the last rv
             except Exception as exc:
                 log.warning(f"k8s watch {kind.value} failed: {exc}")
-                self._stop.wait(5.0)
+                self._stop.wait(self.error_backoff_s)
 
     def stop(self) -> None:
         self._stop.set()

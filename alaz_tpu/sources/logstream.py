@@ -3,14 +3,18 @@
 The reference watches CRI log files with fsnotify, seeks preexisting files
 to the end, and ships a metadata line + raw bytes over pooled TLS TCP
 connections with a 1-byte liveness probe ('X' close marker, pool.go:24-45)
-and a 10s container-poll reconcile (stream.go:324-430). Here the transport
-is a pluggable connection factory (sockets in production, in-memory sinks
-in tests); file watching is poll-based (inotify adds a dependency for no
-behavioral difference at 10s reconcile granularity).
+and a 10s container-poll reconcile (stream.go:324-430). The transport is a
+pluggable connection factory: ``SocketConnection`` + ``dial`` below are
+the production leg (TLS per stream.go:51-66 — but the CA comes from
+env/config, not an embedded SaaS certificate), and tests use in-memory
+sinks or a loopback TLS server. File watching is poll-based (inotify adds
+a dependency for no behavioral difference at 10s reconcile granularity).
 """
 
 from __future__ import annotations
 
+import socket
+import ssl
 import threading
 import time
 from dataclasses import dataclass, field
@@ -33,6 +37,104 @@ class Connection:
 
     def close(self) -> None:
         pass
+
+
+class SocketConnection(Connection):
+    """A pooled TCP/TLS connection. ``alive()`` is the pool.go:24-45
+    probe: read one byte under a 1 ms deadline — a timeout means the
+    peer simply has nothing to say (alive), EOF or an error means dead,
+    and the byte ``X`` is the server's explicit close marker. Sends
+    carry a deadline too: a peer that accepted the conn but stopped
+    reading (zero TCP window) must not wedge the shipper thread —
+    timeout surfaces as a send failure and the conn is retired."""
+
+    def __init__(self, sock: socket.socket, send_timeout_s: float = 60.0):
+        self._sock = sock
+        self._send_timeout_s = send_timeout_s
+
+    def send(self, data: bytes) -> None:
+        self._sock.settimeout(self._send_timeout_s)
+        try:
+            self._sock.sendall(data)
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+
+    def alive(self) -> bool:
+        try:
+            self._sock.settimeout(0.001)
+            buf = self._sock.recv(1)
+        except (TimeoutError, socket.timeout, ssl.SSLWantReadError, BlockingIOError):
+            return True
+        except OSError:
+            return False
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+        if not buf:  # EOF: peer closed
+            return False
+        if buf == b"X":  # explicit close marker
+            return False
+        return True  # unexpected data on a send-only conn: ignore
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def dial(
+    host: str,
+    port: int,
+    use_tls: bool = True,
+    ca_file: str | None = None,
+    server_name: str | None = None,
+    timeout_s: float = 60.0,
+) -> SocketConnection:
+    """Production connection factory body (stream.go:81-105: 60 s dial
+    timeout, TLS by default). ``ca_file`` pins a private CA; None uses
+    the system trust store (the reference instead embeds its SaaS CA —
+    caCert.go — which only makes sense for a fixed backend)."""
+    raw = socket.create_connection((host, port), timeout=timeout_s)
+    if not use_tls:
+        raw.settimeout(None)
+        return SocketConnection(raw)
+    ctx = ssl.create_default_context(cafile=ca_file)
+    try:
+        wrapped = ctx.wrap_socket(raw, server_hostname=server_name or host)
+    except BaseException:
+        raw.close()
+        raise
+    wrapped.settimeout(None)
+    return SocketConnection(wrapped)
+
+
+def factory_from_env(env=None) -> Callable[[], Connection]:
+    """Build the dial factory from the reference's env surface:
+    LOG_BACKEND (host:port), LOG_BACKEND_TLS (default true),
+    LOG_BACKEND_SERVER_NAME, plus LOG_BACKEND_CA_FILE for the CA pin
+    (stream.go:51-66,76-124). All accept the ALAZ_TPU_ prefix like every
+    other knob (config.lookup_env)."""
+    from alaz_tpu.config import lookup_env, parse_bool
+
+    backend = lookup_env("LOG_BACKEND", "", env) or ""
+    if not backend or ":" not in backend:
+        raise ValueError("LOG_BACKEND must be host:port")
+    host, _, port_s = backend.rpartition(":")
+    port = int(port_s)
+    use_tls = parse_bool(lookup_env("LOG_BACKEND_TLS", None, env), True)
+    ca_file = lookup_env("LOG_BACKEND_CA_FILE", None, env) or None
+    server_name = lookup_env("LOG_BACKEND_SERVER_NAME", None, env) or None
+
+    def factory() -> Connection:
+        return dial(host, port, use_tls=use_tls, ca_file=ca_file, server_name=server_name)
+
+    return factory
 
 
 class ConnectionPool:

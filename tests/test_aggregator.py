@@ -688,3 +688,32 @@ class TestZombieReaper:
 
         assert agg.reap_zombies(kill_fn=fake_kill) == []
         assert 300 in agg.live_pids
+
+    def test_default_probe_uses_proc_root_not_own_namespace(self, tmp_path):
+        """The default liveness probe consults the CONFIGURED proc root
+        (host procfs when containerized), never this process's own pid
+        table — host pids are invisible in a container pid namespace and
+        kill(pid,0) would reap every live process (ADVICE r2)."""
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner,
+                         cluster=make_cluster(interner),
+                         proc_root=str(tmp_path))
+        (tmp_path / "100").mkdir()  # pid 100 alive in the agent namespace
+        _establish(agg, pid=100, fd=7)
+        _establish(agg, pid=200, fd=8)  # no procfs dir: dead
+        assert agg.reap_zombies() == [200]
+        assert 100 in agg.live_pids
+        assert agg.socket_lines.get(100, 7) is not None
+        assert agg.socket_lines.get(200, 8) is None
+
+    def test_missing_proc_root_skips_sweep_not_mass_teardown(self, tmp_path):
+        """An unmounted/typoed proc root must NOT read as 'all pids
+        dead' — the sweep is skipped loudly and join state survives."""
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner,
+                         cluster=make_cluster(interner),
+                         proc_root=str(tmp_path / "not-mounted"))
+        _establish(agg, pid=100, fd=7)
+        assert agg.reap_zombies() == []
+        assert 100 in agg.live_pids
+        assert agg.socket_lines.get(100, 7) is not None

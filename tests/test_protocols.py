@@ -416,13 +416,17 @@ def _compact_bytes(b: bytes | None) -> bytes:
 _EMPTY_TAGS = _uvarint(0)
 
 
-def _record_batch(key: bytes, value: bytes) -> bytes:
+def _record_batch(key: bytes, value: bytes, codec: int = 0) -> bytes:
     rec_body = bytes([0]) + _zigzag(0) + _zigzag(0)
     rec_body += _zigzag(len(key)) + key
     rec_body += _zigzag(len(value)) + value
     rec_body += _zigzag(0)
     record = _zigzag(len(rec_body)) + rec_body
-    tail = struct.pack("!iBihiqqqhii", 0, 2, 0, 0, 0, 0, 0, -1, -1, -1, 1) + record
+    if codec == 4:  # zstd-compressed records section, attributes bit set
+        import zstandard
+
+        record = zstandard.ZstdCompressor().compress(record)
+    tail = struct.pack("!iBihiqqqhii", 0, 2, 0, codec, 0, 0, 0, -1, -1, -1, 1) + record
     return struct.pack("!qi", 0, len(tail)) + tail
 
 
@@ -654,3 +658,123 @@ class TestCompression:
         lz4_data = self._lz4_compress_literals(b"via lz4")
         assert _decompress(3, lz4_data) == b"via lz4"
         assert _decompress(0, b"raw") == b"raw"
+
+    def _zstd_legs(self):
+        """(name, decode_fn) for every available zstd backend — both must
+        honor the same contract."""
+        import pytest
+
+        from alaz_tpu.protocols import compression as cx
+
+        zstandard = pytest.importorskip("zstandard")  # tests need a compressor
+        legs = []
+        if cx._load_libzstd() is not None:
+            legs.append(("ctypes", cx.zstd_decompress_ctypes))
+        legs.append(
+            ("wheel", lambda d, max_out=1 << 30: cx._zstd_decompress_wheel(
+                zstandard, d, max_out
+            ))
+        )
+        return zstandard, legs
+
+    def test_zstd_ctypes_binding(self):
+        """The system-libzstd ctypes path must decode real zstd frames —
+        this is what guarantees zstd works without the optional wheel
+        (decompress.go:87 decodes unconditionally)."""
+        import pytest
+
+        from alaz_tpu.protocols import compression as cx
+
+        zstandard = pytest.importorskip("zstandard")
+        if cx._load_libzstd() is None:
+            pytest.skip("no system libzstd")
+        payload = b"zstd kafka record batch payload " * 64
+        frame = zstandard.ZstdCompressor(level=3).compress(payload)
+        assert cx.zstd_decompress_ctypes(frame) == payload
+        # frame without a content-size header (streaming writer)
+        cobj = zstandard.ZstdCompressor().compressobj()
+        frame2 = cobj.compress(payload) + cobj.flush()
+        assert cx.zstd_decompress_ctypes(frame2) == payload
+
+    def test_zstd_corrupt_raises(self):
+        import pytest
+
+        from alaz_tpu.protocols import compression as cx
+
+        _, legs = self._zstd_legs()
+        with pytest.raises(cx.CorruptData):
+            cx.zstd_decompress(b"\x28\xb5\x2f\xfdgarbage-not-a-frame")
+        for name, decode in legs:
+            with pytest.raises(cx.CorruptData):
+                decode(b"\x28\xb5\x2f\xfdtruncated")
+
+    def test_zstd_truncated_frame_never_partial(self):
+        """A frame cut mid-stream must raise, not return partial bytes —
+        partial output would flow into record parsing as 'decoded'."""
+        import pytest
+
+        from alaz_tpu.protocols import compression as cx
+
+        zstandard, legs = self._zstd_legs()
+        frame = zstandard.ZstdCompressor().compress(b"q" * (1 << 20))
+        cut = frame[: len(frame) // 2]
+        for name, decode in legs:
+            with pytest.raises(cx.CorruptData):
+                decode(cut)
+
+    def test_zstd_backends_agree_on_multiframe_and_bound(self):
+        """Concatenated frames decode identically via either backend, and
+        the zip-bomb bound applies to both."""
+        import pytest
+
+        from alaz_tpu.protocols import compression as cx
+
+        zstandard, legs = self._zstd_legs()
+        c = zstandard.ZstdCompressor()
+        two = c.compress(b"a" * 1000) + c.compress(b"b" * 1000)
+        expect = b"a" * 1000 + b"b" * 1000
+        bomb = c.compress(b"\x00" * (1 << 20))
+        for name, decode in legs:
+            assert decode(two) == expect, name
+            with pytest.raises(cx.CorruptData):
+                decode(bomb, max_out=1 << 10)
+
+    def test_zstd_record_batch_decodes_on_the_wire(self):
+        """A fetch-style record batch with attributes codec=4 (zstd)
+        yields its records — the decompress.go:87 parity case."""
+        import pytest
+
+        pytest.importorskip("zstandard")  # the test's compressor
+        from alaz_tpu.protocols.kafka import decode_record_set
+
+        batch = _record_batch(b"zk", b"zv", codec=4)
+        msgs = decode_record_set("orders", 0, batch, "CONSUME")
+        assert len(msgs) == 1
+        assert msgs[0].key == "zk" and msgs[0].value == "zv"
+
+    def test_zstd_without_wheel_falls_back_to_libzstd(self, monkeypatch):
+        """Simulate the bare environment: zstandard missing → the kafka
+        codec table still decodes via libzstd."""
+        import builtins
+        import sys
+
+        import pytest
+
+        zstandard = pytest.importorskip("zstandard")
+
+        from alaz_tpu.protocols import compression as cx
+        from alaz_tpu.protocols.kafka import _decompress
+
+        if cx._load_libzstd() is None:
+            pytest.skip("no system libzstd")
+        frame = zstandard.ZstdCompressor().compress(b"no-wheel environment")
+        real_import = builtins.__import__
+
+        def no_zstandard(name, *a, **kw):
+            if name == "zstandard":
+                raise ImportError("simulated bare environment")
+            return real_import(name, *a, **kw)
+
+        monkeypatch.delitem(sys.modules, "zstandard", raising=False)
+        monkeypatch.setattr(builtins, "__import__", no_zstandard)
+        assert _decompress(4, frame) == b"no-wheel environment"

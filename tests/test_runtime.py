@@ -27,6 +27,17 @@ class TestMetrics:
         text = m.render_prometheus()
         assert "alaz_tpu_a 4" in text
 
+    def test_info_label_values_escaped(self):
+        """Exposition format: backslash, quote and newline in label
+        values must be escaped or the scrape line is invalid."""
+        m = Metrics()
+        m.info("weird", kind='v5e "lite"', path="a\\b", note="x\ny")
+        text = m.render_prometheus()
+        assert 'kind="v5e \\"lite\\""' in text
+        assert 'path="a\\\\b"' in text
+        assert 'note="x\\ny"' in text
+        assert "\ny" not in text.replace("\\n", "")  # no raw newline leaked
+
 
 class TestHealth:
     def test_stop_resume_protocol(self):

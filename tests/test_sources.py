@@ -1,9 +1,12 @@
 """Sources plane: replay pacing, k8s fan-out, container index, TLS attach,
 log streaming, dist tracing."""
 
+import socket
+import threading
 import time
 
 import numpy as np
+import pytest
 
 from alaz_tpu.aggregator.dist_tracing import DistTracingCorrelator
 from alaz_tpu.config import SimulationConfig
@@ -183,6 +186,208 @@ class TestLogStreamer:
         c2 = pool.get()  # dead conn discarded, new one created
         assert c2 is not c1
         assert pool.discarded == 1
+
+
+def _make_self_signed(tmp_path):
+    """Self-signed localhost cert via the openssl CLI (no new deps)."""
+    import subprocess
+
+    key, crt = tmp_path / "key.pem", tmp_path / "crt.pem"
+    r = subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "2",
+            "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    if r.returncode != 0:
+        pytest.skip(f"openssl unavailable: {r.stderr[-200:]}")
+    return key, crt
+
+
+class _LoopbackTlsServer:
+    """Accepts TLS conns, records every byte, can order a conn closed
+    with the 'X' marker (the backend side of pool.go:24-45)."""
+
+    def __init__(self, key, crt):
+        import ssl as ssl_mod
+
+        self.received = []
+        self._close_next = threading.Event()
+        ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile=str(crt), keyfile=str(key))
+        self._ctx = ctx
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(4)
+        self._lsock.settimeout(0.2)
+        self.port = self._lsock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def order_close_next(self):
+        self._close_next.set()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                raw, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn = self._ctx.wrap_socket(raw, server_side=True)
+            except OSError:
+                raw.close()
+                continue
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        conn.settimeout(0.2)
+        while not self._stop.is_set():
+            if self._close_next.is_set():
+                self._close_next.clear()
+                try:
+                    conn.sendall(b"X")
+                finally:
+                    conn.close()
+                return
+            try:
+                data = conn.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                conn.close()
+                return
+            self.received.append(data)
+
+    def stop(self):
+        self._stop.set()
+        self._lsock.close()
+
+
+class TestTlsTransport:
+    """G21's production leg: logs flow over a REAL TLS socket with the
+    CA pinned via env, and the 1-byte 'X' liveness protocol retires
+    server-closed conns from the pool (stream.go:51-66,214-289,
+    pool.go:24-45)."""
+
+    def test_logs_flow_over_loopback_tls(self, tmp_path, monkeypatch):
+        import time as time_mod
+
+        from alaz_tpu.sources.logstream import factory_from_env
+
+        key, crt = _make_self_signed(tmp_path)
+        srv = _LoopbackTlsServer(key, crt)
+        try:
+            monkeypatch.setenv("LOG_BACKEND", f"localhost:{srv.port}")
+            monkeypatch.setenv("LOG_BACKEND_CA_FILE", str(crt))
+            monkeypatch.setenv("LOG_BACKEND_SERVER_NAME", "localhost")
+            pool = ConnectionPool(factory_from_env())
+            ls = LogStreamer(pool)
+            f = tmp_path / "c1.log"
+            f.write_text("")
+            ls.watch("c1", f, metadata={"pod": "p9"})
+            f.write_text("over tls\n")
+            assert ls.pump_once() == len("over tls\n")
+            deadline = time_mod.monotonic() + 5
+            while time_mod.monotonic() < deadline and not srv.received:
+                time_mod.sleep(0.02)
+            blob = b"".join(srv.received)
+            assert blob.startswith(b"**AlazLogs_c1_p9\n")
+            assert blob.endswith(b"over tls\n")
+            assert pool.created == 1
+        finally:
+            srv.stop()
+
+    def test_x_marker_retires_conn(self, tmp_path, monkeypatch):
+        import time as time_mod
+
+        from alaz_tpu.sources.logstream import factory_from_env
+
+        key, crt = _make_self_signed(tmp_path)
+        srv = _LoopbackTlsServer(key, crt)
+        try:
+            monkeypatch.setenv("LOG_BACKEND", f"127.0.0.1:{srv.port}")
+            monkeypatch.setenv("LOG_BACKEND_CA_FILE", str(crt))
+            monkeypatch.setenv("LOG_BACKEND_SERVER_NAME", "localhost")
+            pool = ConnectionPool(factory_from_env())
+            conn = pool.get()
+            assert conn.alive()
+            srv.order_close_next()
+            deadline = time_mod.monotonic() + 5
+            while time_mod.monotonic() < deadline and conn.alive():
+                time_mod.sleep(0.05)
+            assert not conn.alive()  # 'X' (or the close after it) seen
+            pool.put(conn)  # dead conn must not be re-pooled
+            assert pool._pool == []
+        finally:
+            srv.stop()
+
+    def test_untrusted_ca_rejected(self, tmp_path, monkeypatch):
+        import ssl as ssl_mod
+
+        from alaz_tpu.sources.logstream import factory_from_env
+
+        key, crt = _make_self_signed(tmp_path)
+        srv = _LoopbackTlsServer(key, crt)
+        try:
+            monkeypatch.setenv("LOG_BACKEND", f"localhost:{srv.port}")
+            monkeypatch.delenv("LOG_BACKEND_CA_FILE", raising=False)
+            with pytest.raises(ssl_mod.SSLError):
+                factory_from_env()()  # system roots don't trust our CA
+        finally:
+            srv.stop()
+
+    def test_prefixed_env_names_accepted(self):
+        """LOG_BACKEND* follows the same ALAZ_TPU_-prefix convention as
+        every other knob (config.lookup_env)."""
+        from alaz_tpu.sources.logstream import factory_from_env
+
+        env = {
+            "ALAZ_TPU_LOG_BACKEND": "logs.example:6000",
+            "ALAZ_TPU_LOG_BACKEND_TLS": "off",  # recognized false token
+        }
+        factory = factory_from_env(env)  # no raise: prefixed name resolved
+        assert callable(factory)
+
+    def test_unknown_tls_token_keeps_tls_on(self):
+        """A typo in the default-True TLS knob must not silently
+        downgrade to plaintext."""
+        from alaz_tpu.config import parse_bool
+
+        assert parse_bool("enabled", True) is True  # unknown → default
+        assert parse_bool("off", True) is False
+        assert parse_bool(None, True) is True
+
+    def test_plaintext_opt_out(self, monkeypatch, tmp_path):
+        from alaz_tpu.sources.logstream import factory_from_env
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            monkeypatch.setenv("LOG_BACKEND", f"127.0.0.1:{srv.getsockname()[1]}")
+            monkeypatch.setenv("LOG_BACKEND_TLS", "false")
+            conn = factory_from_env()()
+            peer, _ = srv.accept()
+            conn.send(b"plain")
+            assert peer.recv(5) == b"plain"
+            conn.close()
+            peer.close()
+        finally:
+            srv.close()
 
 
 class TestDistTracing:
@@ -397,6 +602,174 @@ class TestK8sWatchTranslation:
         )
         t, _ = cluster.attribute(ips)
         assert t[0] == EP_OUTBOUND
+
+
+class _FakeApiServer:
+    """Scripted apiserver speaking the lister/Watch client protocol the
+    kind loop consumes: list calls pop LIST scripts (a list of objects or
+    an exception), watch streams pop WATCH scripts (a list of raw events,
+    an exception to raise mid-stream, or clean stream timeout). Records
+    every resource_version the loop resumes from."""
+
+    def __init__(self, list_scripts, watch_scripts):
+        from types import SimpleNamespace as NS
+
+        self._NS = NS
+        self.list_scripts = list(list_scripts)
+        self.watch_scripts = list(watch_scripts)
+        self.watch_rvs = []  # resource_version per watch call
+        self.done = threading.Event()  # scripts exhausted
+        self.release = threading.Event()  # unparks the final stream
+
+    # the lister callable (list_pod_for_all_namespaces shape)
+    def lister(self, timeout_seconds=None, **kw):
+        if not self.list_scripts:
+            self.done.set()
+            raise ConnectionError("fake apiserver: no more list scripts")
+        script = self.list_scripts.pop(0)
+        if isinstance(script, Exception):
+            raise script
+        items, rv = script
+        return self._NS(items=items, metadata=self._NS(resource_version=rv))
+
+    def make_watch(self):
+        server = self
+
+        class _Watch:
+            def stream(self, lister, resource_version=None, timeout_seconds=None):
+                server.watch_rvs.append(resource_version)
+                if not server.watch_scripts:
+                    server.done.set()
+                    # park: a real stream blocks on the socket; released
+                    # by the test once it has ordered the loop to stop
+                    server.release.wait(10)
+                    return
+                script = server.watch_scripts.pop(0)
+                if isinstance(script, Exception):
+                    raise script
+                yield from script
+
+            def stop(self):
+                pass
+
+        return _Watch
+
+
+class _CollectingService:
+    def __init__(self):
+        self.msgs = []
+
+    def submit_k8s(self, msg):
+        self.msgs.append(msg)
+
+
+class TestK8sWatchLoop:
+    """The live kind-loop plumbing itself — seed, rv-resume, 410 Gone
+    re-list with delete reconciliation, error backoff — driven against a
+    scripted fake apiserver (VERDICT r2 Weak #5: these paths had never
+    executed)."""
+
+    _stub_pod = staticmethod(TestK8sWatchTranslation._stub_pod)
+
+    def _pod(self, uid, rv):
+        p = self._stub_pod(uid=uid, name=uid)
+        p.metadata.resource_version = rv
+        return p
+
+    def _run_loop(self, src, server, kind=None):
+        from alaz_tpu.events.k8s import ResourceType
+
+        t = threading.Thread(
+            target=src._kind_loop,
+            args=(kind or ResourceType.POD, server.lister, server.make_watch()),
+            daemon=True,
+        )
+        t.start()
+        assert server.done.wait(10), "loop never exhausted the script"
+        src._stop.set()
+        server.release.set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_seed_watch_resume_and_410_relist(self):
+        from alaz_tpu.events.k8s import EventType
+        from alaz_tpu.sources.k8s_watch import K8sWatchSource
+
+        pod_a, pod_b = self._pod("pod-a", "5"), self._pod("pod-b", "6")
+        gone = ConnectionError("Expired: too old resource version")
+        gone.status = 410
+        server = _FakeApiServer(
+            list_scripts=[
+                ([pod_a], "5"),  # seed
+                ([pod_b], "9"),  # re-list after 410: A vanished
+            ],
+            watch_scripts=[
+                [{"type": "ADDED", "object": pod_b}],  # then clean timeout
+                gone,  # second watch: rv expired server-side
+                # third script missing → done + park
+            ],
+        )
+        src = K8sWatchSource(error_backoff_s=30.0)  # backoff would be felt
+        svc = _CollectingService()
+        src._service = svc
+        t0 = time.monotonic()
+        self._run_loop(src, server)
+        assert time.monotonic() - t0 < 10  # 410 re-listed immediately, no backoff
+        # watch #1 resumed from the seed LIST's rv, watch #2 from pod_b's,
+        # watch #3 from the re-LIST's
+        assert server.watch_rvs == ["5", "6", "9"]
+        log_ = [(m.event_type, getattr(m.object, "uid", "")) for m in svc.msgs]
+        assert (EventType.UPDATE, "pod-a") in log_  # seed
+        assert (EventType.ADD, "pod-b") in log_  # watch event
+        assert (EventType.DELETE, "pod-a") in log_  # 410 re-list reconciliation
+        # the delete must come only after the re-list, not during the seed
+        assert log_.index((EventType.DELETE, "pod-a")) > log_.index(
+            (EventType.ADD, "pod-b")
+        )
+
+    def test_lister_error_backs_off_and_recovers(self):
+        from alaz_tpu.events.k8s import EventType
+        from alaz_tpu.sources.k8s_watch import K8sWatchSource
+
+        pod_a = self._pod("pod-a", "3")
+        server = _FakeApiServer(
+            list_scripts=[ConnectionError("apiserver down"), ([pod_a], "3")],
+            watch_scripts=[],  # first watch parks → done
+        )
+        src = K8sWatchSource(error_backoff_s=0.05)
+        svc = _CollectingService()
+        src._service = svc
+        t0 = time.monotonic()
+        self._run_loop(src, server)
+        assert time.monotonic() - t0 >= 0.05  # the backoff was taken
+        assert (EventType.UPDATE, "pod-a") in [
+            (m.event_type, getattr(m.object, "uid", "")) for m in svc.msgs
+        ]
+
+    def test_watch_delete_updates_known_no_relist_resurrection(self):
+        """A DELETE seen on the watch stream removes the object from the
+        reconciliation state — the next re-list must NOT synthesize a
+        second DELETE for it."""
+        from alaz_tpu.events.k8s import EventType
+        from alaz_tpu.sources.k8s_watch import K8sWatchSource
+
+        pod_a, pod_b = self._pod("pod-a", "5"), self._pod("pod-b", "6")
+        gone = RuntimeError("gone")
+        gone.status = 410
+        server = _FakeApiServer(
+            list_scripts=[([pod_a, pod_b], "6"), ([pod_b], "9")],
+            watch_scripts=[[{"type": "DELETED", "object": pod_a}], gone],
+        )
+        src = K8sWatchSource(error_backoff_s=30.0)
+        svc = _CollectingService()
+        src._service = svc
+        self._run_loop(src, server)
+        deletes = [
+            m for m in svc.msgs
+            if m.event_type == EventType.DELETE
+            and getattr(m.object, "uid", "") == "pod-a"
+        ]
+        assert len(deletes) == 1  # the watch one; reconcile stayed silent
 
 
 class FakeCriServer:
@@ -684,6 +1057,31 @@ class TestGoTlsDiscovery:
             assert data[off] == 0xC3
             assert plan.read.file_offset <= off < plan.read.file_offset + plan.read.size
 
+    def test_ret_line_matches_prefixed_and_arm64_encodings(self):
+        """Some toolchains/cgo objects emit prefixed returns ('f3 c3
+        repz ret', CET 'f2 c3 bnd ret'); arm64 objdump prints one hex
+        word. All are RET sites and need exit uprobes (ADVICE r2) —
+        while c3 bytes inside other instructions must not match."""
+        from alaz_tpu.sources.gotls import _RET_LINE
+
+        hits = {
+            "  401000:\tc3                   \tret",
+            "  401005:\tf3 c3                \trepz ret",
+            "  401010:\tf2 c3                \tbnd ret",
+            "  401015:\tc3                   \tretq",
+            "   40200c:\td65f03c0 \tret",
+        }
+        misses = {
+            "  401020:\t48 c7 c0 c3 00 00 00 \tmov    $0xc3,%rax",
+            "  401030:\t0f 1f 00             \tnopl   (%rax)",
+            "0000000000401000 <crypto/tls.(*Conn).Read>:",
+            "  401040:\tc3 12                \t.word 0x12c3",
+        }
+        for line in hits:
+            assert _RET_LINE.match(line), line
+        for line in misses:
+            assert not _RET_LINE.match(line), line
+
     def test_old_go_rejected(self, tmp_path):
         from alaz_tpu.sources.gotls import discover_go_tls
 
@@ -757,6 +1155,33 @@ class TestIngestServer:
             assert svc.tcp_queue.put_total == 7
         finally:
             srv.stop()
+
+    def test_live_listener_not_stolen(self, tmp_path):
+        """A second instance pointed at a LIVE socket must fail loudly
+        instead of unlinking it and silently siphoning off the first
+        instance's agents (ADVICE r2); a stale socket file (bound by a
+        dead process) is still reclaimed."""
+        import pytest
+
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.runtime.service import Service
+        from alaz_tpu.sources.ingest_server import IngestServer
+
+        svc, srv = self._service_and_server(tmp_path)
+        try:
+            with pytest.raises(OSError, match="in use"):
+                IngestServer(Service(interner=Interner()), path=tmp_path / "ingest.sock")
+        finally:
+            srv.stop()
+        # srv.stop() unlinks; recreate a stale file to simulate a crash
+        path = tmp_path / "ingest.sock"
+        import socket as socket_mod
+
+        stale = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        stale.bind(str(path))
+        stale.close()  # closed listener: connect() now refused
+        srv2 = IngestServer(Service(interner=Interner()), path=path)
+        srv2.stop()
 
     def test_native_frames_hit_the_ring(self, tmp_path):
         import time
