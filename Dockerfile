@@ -43,7 +43,7 @@ RUN pip install --no-cache-dir \
 WORKDIR /app
 COPY alaz_tpu/ alaz_tpu/
 COPY testconfig/ testconfig/
-COPY bench.py README.md ./
+COPY bench.py __graft_entry__.py README.md ./
 # native artifacts from the builder stage; graph/native.py loads the
 # prebuilt .so directly when no toolchain is present
 COPY --from=builder /src/alaz_tpu/native/libalaz_ingest.so alaz_tpu/native/

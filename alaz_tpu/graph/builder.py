@@ -68,6 +68,61 @@ class NodeTable:
         return np.asarray(self._uids, dtype=np.int32)
 
 
+def cluster_renumber(
+    edge_src: np.ndarray, edge_dst: np.ndarray, n_nodes: int
+) -> np.ndarray:
+    """Locality-oriented node renumbering: a permutation ``perm`` with
+    ``perm[old_id] = new_id`` that places sources talking to the same
+    destination in one contiguous id range.
+
+    Why: batches are dst-sorted (snapshot.py), so a window of consecutive
+    edges shares few destinations; after this pass their *source* rows
+    also live in a narrow band of the node table, turning the step's
+    residual src-side gathers from random row hits into windowed reads
+    (ARCHITECTURE.md §3b — the three ~9 ms src gathers are the remaining
+    step-time bound, and uniform-random ids are their adversarial case).
+    Real service maps have community structure (teams of pods calling
+    their own services); this pass is what converts that structure into
+    memory locality. Cost: one O(E log E) host-side sort per window —
+    free next to the device step.
+
+    Ordering key per node: (its modal destination, out-degree desc,
+    old id). Nodes with no outgoing edges (services, sinks) keep their
+    relative order after all sources."""
+    if edge_src.shape[0] == 0:
+        return np.arange(n_nodes, dtype=np.int32)
+    # modal dst per src via pair counting (vectorized groupby)
+    pair_key = edge_src.astype(np.int64) * np.int64(n_nodes) + edge_dst.astype(np.int64)
+    uniq_pairs, pair_counts = np.unique(pair_key, return_counts=True)
+    pair_src = (uniq_pairs // n_nodes).astype(np.int64)
+    pair_dst = (uniq_pairs % n_nodes).astype(np.int64)
+    # per src, pick the dst with max count: sort by (src, count) and take last
+    order = np.lexsort((pair_counts, pair_src))
+    boundaries = np.flatnonzero(np.diff(pair_src[order], append=-1))
+    top_dst = np.full(n_nodes, np.int64(n_nodes), dtype=np.int64)  # sinks last
+    top_dst[pair_src[order][boundaries]] = pair_dst[order][boundaries]
+    out_deg = np.bincount(edge_src, minlength=n_nodes).astype(np.int64)
+    new_order = np.lexsort((np.arange(n_nodes), -out_deg, top_dst))
+    perm = np.empty(n_nodes, dtype=np.int32)
+    perm[new_order] = np.arange(n_nodes, dtype=np.int32)
+    return perm
+
+
+def apply_renumber(
+    perm: np.ndarray,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    *node_arrays: np.ndarray,
+) -> tuple:
+    """Apply a ``cluster_renumber`` permutation: edge endpoints are
+    remapped through ``perm`` and every per-node array is reordered so
+    row ``perm[i]`` of the output is row ``i`` of the input."""
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.shape[0], dtype=perm.dtype)
+    out_nodes = tuple(a[inv] for a in node_arrays)
+    return (perm[edge_src], perm[edge_dst]) + out_nodes
+
+
 class GraphBuilder:
     """Aggregates one window's REQUEST_DTYPE rows into a GraphBatch."""
 

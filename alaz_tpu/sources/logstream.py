@@ -100,11 +100,14 @@ def dial(
     timeout, TLS by default). ``ca_file`` pins a private CA; None uses
     the system trust store (the reference instead embeds its SaaS CA —
     caCert.go — which only makes sense for a fixed backend)."""
+    if use_tls:
+        # build the context BEFORE dialing: a bad ca_file path must not
+        # leak an established TCP fd per attempt
+        ctx = ssl.create_default_context(cafile=ca_file)
     raw = socket.create_connection((host, port), timeout=timeout_s)
     if not use_tls:
         raw.settimeout(None)
         return SocketConnection(raw)
-    ctx = ssl.create_default_context(cafile=ca_file)
     try:
         wrapped = ctx.wrap_socket(raw, server_hostname=server_name or host)
     except BaseException:

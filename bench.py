@@ -80,7 +80,8 @@ def bench_model(args) -> dict:
     from alaz_tpu.models.registry import get_model
 
     batch = _example_batch(
-        n_pods=args.pods, n_svcs=args.svcs, n_edges=args.edges, seed=0
+        n_pods=args.pods, n_svcs=args.svcs, n_edges=args.edges, seed=0,
+        structure=args.structure, layout=args.layout,
     )
     n_edges = batch.n_edges
 
@@ -243,9 +244,17 @@ def _metric_for(args) -> tuple[str, str]:
     shared by the result payloads and the watchdog's error line."""
     if args.e2e:
         return "e2e_ingest_to_score_rows_per_sec", "rows/s"
+    name = "gnn_inference_edges_per_sec_per_chip"
+    tags = []
     if args.model != "graphsage":
-        return f"gnn_inference_edges_per_sec_per_chip[{args.model}]", "edges/s"
-    return "gnn_inference_edges_per_sec_per_chip", "edges/s"
+        tags.append(args.model)
+    if getattr(args, "structure", "uniform") != "uniform":
+        tags.append(args.structure)
+    if getattr(args, "layout", "random") != "random":
+        tags.append(args.layout)
+    if tags:
+        name += "[" + ",".join(tags) + "]"
+    return name, "edges/s"
 
 
 def _arm_watchdog(seconds: float, metric: str, unit: str):
@@ -293,6 +302,10 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--profile", default="")
     p.add_argument("--e2e", action="store_true")
+    p.add_argument("--structure", default="uniform", choices=["uniform", "community"],
+                   help="edge draw: uniform (adversarial for locality) or community")
+    p.add_argument("--layout", default="random", choices=["random", "clustered"],
+                   help="node id layout: as-drawn or cluster_renumber'd")
     p.add_argument("--watchdog-s", type=float, default=900.0,
                    help="hard exit with an error JSON line after this long")
     args = p.parse_args()

@@ -452,41 +452,83 @@ class TestMetricsDepth:
 
 @pytest.mark.slow
 class TestServiceSoak:
-    def test_sustained_load_bounded_state(self):
-        """Soak the service at high rate and assert the state the round-1
-        advisor flagged as leak-prone stays bounded: h2 conns, stmt
-        caches, path caches, rate-limit buckets, retry queue — plus RSS
-        growth within a sane envelope (the reference harness tracks RSS
-        over the run, main_benchmark_test.go:152-290)."""
+    def test_sustained_rate_soak_rss_slope(self):
+        """A real soak, the main_benchmark_test.go:152-290 analog: ≥60 s
+        of PACED event submission (not a flat-out burst), a profile
+        sample every interval (wall, RSS, queue depth, per-stage
+        counters, leak-prone cache sizes), then assertions on (a) the
+        reference's ≥90%-processed invariant, (b) bounded state in every
+        cache the round-1 advisor flagged, and (c) the RSS *slope* over
+        the post-warmup samples — a leak shows as a persistent positive
+        slope even when a one-shot envelope would pass."""
+        import sys
+
         import resource
 
         def current_rss() -> int:
             with open("/proc/self/statm") as f:
                 return int(f.read().split()[1]) * resource.getpagesize()
 
+        duration_s = 60.0
         interner = Interner()
         svc = Service(interner=interner)
-        svc.housekeeping_interval_s = 1.0  # fast gc ticks for the soak
+        svc.housekeeping_interval_s = 5.0  # several gc ticks over the soak
         sim = Simulator(
-            SimulationConfig(test_duration_s=12.0, pod_count=60, service_count=20,
-                             edge_count=40, edge_rate=400),
+            SimulationConfig(test_duration_s=duration_s, pod_count=60,
+                             service_count=20, edge_count=40, edge_rate=500),
             interner=interner,
         )
+        samples = []  # (wall_s, rss, l7_pending, edges_out, h2, stmts, buckets)
+
+        def take_sample(t0):
+            agg = svc.aggregator
+            snap = svc.metrics.snapshot()
+            samples.append((
+                time.monotonic() - t0,
+                current_rss(),
+                snap.get("l7.pending", 0),
+                snap.get("edges.out", 0),
+                agg.h2.conn_count(),
+                len(agg.pg_stmts) + len(agg.mysql_stmts),
+                len(agg._pid_buckets),
+            ))
+            s = samples[-1]
+            print(
+                f"# soak t={s[0]:6.1f}s rss={s[1]/1e6:7.1f}MB pending={s[2]:<8}"
+                f" edges_out={s[3]:<9} h2={s[4]} stmts={s[5]} buckets={s[6]}",
+                file=sys.stderr,
+            )
+
         svc.start()
-        rss0 = current_rss()
         try:
             for m in sim.setup():
                 svc.submit_k8s(m)
             svc.submit_tcp(sim.tcp_events())
             time.sleep(0.1)
-            for batch in sim.iter_l7_batches():
+            batches = list(sim.iter_l7_batches())
+            t0 = time.monotonic()
+            take_sample(t0)
+            next_sample = 5.0
+            # pace: batch i is due at its share of the soak duration
+            # (drift-corrected absolute schedule, not cumulative sleeps)
+            for i, batch in enumerate(batches):
+                due = t0 + (i / len(batches)) * duration_s
+                delay = due - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
                 svc.submit_l7(batch)
+                if time.monotonic() - t0 >= next_sample:
+                    take_sample(t0)
+                    next_sample += 5.0
+            wall = time.monotonic() - t0
+            assert wall >= 0.9 * duration_s, f"soak only ran {wall:.1f}s"
             svc.drain(30)
             svc.flush_windows()
             svc.drain(30)
+            take_sample(t0)
         finally:
             svc.stop()
-        rss1 = current_rss()
+
         agg = svc.aggregator
         assert svc.graph_store.request_count >= 0.9 * sim.expected_events
         assert agg.h2.conn_count() < 1000
@@ -494,8 +536,17 @@ class TestServiceSoak:
         assert sum(len(c) for c in agg._path_cache.values()) < 70000
         assert len(agg._pid_buckets) < 5000
         assert agg.pending_retries == 0
-        # current-RSS growth over the soak stays under 1.5 GB (the
-        # reference DaemonSet runs in 1Gi; loose envelope for the python
-        # harness + jax runtime). Current RSS, not ru_maxrss: a peak set
-        # by an earlier test would make a delta of peaks vacuous.
-        assert rss1 - rss0 < 1_500_000_000, (rss0, rss1)
+        # RSS slope over the steady-state samples (warmup excluded: the
+        # first windows allocate interner tables, jit caches, arenas).
+        # At 20k ev/s a real per-event leak of even 100 B/event would
+        # slope at ~2 MB/s; the bar of 1 MB/s passes allocator noise and
+        # fails leaks an order of magnitude below round-1's findings.
+        steady = [(t, rss) for (t, rss, *_rest) in samples if t >= 20.0]
+        assert len(steady) >= 5, f"too few steady samples: {len(steady)}"
+        ts = np.array([s[0] for s in steady])
+        rs = np.array([s[1] for s in steady], dtype=np.float64)
+        slope_bytes_per_s = float(np.polyfit(ts, rs, 1)[0])
+        print(f"# soak rss slope: {slope_bytes_per_s/1e6:.3f} MB/s", file=sys.stderr)
+        assert slope_bytes_per_s < 1_000_000, (
+            f"RSS grows at {slope_bytes_per_s/1e6:.2f} MB/s over the soak"
+        )
