@@ -189,6 +189,10 @@ class ModelConfig:
     dropout: float = 0.1
     dtype: str = "bfloat16"
     use_pallas: bool = True
+    # src-side gather strategy: "xla" row gather (uniform-random layouts)
+    # or "banded" Pallas windowed kernel (after graph/builder.py's
+    # cluster_renumber pass narrows per-chunk src id bands — §3b residual)
+    src_gather: str = "xla"
     remat: bool = False  # jax.checkpoint each GNN layer (FLOPs for memory)
     # tgn only: pre-size node memory to the largest expected bucket so a
     # growing fleet doesn't pay a serving-time recompile per
@@ -202,6 +206,7 @@ class ModelConfig:
             hidden_dim=env_int("HIDDEN_DIM", 128),
             num_layers=env_int("NUM_LAYERS", 2),
             use_pallas=env_bool("USE_PALLAS", True),
+            src_gather=env_str("SRC_GATHER", "xla"),
             remat=env_bool("REMAT", False),
             tgn_max_nodes=env_int("TGN_MAX_NODES", 4096),
         )

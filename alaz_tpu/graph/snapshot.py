@@ -128,9 +128,14 @@ class GraphBatch:
         el = np.zeros(e_pad, dtype=np.float32)
         es[:e] = edge_src
         ed[:e] = edge_dst
-        # padding edges point at the last padded node slot so segment ops
-        # dump them into a masked-out row instead of polluting node 0
-        es[e:] = n_pad - 1
+        # padding DSTs point at the last padded node slot so segment ops
+        # dump them into a masked-out row instead of polluting node 0.
+        # Padding SRCs repeat the last real src instead: src values of
+        # masked edges are never consumed (edge_mask zeroes their
+        # messages), but a far-away pad id would blow the straddling
+        # chunk's [min,max] band to the whole table and cliff the banded
+        # gather kernel (ops/pallas_segment.py gather_rows_banded).
+        es[e:] = edge_src[-1] if e > 0 else 0
         ed[e:] = n_pad - 1
         et[:e] = edge_type
         ef[:e] = edge_feats

@@ -79,7 +79,10 @@ def edge_head_init(key, hidden: int, edge_feat_dim: int) -> list[dict]:
     return mlp_init(key, [2 * hidden + edge_feat_dim, hidden, 1])
 
 
-def edge_head(params, h, graph, dtype, use_pallas: bool | str = False) -> jnp.ndarray:
+def edge_head(
+    params, h, graph, dtype, use_pallas: bool | str = False,
+    src_gather_mode: str = "xla",
+) -> jnp.ndarray:
     """Per-edge anomaly logit from [h_src, h_dst, edge_feats].
 
     Computed as the split form of ``mlp(params, concat([h[src], h[dst],
@@ -95,8 +98,9 @@ def edge_head(params, h, graph, dtype, use_pallas: bool | str = False) -> jnp.nd
     u = h @ w1[:hdim]  # [N, H'] src-side projection
     v = h @ w1[hdim : 2 * hdim]  # [N, H'] dst-side projection
     efp = graph["edge_feats"].astype(dtype) @ w1[2 * hdim :]
-    from alaz_tpu.ops.segment import expand_dst
+    from alaz_tpu.ops.segment import expand_dst, gather_src
 
     v_e = expand_dst(v, graph["edge_dst"], h.shape[0], use_pallas)
-    z = u[graph["edge_src"]] + v_e + efp + params[0]["b"].astype(dtype)
+    u_e = gather_src(u, graph["edge_src"], h.shape[0], src_gather_mode)
+    z = u_e + v_e + efp + params[0]["b"].astype(dtype)
     return mlp(params[1:], jax.nn.gelu(z))[:, 0]

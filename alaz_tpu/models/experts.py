@@ -33,6 +33,7 @@ from alaz_tpu.models.common import (
     mlp_init,
     scatter_messages,
 )
+from alaz_tpu.ops.segment import gather_src
 
 Params = Dict[str, Any]
 
@@ -86,7 +87,12 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     ef = graph["edge_feats"].astype(dtype)
 
     for layer in params["layers"]:
-        msgs = _expert_messages(layer, h[graph["edge_src"]], graph["edge_type"], dtype)
+        msgs = _expert_messages(
+            layer,
+            gather_src(h, graph["edge_src"], n, cfg.src_gather),
+            graph["edge_type"],
+            dtype,
+        )
         msgs = msgs + dense(layer["edge_proj"], ef)
         agg, deg = scatter_messages(msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas)
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
@@ -94,7 +100,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
         h = (h + h_new) * node_mask[:, None]
 
-    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas)
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]
     return {
         "node_h": h,

@@ -30,7 +30,7 @@ from alaz_tpu.models.common import (
     mlp_init,
     scatter_messages,
 )
-from alaz_tpu.ops.segment import expand_dst, segment_softmax
+from alaz_tpu.ops.segment import expand_dst, gather_src, segment_softmax
 
 Params = Dict[str, Any]
 
@@ -88,7 +88,11 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
 
         q_part = jnp.einsum("nhd,hd->nh", q, a_q)  # [N, nh]
         e_part = jnp.einsum("ehd,hd->eh", e_feat, a_e)  # [E, nh]
-        kv_src = kv[src]  # the one irreducible src gather per layer
+        # the one irreducible src gather per layer (flattened to 2D so
+        # the banded kernel applies after a clustered layout)
+        kv_src = gather_src(
+            kv.reshape(n, nh * hd), src, n, cfg.src_gather
+        ).reshape(-1, nh, hd)
         k_src = jnp.einsum("ehd,hd->eh", kv_src, a_k)
         logits = (
             expand_dst(q_part, dst, n, cfg.use_pallas) + k_src + e_part
@@ -108,7 +112,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     for layer in params["layers"]:
         h = layer_fn(layer, h)
 
-    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas)
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]
     return {
         "node_h": h,

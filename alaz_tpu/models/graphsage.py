@@ -30,6 +30,7 @@ from alaz_tpu.models.common import (
     mlp_init,
     scatter_messages,
 )
+from alaz_tpu.ops.segment import gather_src
 
 Params = Dict[str, Any]
 
@@ -81,9 +82,9 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
         # dense-before-gather: (h @ W)[src] == (h[src]) @ W, but the
         # matmul runs over N node rows instead of E edge rows (8× fewer
         # FLOPs at config-5 fan-in) and the gather moves the same bytes
-        msgs = dense(layer["msg"], h)[graph["edge_src"]] + dense(
-            layer["edge_proj"], ef
-        )
+        msgs = gather_src(
+            dense(layer["msg"], h), graph["edge_src"], n, cfg.src_gather
+        ) + dense(layer["edge_proj"], ef)
         agg, deg = scatter_messages(
             msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas
         )
@@ -99,7 +100,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     for layer in params["layers"]:
         h = layer_fn(layer, h)
 
-    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas)
+    edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]
     return {
         "node_h": h,

@@ -343,6 +343,171 @@ def _expand_vjp_bwd(num_nodes, residuals, g):
 segment_expand_sorted.defvjp(_expand_vjp_fwd, _expand_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Banded gather: out[e] = v[ids[e]] for ids that are UNSORTED but lie in a
+# narrow band per TILE_E chunk — the src-side gather after the
+# cluster_renumber layout pass (graph/builder.py). Edges are dst-sorted;
+# with community structure + renumbering, the sources referenced by a
+# chunk of consecutive edges span a few 128-row windows of the node
+# table. Each chunk DMAs its [min,max] window range and expands rows via
+# one-hot MXU matmuls (rows outside a window one-hot to zero, so summing
+# windows covers every edge exactly once). DMA count ≈ Σ_c band_c/128
+# instead of one row op per edge — on uniform-random ids the band is the
+# whole table and the XLA gather is strictly better; callers gate on the
+# measured band (ARCHITECTURE.md §3b).
+# ---------------------------------------------------------------------------
+
+
+def _banded_gather_kernel(
+    lo_ref, nw_ref, v_hbm, ids_hbm, out_ref, v_scratch, id_scratch, sems
+):
+    c = pl.program_id(0)
+    lo = lo_ref[c]  # 128-aligned window base for this chunk
+    nw = nw_ref[c]  # number of 128-row windows the chunk's band spans
+
+    for r in range(_DST_ROWS):
+        pltpu.make_async_copy(
+            ids_hbm.at[pl.ds(c * _DST_ROWS + r, 1), :],
+            id_scratch.at[pl.ds(r, 1)],
+            sems.at[2, r],
+        ).start()
+
+    def win_dma(slot, w):
+        return pltpu.make_async_copy(
+            v_hbm.at[pl.ds(lo + w * 128, 128), :],
+            v_scratch.at[slot],
+            sems.at[slot, 0],
+        )
+
+    win_dma(0, 0).start()
+    for r in range(_DST_ROWS):
+        pltpu.make_async_copy(
+            ids_hbm.at[pl.ds(c * _DST_ROWS + r, 1), :],
+            id_scratch.at[pl.ds(r, 1)],
+            sems.at[2, r],
+        ).wait()
+
+    precision = (
+        jax.lax.Precision.HIGHEST
+        if v_scratch.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(w, _):
+        slot = jax.lax.rem(w, 2)
+
+        @pl.when(w + 1 < nw)
+        def _():
+            win_dma(1 - slot, w + 1).start()
+
+        win_dma(slot, w).wait()
+        win0 = lo + w * 128
+        for r in range(_DST_ROWS):
+            id_local = id_scratch[r, :].reshape(128, 1) - win0
+            onehot = (
+                id_local == jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            ).astype(v_scratch.dtype)
+            contrib = jax.lax.dot_general(
+                onehot,
+                v_scratch[slot],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=precision,
+            )
+            out_ref[r * 128 : (r + 1) * 128, :] += contrib.astype(out_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, nw, body, 0)
+
+
+def _gather_banded(v: jnp.ndarray, ids: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    n, f = v.shape
+    e = ids.shape[0]
+    assert e % TILE_E == 0 and n % 128 == 0
+    n_chunks = e // TILE_E
+    ids2d = ids.reshape(e // 128, 128).astype(jnp.int32)
+    per_chunk = ids.reshape(n_chunks, TILE_E).astype(jnp.int32)
+    lo = (jnp.min(per_chunk, axis=1) // 128) * 128
+    hi = jnp.max(per_chunk, axis=1)
+    nw = (hi - lo) // 128 + 1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM; DMA'd
+            pl.BlockSpec(memory_space=pl.ANY),  # ids
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_E, f), lambda c, *_: (c, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 128, f), v.dtype),
+            pltpu.VMEM((_DST_ROWS, 128), jnp.int32),
+            pltpu.SemaphoreType.DMA((3, max(2, _DST_ROWS))),
+        ],
+    )
+    return pl.pallas_call(
+        _banded_gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((e, f), v.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * e * 128 * f,
+            bytes_accessed=e * f * v.dtype.itemsize * 2 + e * 4,
+            transcendentals=0,
+        ),
+    )(lo, nw, v, ids2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def gather_rows_banded(v, ids, num_nodes):
+    """out[e] = v[ids[e]] for unsorted ids with narrow per-chunk bands
+    (post-cluster_renumber src gathers). ``num_nodes`` rides along for
+    the backward scatter."""
+    return _banded_fwd_impl(v, ids)
+
+
+def _banded_fwd_impl(v, ids):
+    dtype = v.dtype
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        v = v.astype(jnp.float32)
+    f = v.shape[1]
+    f_pad = ((f + 127) // 128) * 128
+    if f_pad != f:
+        v = jnp.pad(v, ((0, 0), (0, f_pad - f)))
+    e = ids.shape[0]
+    e_pad = ((e + TILE_E - 1) // TILE_E) * TILE_E
+    if e_pad != e:
+        # pad ids with the last real id: the pad chunk's band collapses
+        # onto one window instead of dragging in row 0
+        fill = ids[-1] if e > 0 else jnp.int32(0)
+        ids = jnp.concatenate(
+            [ids, jnp.full((e_pad - e,), fill, ids.dtype)]
+        )
+    interpret = jax.default_backend() != "tpu"
+    out = _gather_banded(v, ids, interpret=interpret)
+    return out[:e, :f].astype(dtype)
+
+
+def _banded_vjp_fwd(v, ids, num_nodes):
+    return _banded_fwd_impl(v, ids), (ids,)
+
+
+def _banded_vjp_bwd(num_nodes, residuals, g):
+    (ids,) = residuals
+    # dv[i] = Σ_{e: ids[e]=i} g[e] — ids are unsorted, XLA scatter
+    dv = jax.ops.segment_sum(
+        g.astype(jnp.float32), ids, num_segments=num_nodes
+    ).astype(g.dtype)
+    return (dv, None)
+
+
+gather_rows_banded.defvjp(_banded_vjp_fwd, _banded_vjp_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight=None):
     """out[d] = Σ_{e: dst[e]=d} w[e]·x[src[e]], edges sorted by dst."""

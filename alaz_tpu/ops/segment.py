@@ -64,6 +64,45 @@ def expand_dst(
     return v[segment_ids]
 
 
+_SRC_GATHER_MODES = ("xla", "banded", "banded-interpret")
+_banded_fallback_warned = False
+
+
+def gather_src(
+    v: jnp.ndarray,
+    src_ids: jnp.ndarray,
+    num_nodes: int,
+    mode: str = "xla",
+) -> jnp.ndarray:
+    """[N, F] → [E, F] gather ``v[src_ids]`` for UNSORTED src ids — the
+    §3b residual. ``mode``: "xla" (row gather; right for uniform-random
+    layouts), "banded" (Pallas windowed kernel on TPU; right after the
+    cluster_renumber layout pass narrows per-chunk id bands), or
+    "banded-interpret" to force the kernel off-TPU for tests. An unknown
+    mode raises — a typo silently measuring the wrong path would poison
+    every '[banded]'-tagged benchmark row."""
+    import jax
+
+    if mode not in _SRC_GATHER_MODES:
+        raise ValueError(
+            f"src_gather mode {mode!r}; expected one of {_SRC_GATHER_MODES}"
+        )
+    if (mode == "banded" and jax.default_backend() == "tpu") or mode == "banded-interpret":
+        from alaz_tpu.ops.pallas_segment import gather_rows_banded
+
+        return gather_rows_banded(v, src_ids, num_nodes)
+    if mode == "banded":
+        global _banded_fallback_warned
+        if not _banded_fallback_warned:
+            _banded_fallback_warned = True
+            from alaz_tpu.logging import get_logger
+
+            get_logger("alaz_tpu.ops").warning(
+                "src_gather=banded requested off-TPU; using the XLA gather"
+            )
+    return v[src_ids]
+
+
 def segment_softmax(
     logits: jnp.ndarray,
     segment_ids: jnp.ndarray,

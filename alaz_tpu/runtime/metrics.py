@@ -10,6 +10,7 @@ snapshot dict for the health/metrics push path.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from typing import Callable, Dict
@@ -205,21 +206,126 @@ def host_gauges(metrics: Metrics) -> None:
         except OSError:
             return 0.0
 
+    def vmstat(field: str) -> float:
+        """/proc/vmstat counters (the node_exporter vmstat collector)."""
+        try:
+            with open("/proc/vmstat") as f:
+                for line in f:
+                    if line.startswith(field + " "):
+                        return float(line.split()[1])
+        except OSError:
+            pass
+        return 0.0
+
+    # partitions, not whole devices: sda1 / vdb2 / nvme0n1p3 / mmcblk0p1.
+    # A bare trailing-digit check would also drop whole NVMe/eMMC devices
+    # (nvme0n1, mmcblk0) — the common case on modern nodes.
+    part_re = re.compile(
+        r"^(?:(?:h|s|v|xv)d[a-z]+\d+|nvme\d+n\d+p\d+|mmcblk\d+p\d+)$"
+    )
+
+    def diskstats(col: int) -> float:
+        """Sum of one /proc/diskstats column over whole devices (the
+        diskstats collector). col 3=reads, 7=writes, 5/9=sectors,
+        12=io_time_ms."""
+        total = 0.0
+        try:
+            with open("/proc/diskstats") as f:
+                for line in f:
+                    cols = line.split()
+                    if len(cols) <= col:
+                        continue
+                    name = cols[2]
+                    if name.startswith(("loop", "ram", "dm-", "sr", "fd")):
+                        continue
+                    if part_re.match(name):
+                        continue
+                    total += float(cols[col])
+        except OSError:
+            return 0.0
+        return total
+
+    def sockstat(proto: str, field: str) -> float:
+        """/proc/net/sockstat (the sockstat collector): TCP inuse/orphan/
+        tw, UDP inuse — the reference joins on live socket state, so
+        kernel socket-table pressure is first-order here."""
+        try:
+            with open("/proc/net/sockstat") as f:
+                for line in f:
+                    if line.startswith(proto + ":"):
+                        parts = line.split()
+                        for i, tok in enumerate(parts):
+                            if tok == field and i + 1 < len(parts):
+                                return float(parts[i + 1])
+        except OSError:
+            pass
+        return 0.0
+
+    def file_nr(idx: int) -> float:
+        """/proc/sys/fs/file-nr: allocated (0) and max (2) file handles
+        system-wide (the filefd collector)."""
+        try:
+            return float(open("/proc/sys/fs/file-nr").read().split()[idx])
+        except OSError:
+            return 0.0
+
+    def psi(resource: str) -> float:
+        """PSI avg10 'some' pressure percentage (the pressure
+        collector); 0 where the kernel lacks CONFIG_PSI."""
+        try:
+            with open(f"/proc/pressure/{resource}") as f:
+                for line in f:
+                    if line.startswith("some"):
+                        for tok in line.split():
+                            if tok.startswith("avg10="):
+                                return float(tok[6:])
+        except OSError:
+            pass
+        return 0.0
+
     metrics.gauge("host.process_rss_bytes", rss_bytes)
     metrics.gauge("host.mem_available_bytes", lambda: meminfo("MemAvailable"))
     metrics.gauge("host.mem_total_bytes", lambda: meminfo("MemTotal"))
+    metrics.gauge("host.mem_cached_bytes", lambda: meminfo("Cached"))
+    metrics.gauge("host.mem_buffers_bytes", lambda: meminfo("Buffers"))
+    metrics.gauge("host.swap_total_bytes", lambda: meminfo("SwapTotal"))
+    metrics.gauge("host.swap_free_bytes", lambda: meminfo("SwapFree"))
     metrics.gauge("host.load1", lambda: loadavg(0))
     metrics.gauge("host.load5", lambda: loadavg(1))
     metrics.gauge("host.load15", lambda: loadavg(2))
     metrics.gauge("host.cpu_user_s", lambda: stat_field("cpu", 1, 0.01))
     metrics.gauge("host.cpu_system_s", lambda: stat_field("cpu", 3, 0.01))
     metrics.gauge("host.cpu_idle_s", lambda: stat_field("cpu", 4, 0.01))
+    metrics.gauge("host.cpu_iowait_s", lambda: stat_field("cpu", 5, 0.01))
+    metrics.gauge("host.cpu_steal_s", lambda: stat_field("cpu", 8, 0.01))
     metrics.gauge("host.context_switches", lambda: stat_field("ctxt", 1))
+    metrics.gauge("host.forks_total", lambda: stat_field("processes", 1))
     metrics.gauge("host.procs_running", lambda: stat_field("procs_running", 1))
+    metrics.gauge("host.procs_blocked", lambda: stat_field("procs_blocked", 1))
     metrics.gauge("host.net_rx_bytes", lambda: net_bytes(0))
     metrics.gauge("host.net_tx_bytes", lambda: net_bytes(8))
+    metrics.gauge("host.net_rx_errors", lambda: net_bytes(2))
+    metrics.gauge("host.net_rx_dropped", lambda: net_bytes(3))
+    metrics.gauge("host.net_tx_errors", lambda: net_bytes(10))
+    metrics.gauge("host.net_tx_dropped", lambda: net_bytes(11))
     metrics.gauge("host.disk_used_bytes", lambda: disk("used"))
     metrics.gauge("host.disk_total_bytes", lambda: disk("total"))
+    metrics.gauge("host.disk_reads_completed", lambda: diskstats(3))
+    metrics.gauge("host.disk_writes_completed", lambda: diskstats(7))
+    metrics.gauge("host.disk_read_sectors", lambda: diskstats(5))
+    metrics.gauge("host.disk_written_sectors", lambda: diskstats(9))
+    metrics.gauge("host.disk_io_time_ms", lambda: diskstats(12))
+    metrics.gauge("host.pgfault", lambda: vmstat("pgfault"))
+    metrics.gauge("host.pgmajfault", lambda: vmstat("pgmajfault"))
+    metrics.gauge("host.sockets_tcp_inuse", lambda: sockstat("TCP", "inuse"))
+    metrics.gauge("host.sockets_tcp_orphan", lambda: sockstat("TCP", "orphan"))
+    metrics.gauge("host.sockets_tcp_tw", lambda: sockstat("TCP", "tw"))
+    metrics.gauge("host.sockets_udp_inuse", lambda: sockstat("UDP", "inuse"))
+    metrics.gauge("host.filefd_allocated", lambda: file_nr(0))
+    metrics.gauge("host.filefd_maximum", lambda: file_nr(2))
+    metrics.gauge("host.pressure_cpu_avg10", lambda: psi("cpu"))
+    metrics.gauge("host.pressure_memory_avg10", lambda: psi("memory"))
+    metrics.gauge("host.pressure_io_avg10", lambda: psi("io"))
     metrics.gauge("host.open_fds", open_fds)
     metrics.gauge("host.boot_uptime_s", boot_uptime)
 

@@ -85,7 +85,14 @@ def bench_model(args) -> dict:
     )
     n_edges = batch.n_edges
 
-    cfg = ModelConfig(model=args.model, hidden_dim=args.hidden, num_layers=2)
+    if args.src_gather == "banded" and jax.default_backend() != "tpu":
+        # never record a '[banded]'-tagged number that measured XLA
+        print("# src-gather banded needs TPU; falling back to xla", file=sys.stderr)
+        args.src_gather = "xla"
+    cfg = ModelConfig(
+        model=args.model, hidden_dim=args.hidden, num_layers=2,
+        src_gather=args.src_gather,
+    )
     init, apply = get_model(cfg.model)
     params = init(jax.random.PRNGKey(0), cfg)
     graph = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
@@ -252,20 +259,26 @@ def _metric_for(args) -> tuple[str, str]:
         tags.append(args.structure)
     if getattr(args, "layout", "random") != "random":
         tags.append(args.layout)
+    if getattr(args, "src_gather", "xla") != "xla":
+        tags.append(args.src_gather)
     if tags:
         name += "[" + ",".join(tags) + "]"
     return name, "edges/s"
 
 
-def _arm_watchdog(seconds: float, metric: str, unit: str):
+def _arm_watchdog(seconds: float, args):
     """A wedged accelerator tunnel can hang device ops forever; emit the
-    one-JSON-line contract (for the metric actually being run) with an
-    error marker and hard-exit instead of eating the caller's whole
-    budget. Returns the timer so a finishing run can cancel it."""
+    one-JSON-line contract with an error marker and hard-exit instead of
+    eating the caller's whole budget. The metric name is resolved at
+    FIRE time from ``args`` so mode rewrites that happen after arming
+    (e.g. the banded→xla CPU fallback in bench_model) are reflected —
+    the error line must name the metric actually being run. Returns the
+    timer so a finishing run can cancel it."""
     import os
     import threading
 
     def fire():
+        metric, unit = _metric_for(args)
         print(
             json.dumps(
                 {
@@ -306,12 +319,14 @@ def main() -> None:
                    help="edge draw: uniform (adversarial for locality) or community")
     p.add_argument("--layout", default="random", choices=["random", "clustered"],
                    help="node id layout: as-drawn or cluster_renumber'd")
+    p.add_argument("--src-gather", default="xla", choices=["xla", "banded"],
+                   help="src gather strategy (banded needs --layout clustered)")
     p.add_argument("--watchdog-s", type=float, default=900.0,
                    help="hard exit with an error JSON line after this long")
     args = p.parse_args()
     watchdog = None
     if args.watchdog_s > 0:
-        watchdog = _arm_watchdog(args.watchdog_s, *_metric_for(args))
+        watchdog = _arm_watchdog(args.watchdog_s, args)
 
     out = bench_e2e(args) if args.e2e else bench_model(args)
     if watchdog is not None:

@@ -397,6 +397,20 @@ class TestMetricsDepth:
             "host.net_rx_bytes", "host.net_tx_bytes",
             "host.disk_used_bytes", "host.disk_total_bytes",
             "host.open_fds", "host.boot_uptime_s",
+            # r3 breadth (the remaining node_exporter collectors the
+            # reference registry covers: vmstat, diskstats, sockstat,
+            # filefd, pressure, swap, netdev errors)
+            "host.mem_cached_bytes", "host.swap_total_bytes",
+            "host.cpu_iowait_s", "host.cpu_steal_s", "host.forks_total",
+            "host.procs_blocked", "host.net_rx_errors",
+            "host.net_rx_dropped", "host.net_tx_errors",
+            "host.net_tx_dropped", "host.disk_reads_completed",
+            "host.disk_writes_completed", "host.disk_io_time_ms",
+            "host.pgfault", "host.pgmajfault",
+            "host.sockets_tcp_inuse", "host.sockets_tcp_tw",
+            "host.sockets_udp_inuse", "host.filefd_allocated",
+            "host.filefd_maximum", "host.pressure_cpu_avg10",
+            "host.pressure_memory_avg10", "host.pressure_io_avg10",
         ]
         for name in expected:
             assert name in snap, name
@@ -404,6 +418,11 @@ class TestMetricsDepth:
         assert snap["host.mem_total_bytes"] > 0
         assert snap["host.cpu_user_s"] > 0
         assert snap["host.open_fds"] > 0
+        assert snap["host.pgfault"] > 0
+        assert snap["host.filefd_maximum"] > 0
+        # tcp_inuse can legitimately be 0 in a fresh netns — presence +
+        # non-negative is the environment-independent check
+        assert snap["host.sockets_tcp_inuse"] >= 0
 
     def test_device_gauges_and_info(self):
         from alaz_tpu.runtime.metrics import Metrics, device_gauges

@@ -179,6 +179,11 @@ class TestAnomalyEndToEnd:
                 lbls.append(b.edge_label)
                 masks.append(b.edge_mask)
         a = auroc(np.concatenate(scores), np.concatenate(lbls), np.concatenate(masks))
+        # 0.85 here is a smoke-test gate, not the quality bar: this config
+        # is 1/300th scale (30 pods, 25 edges) where 30 unrolled steps on
+        # 6 windows are noisy. The ≥0.9 north star is demonstrated at
+        # FULL 10k-pod scale in EVAL_r03.json (tgn: 0.9827) and by
+        # test_auroc_gate_10k_pods.
         assert a >= 0.85, f"TGN AUROC {a:.3f}"
 
 
