@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-3 TPU bench capture: every metric the VERDICT asked for, run
+# SERIALLY (one TPU process at a time — two concurrent benches starve
+# each other and can wedge the accelerator tunnel). Each line lands in
+# BENCH_MODELS_r03.json; the profiler trace lands in traces/.
+#
+#   bash tools/bench_r03.sh [out.json]
+#
+# Prereq: the accelerator answers (probe with a small matmul first).
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-BENCH_MODELS_r03.json}"
+: > "$OUT"
+
+run() { # run <label> <args...>
+  local label="$1"; shift
+  echo "== $label: python bench.py $*" >&2
+  local line
+  line=$(python bench.py "$@" 2>/tmp/bench_r03_err.log | tail -1)
+  rc=$?
+  if [ -n "$line" ]; then
+    echo "$line" >> "$OUT"
+  else
+    echo "{\"metric\": \"$label\", \"value\": 0, \"error\": \"empty output rc=$rc\"}" >> "$OUT"
+  fi
+  tail -2 /tmp/bench_r03_err.log >&2 || true
+}
+
+# headline (same invocation the driver makes) + MFU
+run graphsage
+# per-model single-chip numbers (BASELINE configs 3/4 evidence)
+run gat      --model gat
+run experts  --model experts
+run tgn      --model tgn
+# full-pipeline ingest->score rows/s
+run e2e      --e2e
+# locality study: adversarial uniform vs community+clustered (+banded kernel)
+run layout-community          --structure community --layout random
+run layout-clustered          --structure community --layout clustered
+run layout-clustered-banded   --structure community --layout clustered --src-gather banded
+# profiler trace (the :8181 pprof analog)
+mkdir -p traces
+run profile  --profile traces/r03_graphsage --iters 5 --repeats 1
+
+echo "--- $OUT ---"
+cat "$OUT"
