@@ -254,6 +254,10 @@ class RuntimeConfig:
     # procfs root for pid liveness probes and cold-start backfill:
     # /host/proc when containerized with the host procfs mounted
     proc_root: str = "/proc"
+    # per-window cluster_renumber locality pass (pairs with
+    # ModelConfig.src_gather="banded"); incompatible with the temporal
+    # model's cross-window node memory — Service refuses the combination
+    renumber_nodes: bool = False
     # ingest-idle grace before open windows flush (traffic-lull liveness).
     # Deliberately much larger than a window: a flush during an upstream
     # delivery STALL (agent buffering through a network hiccup) drops the
@@ -274,5 +278,6 @@ class RuntimeConfig:
             send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
             local_pids=env_bool("LOCAL_PIDS", False),
             proc_root=env_str("PROC_ROOT", "/proc"),
+            renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
         )

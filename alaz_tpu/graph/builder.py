@@ -69,7 +69,10 @@ class NodeTable:
 
 
 def cluster_renumber(
-    edge_src: np.ndarray, edge_dst: np.ndarray, n_nodes: int
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    n_nodes: int,
+    edge_weight: np.ndarray | None = None,
 ) -> np.ndarray:
     """Locality-oriented node renumbering: a permutation ``perm`` with
     ``perm[old_id] = new_id`` that places sources talking to the same
@@ -86,26 +89,62 @@ def cluster_renumber(
     memory locality. Cost: one O(E log E) host-side sort per window —
     free next to the device step.
 
-    Ordering key per node: (its modal destination, out-degree desc,
-    old id). Nodes with no outgoing edges (services, sinks) keep their
-    relative order after all sources."""
+    Ordering key per node: (its modal destination, out-traffic desc,
+    old id) — out-traffic is edge count when unweighted, total request
+    weight otherwise. Nodes with no outgoing edges (services, sinks)
+    keep their relative order after all sources. ``edge_weight`` weights
+    both the modal vote and the tiebreak — essential on AGGREGATED
+    graphs (one edge per (src,dst,proto) pair, GraphBuilder.build),
+    where the per-edge request count is what distinguishes a pod's home
+    service from a one-off noise pair."""
     if edge_src.shape[0] == 0:
         return np.arange(n_nodes, dtype=np.int32)
-    # modal dst per src via pair counting (vectorized groupby)
+    # modal dst per src via (weighted) pair counting — vectorized groupby
     pair_key = edge_src.astype(np.int64) * np.int64(n_nodes) + edge_dst.astype(np.int64)
-    uniq_pairs, pair_counts = np.unique(pair_key, return_counts=True)
+    uniq_pairs, inverse = np.unique(pair_key, return_inverse=True)
+    if edge_weight is None:
+        pair_counts = np.bincount(inverse, minlength=uniq_pairs.shape[0])
+    else:
+        pair_counts = np.bincount(
+            inverse, weights=edge_weight.astype(np.float64),
+            minlength=uniq_pairs.shape[0],
+        )
     pair_src = (uniq_pairs // n_nodes).astype(np.int64)
     pair_dst = (uniq_pairs % n_nodes).astype(np.int64)
     # per src, pick the dst with max count: sort by (src, count) and take last
     order = np.lexsort((pair_counts, pair_src))
     boundaries = np.flatnonzero(np.diff(pair_src[order], append=-1))
     top_dst = np.full(n_nodes, np.int64(n_nodes), dtype=np.int64)  # sinks last
+    if edge_weight is None:
+        out_deg = np.bincount(edge_src, minlength=n_nodes).astype(np.float64)
+    else:
+        out_deg = np.bincount(
+            edge_src, weights=edge_weight.astype(np.float64), minlength=n_nodes
+        )
     top_dst[pair_src[order][boundaries]] = pair_dst[order][boundaries]
-    out_deg = np.bincount(edge_src, minlength=n_nodes).astype(np.int64)
     new_order = np.lexsort((np.arange(n_nodes), -out_deg, top_dst))
     perm = np.empty(n_nodes, dtype=np.int32)
     perm[new_order] = np.arange(n_nodes, dtype=np.int32)
     return perm
+
+
+def src_band_windows(
+    edge_src: np.ndarray, tile: int = 512, window: int = 128
+) -> float:
+    """Mean number of ``window``-row node-table windows each ``tile``-edge
+    chunk's src band spans — the banded gather kernel's exact cost model
+    (DMAs/chunk). ~1-4 after cluster_renumber on community maps; ~N/128
+    on uniform-random ids, where the XLA row gather is the right choice.
+    Callers use this to pick ModelConfig.src_gather per deployment."""
+    e = edge_src.shape[0]
+    if e == 0:
+        return 0.0
+    pad = (-e) % tile
+    ids = np.concatenate([edge_src, np.full(pad, edge_src[-1])]) if pad else edge_src
+    per_chunk = ids.reshape(-1, tile)
+    lo = (per_chunk.min(axis=1) // window) * window
+    hi = per_chunk.max(axis=1)
+    return float(np.mean((hi - lo) // window + 1))
 
 
 def apply_renumber(
@@ -124,11 +163,26 @@ def apply_renumber(
 
 
 class GraphBuilder:
-    """Aggregates one window's REQUEST_DTYPE rows into a GraphBatch."""
+    """Aggregates one window's REQUEST_DTYPE rows into a GraphBatch.
 
-    def __init__(self, nodes: Optional[NodeTable] = None, window_s: float = 1.0):
+    ``renumber=True`` applies the cluster_renumber locality pass to each
+    built batch: node rows/ids are permuted per window so co-communicating
+    sources are contiguous (narrow src bands → the banded gather kernel).
+    The permutation is self-consistent within the batch (features, types,
+    uids, and edge endpoints all move together; score export reads uids
+    through the permuted table) but node SLOTS then differ between
+    windows — do not combine with models that carry per-slot state across
+    windows (the temporal model's memory)."""
+
+    def __init__(
+        self,
+        nodes: Optional[NodeTable] = None,
+        window_s: float = 1.0,
+        renumber: bool = False,
+    ):
         self.nodes = nodes if nodes is not None else NodeTable()
         self.window_s = window_s
+        self.renumber = renumber
 
     def build(
         self,
@@ -227,6 +281,15 @@ class GraphBuilder:
         nf[:, 10] = np.log1p(out_deg)
         nf[:, 11] = np.log1p(in_deg)
 
+        node_uids = self.nodes.uids_array()
+        if self.renumber and n_edges > 0:
+            # weight the modal vote by request count: heavy home-service
+            # traffic must outrank one-off noise pairs on aggregated edges
+            perm = cluster_renumber(e_src, e_dst, n_nodes, edge_weight=count)
+            e_src, e_dst, nf, node_type, node_uids = apply_renumber(
+                perm, e_src, e_dst, nf, node_type, node_uids
+            )
+
         return GraphBatch.build(
             node_feats=nf,
             node_type=node_type,
@@ -235,7 +298,7 @@ class GraphBuilder:
             edge_type=e_type,
             edge_feats=ef,
             edge_label=el,
-            node_uids=self.nodes.uids_array(),
+            node_uids=node_uids,
             window_start_ms=window_start_ms,
             window_end_ms=window_end_ms,
         )
@@ -253,13 +316,14 @@ class WindowedGraphStore(BaseDataStore):
         window_s: float = 1.0,
         on_batch: Optional[Callable[[GraphBatch], None]] = None,
         label_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        renumber: bool = False,
     ):
         self.interner = interner
         self.window_s = window_s
         self.window_ms = int(window_s * 1000)
         self.on_batch = on_batch
         self.label_fn = label_fn
-        self.builder = GraphBuilder(window_s=window_s)
+        self.builder = GraphBuilder(window_s=window_s, renumber=renumber)
         self.batches: List[GraphBatch] = []
         self.request_count = 0
         self.late_dropped = 0

@@ -190,6 +190,98 @@ class TestClusterRenumber:
         )
         assert sorted(perm.tolist()) == [0, 1, 2, 3]
 
+    def test_weighted_modal_vote_ignores_noise_pairs(self):
+        """On AGGREGATED graphs every (src,dst) pair appears once, so the
+        modal-dst vote must be weighted by request count — otherwise a
+        pod with 1 heavy home pair and 3 one-off noise pairs clusters by
+        lexical accident, not by traffic."""
+        import numpy as np
+
+        from alaz_tpu.graph.builder import cluster_renumber
+
+        # pods 0..9 home service 20; pods 10..19 home service 21; every
+        # pod also has noise pairs to high-id services 22..29
+        src, dst, w = [], [], []
+        for p in range(20):
+            home = 20 if p < 10 else 21
+            src += [p, p, p]
+            dst += [home, 22 + p % 8, 23 + p % 7]
+            w += [100.0, 1.0, 1.0]
+        src, dst = np.array(src, np.int32), np.array(dst, np.int32)
+        perm = cluster_renumber(src, dst, 30, edge_weight=np.array(w))
+        team_a = sorted(perm[p] for p in range(10))
+        team_b = sorted(perm[p] for p in range(10, 20))
+        # each team occupies one contiguous id block
+        assert team_a == list(range(team_a[0], team_a[0] + 10))
+        assert team_b == list(range(team_b[0], team_b[0] + 10))
+        # unweighted, the noise pairs dominate the vote and mix the teams
+        perm_u = cluster_renumber(src, dst, 30)
+        mixed_a = sorted(perm_u[p] for p in range(10))
+        assert mixed_a != list(range(mixed_a[0], mixed_a[0] + 10))
+
+    def test_src_band_windows_cost_model(self):
+        import numpy as np
+
+        from alaz_tpu.graph.builder import src_band_windows
+
+        rng = np.random.default_rng(0)
+        assert src_band_windows(np.zeros(0, np.int32)) == 0.0
+        narrow = rng.integers(256, 384, 2048).astype(np.int32)  # one window pair
+        wide = rng.integers(0, 100_000, 2048).astype(np.int32)
+        assert src_band_windows(narrow) <= 2.0
+        assert src_band_windows(wide) > 100.0
+
+    def test_builder_renumber_preserves_uid_edges(self):
+        """The production pass: GraphBuilder(renumber=True) permutes the
+        batch internally but the uid-level edge list — what the score
+        export emits — is unchanged."""
+        import numpy as np
+
+        from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+        from alaz_tpu.graph.builder import GraphBuilder
+
+        rng = np.random.default_rng(0)
+        rows = make_requests(600)
+        rows["from_uid"] = rng.integers(10, 60, 600)
+        rows["to_uid"] = rng.integers(100, 120, 600)
+        rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
+        rows["protocol"] = rng.integers(1, 4, 600)
+
+        def uid_edges(batch):
+            e = batch.n_edges
+            u = batch.node_uids
+            return sorted(zip(
+                u[batch.edge_src[:e]].tolist(),
+                u[batch.edge_dst[:e]].tolist(),
+                batch.edge_type[:e].tolist(),
+            ))
+
+        plain = GraphBuilder(renumber=False).build(rows.copy())
+        renum = GraphBuilder(renumber=True).build(rows.copy())
+        assert uid_edges(plain) == uid_edges(renum)
+        assert plain.n_edges == renum.n_edges and plain.n_nodes == renum.n_nodes
+        # and node features follow their uid through the permutation
+        for b in (plain, renum):
+            uid_to_feat = {
+                int(b.node_uids[i]): b.node_feats[i].tolist()
+                for i in range(b.n_nodes)
+            }
+            if b is plain:
+                ref = uid_to_feat
+        assert ref == uid_to_feat
+
+    def test_service_refuses_renumber_with_tgn(self):
+        import pytest
+
+        from alaz_tpu.config import ModelConfig, RuntimeConfig
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.runtime.service import Service
+
+        cfg = RuntimeConfig(model=ModelConfig(model="tgn"))
+        cfg.renumber_nodes = True
+        with pytest.raises(ValueError, match="tgn"):
+            Service(config=cfg, interner=Interner())
+
     def test_example_batch_layouts_same_model_output_shape(self):
         import __graft_entry__ as g
 
