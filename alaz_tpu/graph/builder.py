@@ -129,13 +129,19 @@ def cluster_renumber(
 
 
 def src_band_windows(
-    edge_src: np.ndarray, tile: int = 512, window: int = 128
+    edge_src: np.ndarray, tile: int | None = None, window: int | None = None
 ) -> float:
     """Mean number of ``window``-row node-table windows each ``tile``-edge
     chunk's src band spans — the banded gather kernel's exact cost model
     (DMAs/chunk). ~1-4 after cluster_renumber on community maps; ~N/128
     on uniform-random ids, where the XLA row gather is the right choice.
-    Callers use this to pick ModelConfig.src_gather per deployment."""
+    Callers use this to pick ModelConfig.src_gather per deployment.
+    Defaults come from ops.constants so the gauge can never drift from
+    the kernel's actual tiling."""
+    from alaz_tpu.ops.constants import DMA_WINDOW, TILE_E
+
+    tile = TILE_E if tile is None else tile
+    window = DMA_WINDOW if window is None else window
     e = edge_src.shape[0]
     if e == 0:
         return 0.0

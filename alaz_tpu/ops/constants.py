@@ -1,0 +1,11 @@
+"""Kernel tiling constants shared with jax-free modules.
+
+The Pallas kernels (ops/pallas_segment.py) chunk edges at ``TILE_E`` and
+DMA node-table rows in ``DMA_WINDOW``-row windows; host-side cost models
+(graph/builder.src_band_windows — the windows.src_band_windows gauge)
+must use the SAME values or they steer operators to the wrong
+src-gather choice. This module keeps them importable without jax.
+"""
+
+TILE_E = 512  # edges per kernel chunk (multiple of 128)
+DMA_WINDOW = 128  # node-table rows per DMA window (= MXU width)

@@ -23,8 +23,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from alaz_tpu.ops.constants import TILE_E  # shared with host cost models
+
 TILE_N = 128  # destination rows per grid step (= MXU width)
-TILE_E = 512  # edges per inner chunk (multiple of 128)
 _DST_ROWS = TILE_E // 128  # 128-edge sub-rows per chunk
 
 

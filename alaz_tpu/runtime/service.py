@@ -25,7 +25,7 @@ from alaz_tpu.config import RuntimeConfig
 from alaz_tpu.datastore.interface import BaseDataStore, DataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.schema import L7Protocol
-from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.graph.builder import WindowedGraphStore, src_band_windows
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges
@@ -270,6 +270,12 @@ class Service:
     def _enqueue_window(self, batch: GraphBatch) -> None:
         self.window_queue.put_nowait_drop([batch])
         self.metrics.counter("windows.closed").inc()
+        # the banded src-gather's DMA cost model on live traffic: lets an
+        # operator read off whether SRC_GATHER=banded would pay here
+        # (≲4 windows/chunk → yes; table-wide → keep the XLA gather)
+        self.metrics.gauge("windows.src_band_windows").set(
+            src_band_windows(batch.edge_src[: batch.n_edges])
+        )
 
     def _consume(self, queue: BatchQueue, fn: Callable[[Any], None]) -> None:
         """Worker loop: every successfully-gotten batch is matched with a

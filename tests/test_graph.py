@@ -270,6 +270,23 @@ class TestClusterRenumber:
                 ref = uid_to_feat
         assert ref == uid_to_feat
 
+    def test_service_exports_band_gauge(self):
+        import numpy as np
+
+        from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.runtime.service import Service
+
+        svc = Service(interner=Interner())
+        rows = make_requests(50)
+        rows["from_uid"] = np.arange(50) % 7 + 1
+        rows["to_uid"] = 100
+        rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
+        rows["start_time_ms"] = 5000
+        svc.graph_store.persist_requests(rows)
+        svc.graph_store.flush()
+        assert svc.metrics.snapshot()["windows.src_band_windows"] >= 1.0
+
     def test_service_refuses_renumber_with_tgn(self):
         import pytest
 
