@@ -200,6 +200,7 @@ class NativeIngest:
         ring_capacity: int = 1 << 18,
         max_edges: int = 1 << 20,
         max_nodes: int = 1 << 20,
+        renumber: bool = False,
     ):
         lib = _load()
         if lib is None:
@@ -209,6 +210,9 @@ class NativeIngest:
         self.window_s = window_s
         self.max_edges = max_edges
         self.max_nodes = max_nodes
+        # the locality pass runs host-side on the exported arrays — the
+        # C++ core's internal slot assignment is untouched
+        self.renumber = renumber
         self._h = ctypes.c_void_p(
             lib.alz_create(self.window_ms, ring_capacity, max_edges, max_nodes)
         )
@@ -397,6 +401,14 @@ class NativeIngest:
         nf[:, 9] = np.log1p(in_lat / np.maximum(in_cnt, 1.0)) / 20.0
         nf[:, 10] = np.log1p(out_deg)
         nf[:, 11] = np.log1p(in_deg)
+
+        if self.renumber and n > 0:
+            from alaz_tpu.graph.builder import apply_renumber, cluster_renumber
+
+            perm = cluster_renumber(src, dst, n_nodes, edge_weight=count)
+            src, dst, nf, node_type, uids = apply_renumber(
+                perm, src, dst, nf, node_type, uids
+            )
 
         return GraphBatch.build(
             node_feats=nf,

@@ -8,4 +8,7 @@ src-gather choice. This module keeps them importable without jax.
 """
 
 TILE_E = 512  # edges per kernel chunk (multiple of 128)
-DMA_WINDOW = 128  # node-table rows per DMA window (= MXU width)
+# Node-table rows per DMA window. STRUCTURAL: this equals the MXU width
+# (128) and the kernels' VMEM scratch/one-hot shapes are written against
+# the literal; it is exported for cost models to read, not to retune.
+DMA_WINDOW = 128

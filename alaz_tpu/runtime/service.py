@@ -133,31 +133,26 @@ class Service:
         self.window_queue = BatchQueue(10_000_000, "windows")
 
         renumber = getattr(self.config, "renumber_nodes", False)
+        if renumber and self.config.model.model == "tgn":
+            # per-window renumbering scrambles node SLOTS between windows;
+            # the temporal model's memory is slot-indexed across windows
+            raise ValueError(
+                "renumber_nodes is incompatible with model=tgn "
+                "(cross-window slot-indexed memory); disable one of the two"
+            )
         self.graph_store = None
         if use_native_ingest:
             from alaz_tpu.graph import native as native_mod
 
             if native_mod.available():
-                if renumber:
-                    log.warning(
-                        "renumber_nodes not supported by the native store; "
-                        "ignoring (the C++ core owns its slot assignment)"
-                    )
                 self.graph_store = native_mod.NativeWindowedStore(
-                    window_s=self.config.window_s, on_batch=self._enqueue_window
+                    window_s=self.config.window_s,
+                    on_batch=self._enqueue_window,
+                    renumber=renumber,
                 )
             else:
                 log.warning("native ingest requested but library unavailable; using numpy store")
         if self.graph_store is None:
-            if renumber and self.config.model.model == "tgn":
-                # per-window renumbering scrambles node SLOTS between
-                # windows; the temporal model's memory is slot-indexed
-                # across windows. Only fatal where renumbering would
-                # actually run (the native store ignores the flag above).
-                raise ValueError(
-                    "renumber_nodes is incompatible with model=tgn "
-                    "(cross-window slot-indexed memory); disable one of the two"
-                )
             self.graph_store = WindowedGraphStore(
                 self.interner,
                 window_s=self.config.window_s,
