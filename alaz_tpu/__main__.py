@@ -196,6 +196,24 @@ def cmd_serve(args) -> int:
             containers = ContainerIndex(lister=CriContainerLister(cri_sock))
             containers.start(svc)
     svc.start()
+    # live k8s informers (k8s/informer.go:67-157): in-cluster discovery
+    # by default, K8S_API_SERVER override for tests/out-of-cluster.
+    # Replay configs carry their own k8s messages, so live serve only.
+    k8s_src = None
+    if cfg.k8s_enabled and not args.config:
+        from alaz_tpu.sources.k8s_watch import K8sWatchSource
+
+        k8s_src = K8sWatchSource(
+            exclude_namespaces=[
+                ns.strip() for ns in cfg.exclude_namespaces.split(",") if ns.strip()
+            ],
+            api_server=cfg.k8s_api_server or None,
+            token_file=cfg.k8s_token_file or None,
+            ca_file=cfg.k8s_ca_file or None,
+        )
+        k8s_src.start(svc)
+        if k8s_src.live:
+            print("k8s informers watching", file=sys.stderr)
     ingest_srv = None
     if args.ingest_socket:
         from alaz_tpu.sources.ingest_server import IngestServer
@@ -239,6 +257,8 @@ def cmd_serve(args) -> int:
             ingest_srv.stop()
         if containers is not None:
             containers.stop()
+        if k8s_src is not None:
+            k8s_src.stop()
         if hc:
             hc.stop()
         debug.stop()

@@ -244,6 +244,13 @@ class RuntimeConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     window_s: float = 1.0  # graph snapshot window
     k8s_enabled: bool = True
+    # explicit apiserver URL (tests / out-of-cluster); empty = in-cluster
+    # serviceaccount discovery (KUBERNETES_SERVICE_HOST + mounted token).
+    # Token/CA for the override: the token file is re-read per request
+    # (bound tokens rotate on disk)
+    k8s_api_server: str = ""
+    k8s_token_file: str = ""
+    k8s_ca_file: str = ""
     exclude_namespaces: str = ""
     send_alive_tcp_connections: bool = False
     # True only when tracked pids are processes of THIS node (live-agent
@@ -274,6 +281,9 @@ class RuntimeConfig:
             mesh=MeshConfig.from_env(),
             window_s=env_float("WINDOW_S", 1.0),
             k8s_enabled=env_bool("K8S_COLLECTOR_ENABLED", True),
+            k8s_api_server=env_str("K8S_API_SERVER", ""),
+            k8s_token_file=env_str("K8S_TOKEN_FILE", ""),
+            k8s_ca_file=env_str("K8S_CA_FILE", ""),
             exclude_namespaces=env_str("EXCLUDE_NAMESPACES", ""),
             send_alive_tcp_connections=env_bool("SEND_ALIVE_TCP_CONNECTIONS", False),
             local_pids=env_bool("LOCAL_PIDS", False),

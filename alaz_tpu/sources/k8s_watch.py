@@ -6,10 +6,15 @@ stream translates ADDED/MODIFIED/DELETED into EventType.ADD/UPDATE/DELETE
 — so deletions reach the cluster IP maps immediately instead of going
 stale forever, and adds are not up to 2 minutes late. A full re-LIST
 every ``resync_interval_s`` (informer.go:47: 120s) remains the fallback
-for missed watch events. The object→DTO translation layer is pure
-functions over duck-typed client objects, unit-tested with stubs
-(tests/test_sources.py); only the client/connection plumbing needs a
-cluster. Without a cluster (or the client library) the source runs in
+for missed watch events. The transport is the repo's own minimal REST
+client (``k8s_client``: LIST + chunked WATCH over the stdlib — the
+client-go analog), discovered in-cluster via the serviceaccount
+convention or pointed at any apiserver with ``api_server=``; the whole
+loop (seed, rv tracking, 410 resume, error backoff, reconcile-deletes)
+is exercised against a local fake apiserver in
+tests/test_k8s_apiserver.py. The object→DTO translation layer is pure
+functions over duck-typed objects, unit-tested with stubs
+(tests/test_sources.py). Without an apiserver the source runs in
 injected mode: tests and replay push messages through ``inject``. Pods
 additionally fan out one CONTAINER message per container (pod.go:48-87).
 """
@@ -36,8 +41,25 @@ from alaz_tpu.events.k8s import (
     StatefulSet,
 )
 from alaz_tpu.logging import get_logger
+from alaz_tpu.sources.k8s_client import (
+    BuiltinWatch,
+    ClusterConfig,
+    K8sRestClient,
+    KindEndpoint,
+)
 
 log = get_logger("alaz_tpu.k8s")
+
+# all-namespaces collection paths, one per informer kind
+KIND_PATHS = {
+    ResourceType.POD: "/api/v1/pods",
+    ResourceType.SERVICE: "/api/v1/services",
+    ResourceType.ENDPOINTS: "/api/v1/endpoints",
+    ResourceType.REPLICASET: "/apis/apps/v1/replicasets",
+    ResourceType.DEPLOYMENT: "/apis/apps/v1/deployments",
+    ResourceType.DAEMONSET: "/apis/apps/v1/daemonsets",
+    ResourceType.STATEFULSET: "/apis/apps/v1/statefulsets",
+}
 
 _WATCH_KINDS = (
     ResourceType.POD,
@@ -210,13 +232,23 @@ class K8sWatchSource:
         resync_interval_s: float = 120.0,
         in_cluster: bool = True,
         error_backoff_s: float = 5.0,
+        api_server: Optional[str] = None,
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        ca_file: Optional[str] = None,
     ):
         self.exclude = set(exclude_namespaces)
         self.resync_interval_s = resync_interval_s
         self.in_cluster = in_cluster
         self.error_backoff_s = error_backoff_s
+        self.api_server = api_server
+        self.token = token
+        self.token_file = token_file
+        self.ca_file = ca_file
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._watches: set = set()
+        self._watch_lock = threading.Lock()
         self._service = None
         self.live = False
 
@@ -237,42 +269,51 @@ class K8sWatchSource:
     def start(self, service) -> None:
         self._service = service
         self._stop.clear()
-        try:
-            import kubernetes  # type: ignore # noqa: F401
-
-            self.live = True
-        except ImportError:
-            log.info("kubernetes client unavailable; k8s source in injected mode")
+        config = self._resolve_config()
+        if config is None:
+            log.info("no apiserver configured or discovered; k8s source in injected mode")
             return
-        listers = self._make_listers()
+        self.live = True
+        listers = self._make_listers(config)
         for kind in _WATCH_KINDS:
             t = threading.Thread(
                 target=self._kind_loop,
-                args=(kind, listers[kind]),
+                args=(kind, listers[kind], self._watch_factory),
                 name=f"alaz-k8s-{kind.value}",
                 daemon=True,
             )
             t.start()
             self._threads.append(t)
 
-    def _make_listers(self) -> dict:  # pragma: no cover - needs a cluster
-        import kubernetes as k8s  # type: ignore
-
+    def _resolve_config(self) -> Optional[ClusterConfig]:
+        """Explicit ``api_server`` beats in-cluster serviceaccount
+        discovery (client-go rest.InClusterConfig order)."""
+        if self.api_server is not None:
+            return ClusterConfig(
+                base_url=self.api_server,
+                token=self.token,
+                token_file=self.token_file,
+                ca_file=self.ca_file,
+            )
         if self.in_cluster:
-            k8s.config.load_incluster_config()
-        else:
-            k8s.config.load_kube_config()
-        v1 = k8s.client.CoreV1Api()
-        apps = k8s.client.AppsV1Api()
-        return {
-            ResourceType.POD: v1.list_pod_for_all_namespaces,
-            ResourceType.SERVICE: v1.list_service_for_all_namespaces,
-            ResourceType.ENDPOINTS: v1.list_endpoints_for_all_namespaces,
-            ResourceType.REPLICASET: apps.list_replica_set_for_all_namespaces,
-            ResourceType.DEPLOYMENT: apps.list_deployment_for_all_namespaces,
-            ResourceType.DAEMONSET: apps.list_daemon_set_for_all_namespaces,
-            ResourceType.STATEFULSET: apps.list_stateful_set_for_all_namespaces,
-        }
+            return ClusterConfig.in_cluster()
+        return None
+
+    def _make_listers(self, config: ClusterConfig) -> dict:
+        client = K8sRestClient(config)
+        return {kind: KindEndpoint(client, path) for kind, path in KIND_PATHS.items()}
+
+    def _watch_factory(self) -> BuiltinWatch:
+        """BuiltinWatch with source-level registration so stop() can close
+        a stream blocked mid-read from another thread."""
+        w = BuiltinWatch()
+        with self._watch_lock:
+            self._watches.add(w)
+        # a kind loop past stop()'s registry drain would otherwise dial an
+        # unstoppable stream; marking it stopped makes stream() a no-op
+        if self._stop.is_set():
+            w.stop()
+        return w
 
     def _kind_loop(self, kind: ResourceType, lister, watch_factory=None) -> None:
         """One informer: LIST (seed + resync, with vanished-object DELETE
@@ -282,12 +323,10 @@ class K8sWatchSource:
         30s server timeout must NOT trigger one). A 410 Gone from the
         watch means the resourceVersion expired server-side — that IS a
         re-LIST trigger, taken immediately without the error backoff.
-        ``watch_factory`` is the client seam: the kubernetes package's
-        Watch by default, protocol-faithful fakes in tests."""
-        if watch_factory is None:  # pragma: no cover - needs the client
-            import kubernetes as k8s  # type: ignore
-
-            watch_factory = k8s.watch.Watch
+        ``watch_factory`` is the client seam: registered BuiltinWatch
+        instances in live mode, protocol-faithful fakes in tests."""
+        if watch_factory is None:
+            watch_factory = BuiltinWatch
 
         known: dict[str, object] = {}
         while not self._stop.is_set():
@@ -338,6 +377,8 @@ class K8sWatchSource:
                             raise
                     finally:
                         w.stop()
+                        with self._watch_lock:
+                            self._watches.discard(w)
                     # stream timeout: loop re-watches from the last rv
             except Exception as exc:
                 log.warning(f"k8s watch {kind.value} failed: {exc}")
@@ -345,6 +386,13 @@ class K8sWatchSource:
 
     def stop(self) -> None:
         self._stop.set()
+        # close live streams so a loop blocked on a quiet watch unblocks
+        # now instead of at its socket timeout
+        with self._watch_lock:
+            watches = list(self._watches)
+            self._watches.clear()
+        for w in watches:
+            w.stop()
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
