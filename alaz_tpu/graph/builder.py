@@ -140,17 +140,63 @@ def src_band_windows(
     the kernel's actual tiling."""
     from alaz_tpu.ops.constants import DMA_WINDOW, TILE_E
 
+    return src_locality_gauges(edge_src, n_nodes=0, tile=tile, window=window)[0]
+
+
+def src_straggler_fraction(
+    edge_src: np.ndarray,
+    n_nodes: int,
+    tile: int | None = None,
+    window: int | None = None,
+    band: int | None = None,
+) -> float:
+    """Fraction of edges whose src falls OUTSIDE the fixed
+    ``band``-window band centered on its chunk's median window — the
+    hybrid banded gather's exact fix-up cost model (the kernel covers the
+    band; everything else is an XLA row op). ≲0.15 after
+    cluster_renumber on ~90%-local community maps; →1.0 on
+    uniform-random ids, where the plain XLA gather is the right choice.
+    The kernel falls back to the plain gather above 1/8 (its static
+    straggler budget), so the operator threshold is 0.125."""
+    return src_locality_gauges(edge_src, n_nodes, tile=tile, window=window, band=band)[1]
+
+
+def src_locality_gauges(
+    edge_src: np.ndarray,
+    n_nodes: int,
+    tile: int | None = None,
+    window: int | None = None,
+    band: int | None = None,
+) -> tuple[float, float]:
+    """(mean band windows, straggler fraction) in one shared pass over
+    ``edge_src`` — the per-window-close gauge pair shares the pad +
+    reshape so the hot window-close path walks the array once.
+    ``n_nodes`` ≤ 0 skips the straggler half (returns 1.0)."""
+    from alaz_tpu.ops.constants import BAND_WINDOWS, DMA_WINDOW, TILE_E
+
     tile = TILE_E if tile is None else tile
     window = DMA_WINDOW if window is None else window
+    band = BAND_WINDOWS if band is None else band
     e = edge_src.shape[0]
     if e == 0:
-        return 0.0
+        return 0.0, 0.0
     pad = (-e) % tile
     ids = np.concatenate([edge_src, np.full(pad, edge_src[-1])]) if pad else edge_src
-    per_chunk = ids.reshape(-1, tile)
-    lo = (per_chunk.min(axis=1) // window) * window
+    win = ids.astype(np.int64) // window
+    per_chunk = win.reshape(-1, tile)
+    lo = per_chunk.min(axis=1)
     hi = per_chunk.max(axis=1)
-    return float(np.mean((hi - lo) // window + 1))
+    band_windows = float(np.mean(hi - lo + 1))
+    if n_nodes <= 0:
+        return band_windows, 1.0
+    n_windows = max(1, n_nodes // window)
+    b = min(band, n_windows)
+    med = np.median(per_chunk, axis=1).astype(np.int64)
+    lo_w = np.clip(med - b // 2, 0, n_windows - b)
+    lo_e = np.repeat(lo_w, tile)
+    in_band = (win >= lo_e) & (win < lo_e + b)
+    # padded ids replicate a real edge; count only the real edge axis
+    return band_windows, float(np.mean(~in_band[:e]))
 
 
 def apply_renumber(

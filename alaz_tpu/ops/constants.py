@@ -8,6 +8,12 @@ src-gather choice. This module keeps them importable without jax.
 """
 
 TILE_E = 512  # edges per kernel chunk (multiple of 128)
+# Fixed band width (in DMA_WINDOW-row windows) the hybrid banded gather
+# covers around each chunk's median src window; ids outside the band are
+# fixed up host-side by an XLA row gather over a static 1/8-of-edges
+# straggler budget. 4 windows = 512 rows comfortably covers one
+# renumbered team/community; widening it scales kernel FLOPs linearly.
+BAND_WINDOWS = 4
 # Node-table rows per DMA window. STRUCTURAL: this equals the MXU width
 # (128) and the kernels' VMEM scratch/one-hot shapes are written against
 # the literal; it is exported for cost models to read, not to retune.

@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from alaz_tpu.ops.constants import TILE_E  # shared with host cost models
+from alaz_tpu.ops.constants import (  # shared with host cost models
+    BAND_WINDOWS,
+    TILE_E,
+)
 
 TILE_N = 128  # destination rows per grid step (= MXU width)
 _DST_ROWS = TILE_E // 128  # 128-edge sub-rows per chunk
@@ -345,26 +348,39 @@ segment_expand_sorted.defvjp(_expand_vjp_fwd, _expand_vjp_bwd)
 
 
 # ---------------------------------------------------------------------------
-# Banded gather: out[e] = v[ids[e]] for ids that are UNSORTED but lie in a
-# narrow band per TILE_E chunk — the src-side gather after the
+# Banded gather: out[e] = v[ids[e]] for ids that are UNSORTED but mostly
+# cluster per TILE_E chunk — the src-side gather after the
 # cluster_renumber layout pass (graph/builder.py). Edges are dst-sorted;
-# with community structure + renumbering, the sources referenced by a
-# chunk of consecutive edges span a few 128-row windows of the node
-# table. Each chunk DMAs its [min,max] window range and expands rows via
-# one-hot MXU matmuls (rows outside a window one-hot to zero, so summing
-# windows covers every edge exactly once). DMA count ≈ Σ_c band_c/128
-# instead of one row op per edge — on uniform-random ids the band is the
-# whole table and the XLA gather is strictly better; callers gate on the
-# measured band (ARCHITECTURE.md §3b).
+# with community structure + renumbering, MOST sources referenced by a
+# chunk of consecutive edges sit near each other in the node table, but
+# real service maps always carry cross-team strays (even 1 stray per
+# chunk blows a [min,max] band out to the whole table — measured 70×
+# slower than the XLA gather at 10% cross-team traffic). So the kernel
+# covers a FIXED BAND_WINDOWS-wide band centered on each chunk's median
+# window: in-band rows expand via one-hot MXU matmuls (out-of-band ids
+# one-hot to zero), and the host fixes up the stragglers with an XLA
+# row-gather over a static budget of positions, falling back to the
+# plain gather if a batch overflows the budget. DMA count is a flat
+# BAND_WINDOWS/chunk and the straggler cost is ≤ budget·~9ns — on
+# uniform-random ids nearly everything is a straggler and the XLA
+# gather is strictly better; callers gate on the measured straggler
+# fraction (ARCHITECTURE.md §3b).
 # ---------------------------------------------------------------------------
 
 
 def _banded_gather_kernel(
-    lo_ref, nw_ref, v_hbm, ids_hbm, out_ref, v_scratch, id_scratch, sems
+    band, lo_ref, v_hbm, ids_hbm, out_ref, v_scratch, id_scratch, sems
 ):
+    # ``band`` is a static Python int (the fixed window count every chunk
+    # covers), so the window loop below unrolls and double-buffer slots
+    # are compile-time constants.
     c = pl.program_id(0)
-    lo = lo_ref[c]  # 128-aligned window base for this chunk
-    nw = nw_ref[c]  # number of 128-row windows the chunk's band spans
+    # lo_ref carries the window INDEX (row//128), not the row base: the
+    # HBM slice offset is then (index)*128, whose tile alignment Mosaic
+    # can prove — a raw runtime row offset is rejected ("tile index in
+    # dimension 0 is divisible by the tiling") even when it is a
+    # multiple of 128 by construction
+    lo_w = lo_ref[c]
 
     for r in range(_DST_ROWS):
         pltpu.make_async_copy(
@@ -375,7 +391,7 @@ def _banded_gather_kernel(
 
     def win_dma(slot, w):
         return pltpu.make_async_copy(
-            v_hbm.at[pl.ds(lo + w * 128, 128), :],
+            v_hbm.at[pl.ds((lo_w + w) * 128, 128), :],
             v_scratch.at[slot],
             sems.at[slot, 0],
         )
@@ -396,15 +412,12 @@ def _banded_gather_kernel(
 
     out_ref[:] = jnp.zeros_like(out_ref)
 
-    def body(w, _):
-        slot = jax.lax.rem(w, 2)
-
-        @pl.when(w + 1 < nw)
-        def _():
+    for w in range(band):
+        slot = w % 2
+        if w + 1 < band:
             win_dma(1 - slot, w + 1).start()
-
         win_dma(slot, w).wait()
-        win0 = lo + w * 128
+        win0 = (lo_w + w) * 128
         for r in range(_DST_ROWS):
             id_local = id_scratch[r, :].reshape(128, 1) - win0
             onehot = (
@@ -418,24 +431,53 @@ def _banded_gather_kernel(
                 precision=precision,
             )
             out_ref[r * 128 : (r + 1) * 128, :] += contrib.astype(out_ref.dtype)
-        return 0
-
-    jax.lax.fori_loop(0, nw, body, 0)
 
 
 def _gather_banded(v: jnp.ndarray, ids: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Hybrid banded gather: fixed-width Pallas band + XLA straggler
+    fix-up, with a whole-batch XLA fallback when stragglers overflow the
+    budget (correctness never depends on the layout actually clustering).
+    """
     n, f = v.shape
     e = ids.shape[0]
     assert e % TILE_E == 0 and n % 128 == 0
     n_chunks = e // TILE_E
-    ids2d = ids.reshape(e // 128, 128).astype(jnp.int32)
-    per_chunk = ids.reshape(n_chunks, TILE_E).astype(jnp.int32)
-    lo = (jnp.min(per_chunk, axis=1) // 128) * 128
-    hi = jnp.max(per_chunk, axis=1)
-    nw = (hi - lo) // 128 + 1
+    ids = ids.astype(jnp.int32)
+    n_windows = n // 128
+    band = min(BAND_WINDOWS, n_windows)
+    win = ids // 128
+    per_chunk = win.reshape(n_chunks, TILE_E)
+    # median window per chunk: robust to strays, unlike min/max
+    med = jnp.median(per_chunk, axis=1).astype(jnp.int32)
+    lo_w = jnp.clip(med - band // 2, 0, n_windows - band)
+    lo_e = jnp.repeat(lo_w, TILE_E)  # per-edge band base
+    in_band = (win >= lo_e) & (win < lo_e + band)
+    n_strag = jnp.sum(~in_band)
+    # static straggler budget: 1/8 of the edge axis (community maps run
+    # ~10% cross-team); overflow → cond takes the plain-gather branch
+    budget = int(min(e, max(TILE_E, e // 8)))
+
+    def plain(_):
+        return v[ids]
+
+    def hybrid(_):
+        out = _banded_call(v, ids, lo_w, band, interpret)
+        pos = jnp.nonzero(~in_band, size=budget, fill_value=e)[0]
+        rows = v[ids[jnp.minimum(pos, e - 1)]]
+        # fill positions point one past the end; "drop" discards them
+        return out.at[pos].set(rows, mode="drop")
+
+    return jax.lax.cond(n_strag <= budget, hybrid, plain, None)
+
+
+def _banded_call(v, ids, lo_w, band, interpret):
+    n, f = v.shape
+    e = ids.shape[0]
+    n_chunks = e // TILE_E
+    ids2d = ids.reshape(e // 128, 128)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=1,
         grid=(n_chunks,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM; DMA'd
@@ -451,23 +493,24 @@ def _gather_banded(v: jnp.ndarray, ids: jnp.ndarray, interpret: bool = False) ->
         ],
     )
     return pl.pallas_call(
-        _banded_gather_kernel,
+        functools.partial(_banded_gather_kernel, band),
         out_shape=jax.ShapeDtypeStruct((e, f), v.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * e * 128 * f,
+            flops=2 * e * band * 128 * f,
             bytes_accessed=e * f * v.dtype.itemsize * 2 + e * 4,
             transcendentals=0,
         ),
-    )(lo, nw, v, ids2d)
+    )(lo_w, v, ids2d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def gather_rows_banded(v, ids, num_nodes):
-    """out[e] = v[ids[e]] for unsorted ids with narrow per-chunk bands
-    (post-cluster_renumber src gathers). ``num_nodes`` rides along for
-    the backward scatter."""
+    """out[e] = v[ids[e]] for unsorted ids whose per-chunk majority
+    clusters (post-cluster_renumber src gathers); strays are fixed up
+    with an XLA row gather. ``num_nodes`` rides along for the backward
+    scatter."""
     return _banded_fwd_impl(v, ids)
 
 

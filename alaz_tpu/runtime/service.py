@@ -25,7 +25,7 @@ from alaz_tpu.config import RuntimeConfig
 from alaz_tpu.datastore.interface import BaseDataStore, DataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.schema import L7Protocol
-from alaz_tpu.graph.builder import WindowedGraphStore, src_band_windows
+from alaz_tpu.graph.builder import WindowedGraphStore, src_locality_gauges
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges
@@ -265,12 +265,16 @@ class Service:
     def _enqueue_window(self, batch: GraphBatch) -> None:
         self.window_queue.put_nowait_drop([batch])
         self.metrics.counter("windows.closed").inc()
-        # the banded src-gather's DMA cost model on live traffic: lets an
-        # operator read off whether SRC_GATHER=banded would pay here
-        # (≲4 windows/chunk → yes; table-wide → keep the XLA gather)
-        self.metrics.gauge("windows.src_band_windows").set(
-            src_band_windows(batch.edge_src[: batch.n_edges])
+        # the banded src-gather's cost models on live traffic: lets an
+        # operator read off whether SRC_GATHER=banded would pay here.
+        # The decisive gauge is the straggler fraction (<0.125, the
+        # kernel's fix-up budget → banded pays; →1.0 → keep the XLA
+        # gather); the [min,max] band width rides along for context.
+        band_w, strag = src_locality_gauges(
+            batch.edge_src[: batch.n_edges], n_nodes=batch.n_nodes
         )
+        self.metrics.gauge("windows.src_band_windows").set(band_w)
+        self.metrics.gauge("windows.src_straggler_fraction").set(strag)
 
     def _consume(self, queue: BatchQueue, fn: Callable[[Any], None]) -> None:
         """Worker loop: every successfully-gotten batch is matched with a

@@ -136,6 +136,41 @@ class K8sRestClient:
         self._https = parts.scheme == "https"
         self._host = parts.hostname or "localhost"
         self._port = parts.port or (443 if self._https else 80)
+        # live LIST connections, so close_all() can interrupt a thread
+        # parked in a blocking read at informer teardown
+        self._live: set = set()
+        self._live_lock = threading.Lock()
+        self._closed = False
+
+    def _track(self, conn) -> None:
+        with self._live_lock:
+            if self._closed:
+                conn.close()
+                raise ApiException(499, "client closed")
+            self._live.add(conn)
+
+    def _untrack(self, conn) -> None:
+        with self._live_lock:
+            self._live.discard(conn)
+
+    def close_all(self) -> None:
+        """Shut down every in-flight LIST so blocked reads unblock now;
+        subsequent requests fail fast with status 499."""
+        with self._live_lock:
+            self._closed = True
+            conns = list(self._live)
+            self._live.clear()
+        for conn in conns:
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket_module.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover - already dead
+                pass
 
     def _connect(self, timeout_s: float):
         if self._https:
@@ -155,10 +190,16 @@ class K8sRestClient:
     def list(self, path: str, timeout_seconds: int = 30) -> JsonObj:
         query = urlencode({"timeoutSeconds": timeout_seconds})
         conn = self._connect(timeout_seconds + 5)
+        conn.auto_open = 0
         try:
-            conn.request("GET", f"{path}?{query}", headers=self._headers())
-            resp = conn.getresponse()
-            body = resp.read()
+            conn.connect()
+            self._track(conn)
+            try:
+                conn.request("GET", f"{path}?{query}", headers=self._headers())
+                resp = conn.getresponse()
+                body = resp.read()
+            finally:
+                self._untrack(conn)
             if resp.status != 200:
                 raise ApiException(resp.status, body[:200].decode("utf-8", "replace"))
             return JsonObj(json.loads(body))
@@ -206,15 +247,26 @@ class BuiltinWatch:
         with self._lock:
             if self._stopped:
                 return
-            conn = client._connect(timeout_seconds + 5)
+        conn = client._connect(timeout_seconds + 5)
+        # without this, a stop() racing the dial is defeated by
+        # http.client's auto_open: request() on the closed conn silently
+        # re-dials and streams anyway
+        conn.auto_open = 0
+        try:
+            conn.connect()  # outside the lock: a slow dial must not block stop()
+        except Exception:
+            if self._stopped:
+                return
+            raise
+        with self._lock:
+            if self._stopped:  # stop() ran while we were dialing
+                conn.close()
+                return
             self._conn = conn
+            self._sock = conn.sock
         try:
             try:
                 conn.request("GET", f"{lister.path}?{query}", headers=client._headers())
-                # a close-delimited response detaches the socket from the
-                # connection; grab it now so stop() can still shut it down
-                with self._lock:
-                    self._sock = conn.sock
                 resp = conn.getresponse()
             except Exception:
                 if self._stopped:

@@ -249,13 +249,14 @@ class K8sWatchSource:
         self._threads: List[threading.Thread] = []
         self._watches: set = set()
         self._watch_lock = threading.Lock()
+        self._client: Optional[K8sRestClient] = None
         self._service = None
         self.live = False
 
     # -- injected mode (tests / replay) ------------------------------------
 
     def inject(self, msg: K8sResourceMessage) -> None:
-        if self._service is None:
+        if self._service is None or self._stop.is_set():
             return
         obj = msg.object
         ns = getattr(obj, "namespace", "")
@@ -300,8 +301,10 @@ class K8sWatchSource:
         return None
 
     def _make_listers(self, config: ClusterConfig) -> dict:
-        client = K8sRestClient(config)
-        return {kind: KindEndpoint(client, path) for kind, path in KIND_PATHS.items()}
+        self._client = K8sRestClient(config)
+        return {
+            kind: KindEndpoint(self._client, path) for kind, path in KIND_PATHS.items()
+        }
 
     def _watch_factory(self) -> BuiltinWatch:
         """BuiltinWatch with source-level registration so stop() can close
@@ -381,18 +384,22 @@ class K8sWatchSource:
                             self._watches.discard(w)
                     # stream timeout: loop re-watches from the last rv
             except Exception as exc:
+                if self._stop.is_set():
+                    break  # teardown interrupted the call — not an error
                 log.warning(f"k8s watch {kind.value} failed: {exc}")
                 self._stop.wait(self.error_backoff_s)
 
     def stop(self) -> None:
         self._stop.set()
-        # close live streams so a loop blocked on a quiet watch unblocks
-        # now instead of at its socket timeout
+        # close live streams and in-flight LISTs so a loop blocked on a
+        # quiet watch or a slow LIST unblocks now, not at socket timeout
         with self._watch_lock:
             watches = list(self._watches)
             self._watches.clear()
         for w in watches:
             w.stop()
+        if self._client is not None:
+            self._client.close_all()
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()

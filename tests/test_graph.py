@@ -231,6 +231,24 @@ class TestClusterRenumber:
         assert src_band_windows(narrow) <= 2.0
         assert src_band_windows(wide) > 100.0
 
+    def test_src_straggler_fraction_cost_model(self):
+        import numpy as np
+
+        from alaz_tpu.graph.builder import src_straggler_fraction
+
+        rng = np.random.default_rng(0)
+        n = 100_000
+        assert src_straggler_fraction(np.zeros(0, np.int32), n) == 0.0
+        # 90% of each chunk near one spot, 10% uniform strays — the
+        # community shape the hybrid kernel is built for
+        local = rng.integers(256, 384, 2048).astype(np.int32)
+        stray = rng.random(2048) < 0.10
+        local[stray] = rng.integers(0, n, int(stray.sum()))
+        frac = src_straggler_fraction(local, n)
+        assert 0.02 < frac < 0.125, frac  # under the kernel's budget
+        uniform = rng.integers(0, n, 2048).astype(np.int32)
+        assert src_straggler_fraction(uniform, n) > 0.9
+
     def test_builder_renumber_preserves_uid_edges(self):
         """The production pass: GraphBuilder(renumber=True) permutes the
         batch internally but the uid-level edge list — what the score

@@ -182,6 +182,34 @@ class TestBandedGather:
         np.add.at(ref, ids, g)
         np.testing.assert_allclose(dv, ref, atol=1e-4)
 
+    def test_straggler_fixup_exact(self):
+        """~10% of ids land far outside every chunk's band (cross-team
+        strays); the hybrid's XLA fix-up must restore them exactly."""
+        from alaz_tpu.ops.pallas_segment import gather_rows_banded
+
+        rng = np.random.default_rng(7)
+        n, e, f = 4096, 2048, 32
+        ids = self._banded_ids(rng, n, e, band=128)
+        stray = rng.random(e) < 0.10
+        ids[stray] = rng.integers(0, n, int(stray.sum()))
+        v = rng.normal(size=(n, f)).astype(np.float32)
+        out = np.asarray(gather_rows_banded(jnp.asarray(v), jnp.asarray(ids), n))
+        np.testing.assert_allclose(out, v[ids], atol=1e-6)
+
+    def test_budget_overflow_falls_back_to_plain_gather(self):
+        """Uniform-random ids overflow the 1/8 straggler budget: the
+        cond must take the plain-gather branch and stay exact (this is
+        the correctness half of the operator gate; the perf half is
+        src_straggler_fraction)."""
+        from alaz_tpu.ops.pallas_segment import gather_rows_banded
+
+        rng = np.random.default_rng(8)
+        n, e, f = 8192, 1024, 32
+        ids = rng.integers(0, n, e).astype(np.int32)
+        v = rng.normal(size=(n, f)).astype(np.float32)
+        out = np.asarray(gather_rows_banded(jnp.asarray(v), jnp.asarray(ids), n))
+        np.testing.assert_allclose(out, v[ids], atol=1e-6)
+
     def test_model_output_identical_under_banded_mode(self):
         """src_gather='banded-interpret' must be a pure layout-aware
         substitution: same logits as the XLA gather path."""
