@@ -138,8 +138,6 @@ def src_band_windows(
     Callers use this to pick ModelConfig.src_gather per deployment.
     Defaults come from ops.constants so the gauge can never drift from
     the kernel's actual tiling."""
-    from alaz_tpu.ops.constants import DMA_WINDOW, TILE_E
-
     return src_locality_gauges(edge_src, n_nodes=0, tile=tile, window=window)[0]
 
 
@@ -189,7 +187,10 @@ def src_locality_gauges(
     band_windows = float(np.mean(hi - lo + 1))
     if n_nodes <= 0:
         return band_windows, 1.0
-    n_windows = max(1, n_nodes // window)
+    # ceil: the kernel sees the 128-padded node table, so a partial top
+    # window is still coverable — flooring would misplace bands near the
+    # table top and misread fractions sitting at the 0.125 threshold
+    n_windows = max(1, -(-n_nodes // window))
     b = min(band, n_windows)
     med = np.median(per_chunk, axis=1).astype(np.int64)
     lo_w = np.clip(med - b // 2, 0, n_windows - b)

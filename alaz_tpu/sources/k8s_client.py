@@ -188,6 +188,9 @@ class K8sRestClient:
         return headers
 
     def list(self, path: str, timeout_seconds: int = 30) -> JsonObj:
+        with self._live_lock:
+            if self._closed:  # fail fast BEFORE dialing — close_all()
+                raise ApiException(499, "client closed")  # can't interrupt a dial
         query = urlencode({"timeoutSeconds": timeout_seconds})
         conn = self._connect(timeout_seconds + 5)
         conn.auto_open = 0
