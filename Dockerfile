@@ -29,16 +29,16 @@ RUN apt-get update \
 # TPU nodes: jax[tpu] pulls libtpu via the Google releases index.
 # JAX_VARIANT=cpu builds a CPU-only image for data-plane nodes.
 ARG JAX_VARIANT=tpu
-# kubernetes: the live LIST+WATCH collector (k8s_watch.py) downgrades to
-# injected mode without it — the manifest's RBAC exists for this client
+# No kubernetes client dependency: the live LIST+WATCH collector speaks
+# the apiserver REST protocol itself (sources/k8s_client.py) using the
+# in-cluster serviceaccount — the manifest's RBAC exists for this client
 RUN pip install --no-cache-dir \
     "jax[${JAX_VARIANT}]" \
     flax \
     optax \
     orbax-checkpoint \
     einops \
-    numpy \
-    kubernetes
+    numpy
 
 WORKDIR /app
 COPY alaz_tpu/ alaz_tpu/

@@ -70,9 +70,22 @@ class TestHealth:
 
 
 class TestService:
-    def _run_service(self, score=True):
+    def _run_service(
+        self,
+        score=True,
+        src_gather="xla",
+        renumber=False,
+        seed=None,
+        duration_s=3.0,
+    ):
         interner = Interner()
-        cfg = RuntimeConfig(model=ModelConfig(model="graphsage", hidden_dim=32, use_pallas=False))
+        cfg = RuntimeConfig(
+            model=ModelConfig(
+                model="graphsage", hidden_dim=32, use_pallas=False,
+                src_gather=src_gather,
+            )
+        )
+        cfg.renumber_nodes = renumber
         params = None
         if score:
             init, _ = get_model("graphsage")
@@ -85,10 +98,13 @@ class TestService:
             model_state=params,
             score_threshold=0.0,  # untrained model: keep every edge
         )
-        sim = Simulator(
-            SimulationConfig(test_duration_s=3.0, pod_count=30, service_count=10, edge_count=15, edge_rate=200),
-            interner=interner,
+        sim_cfg = SimulationConfig(
+            test_duration_s=duration_s, pod_count=30, service_count=10,
+            edge_count=15, edge_rate=200,
         )
+        if seed is not None:
+            sim_cfg.seed = seed
+        sim = Simulator(sim_cfg, interner=interner)
         svc.start()
         try:
             for m in sim.setup():
@@ -103,6 +119,29 @@ class TestService:
         finally:
             svc.stop()
         return svc, scores
+
+    @staticmethod
+    def _score_map(scores):
+        return {
+            (r.window_start_ms, r.from_uid, r.to_uid, r.protocol): r.score
+            for r in scores
+        }
+
+    def test_renumber_banded_scores_match_plain_path(self):
+        """The production locality combo (RENUMBER_NODES=1 +
+        SRC_GATHER=banded) must be invisible in the exported scores: the
+        per-window permutation and the hybrid gather are layout
+        machinery, not model changes. Same traffic, same params → same
+        per-uid score map as the plain xla path."""
+        _, s_plain = self._run_service(seed=7, duration_s=2.0)
+        _, s_banded = self._run_service(
+            seed=7, duration_s=2.0, renumber=True, src_gather="banded-interpret"
+        )
+        plain, banded = self._score_map(s_plain), self._score_map(s_banded)
+        assert plain, "plain path produced no scores"
+        assert set(plain) == set(banded)
+        for k, v in plain.items():
+            assert abs(v - banded[k]) < 1e-4, (k, v, banded[k])
 
     def test_end_to_end_scoring(self):
         svc, scores = self._run_service(score=True)
