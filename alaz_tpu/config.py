@@ -184,6 +184,13 @@ class ModelConfig:
     num_layers: int = 2
     num_heads: int = 4  # gat only
     num_edge_types: int = 9  # one per L7 protocol enum slot
+    # experts model routing form: "table" computes per-expert node tables
+    # (T cheap N-row matmuls) then ONE (type,src) row gather — the
+    # single-chip fast path (kills the T·E·H mask traffic of the masked
+    # sum); "masked" is the Σ_t 1[type=t]·(h_src@W_t) form whose T axis
+    # shards cleanly over the ep mesh axis (the sharded train/score steps
+    # force it when ep>1)
+    expert_dispatch: str = "table"
     node_feature_dim: int = 32
     edge_feature_dim: int = 16
     dropout: float = 0.1
@@ -207,6 +214,7 @@ class ModelConfig:
             num_layers=env_int("NUM_LAYERS", 2),
             use_pallas=env_bool("USE_PALLAS", True),
             src_gather=env_str("SRC_GATHER", "xla"),
+            expert_dispatch=env_str("EXPERT_DISPATCH", "table"),
             remat=env_bool("REMAT", False),
             tgn_max_nodes=env_int("TGN_MAX_NODES", 4096),
         )

@@ -56,23 +56,44 @@ def scatter_messages(
     edge_mask: jnp.ndarray,
     num_nodes: int,
     use_pallas: bool | str,
+    deg: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Masked message scatter → (sum [N,H], degree [N]). Uses the Pallas
     dst-sorted kernel on TPU, XLA segment_sum elsewhere. ``use_pallas``
     may be the string ``"interpret"`` to force the Pallas path off-TPU
     (pl.pallas_call interpret mode) — how the sharding tests exercise the
     kernel+shard_map interaction on a CPU mesh."""
-    m = msgs * edge_mask[:, None].astype(msgs.dtype)
-    if (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret":
+    mask_col = edge_mask[:, None].astype(msgs.dtype)
+    m = msgs * mask_col
+    pallas = (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret"
+    if pallas:
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
+        if deg is None and msgs.shape[1] % 128 != 0:
+            # the kernel pads features to the next 128-lane tile anyway,
+            # so the degree column rides in the pad slack for free (and
+            # the MXU accumulates the counts in f32)
+            out = scatter_sum_sorted(
+                jnp.concatenate([m, mask_col], axis=1), edge_dst, num_nodes
+            )
+            return out[:, :-1], out[:, -1]
         agg = scatter_sum_sorted(m, edge_dst, num_nodes)
     else:
         agg = jax.ops.segment_sum(m, edge_dst, num_segments=num_nodes)
-    deg = jax.ops.segment_sum(
-        edge_mask.astype(msgs.dtype), edge_dst, num_segments=num_nodes
-    )
+    if deg is None:
+        # models hoist this via masked_degree (edge_dst/edge_mask are
+        # layer-invariant); recomputed here only for direct callers
+        deg = masked_degree(edge_mask, edge_dst, num_nodes, msgs.dtype)
     return agg, deg
+
+
+def masked_degree(edge_mask, edge_dst, num_nodes: int, dtype) -> jnp.ndarray:
+    """deg[d] = Σ_{e: dst[e]=d} mask[e] — layer-invariant, so models
+    compute it ONCE per forward and thread it through every
+    scatter_messages call instead of re-scattering [E] per layer."""
+    return jax.ops.segment_sum(
+        edge_mask.astype(dtype), edge_dst, num_segments=num_nodes
+    )
 
 
 def edge_head_init(key, hidden: int, edge_feat_dim: int) -> list[dict]:

@@ -3,14 +3,20 @@
 The reference dispatches each event to a per-protocol handler
 (data.go:1364-1383); here that becomes per-edge-type expert message
 transforms (SURVEY §2.3 P5): each L7 protocol gets its own message weight
-``W_t``, computed as a masked sum of T dense matmuls (T is small and
-static, so every matmul is MXU-shaped and the routing is branch-free):
+``W_t``,
 
-    m_e = Σ_t 1[type_e = t] · (h[src_e] @ W_t + b_t)
+    m_e = h[src_e] @ W_{type_e} + b_{type_e}
 
-Expert tables are stacked ``[T, H, H]``; under pjit the T axis shards over
-the ``ep`` mesh axis and XLA turns the masked sum into compute-where-
-resident + all-reduce.
+computed in one of two equivalent forms selected by
+``ModelConfig.expert_dispatch``:
+
+- ``"table"`` (default): per-expert node tables ``u_t = h @ W_t`` (T
+  MXU-shaped N-row matmuls) + ONE (type, src) row gather — the
+  single-chip fast path.
+- ``"masked"``: ``Σ_t 1[type_e = t] · (h[src_e] @ W_t + b_t)`` — T
+  branch-free E-row matmuls whose stacked ``[T, H, H]`` expert axis
+  shards over the ``ep`` mesh axis under pjit (compute-where-resident +
+  all-reduce); the sharded train/score steps force this form when ep>1.
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ from alaz_tpu.models.common import (
     layernorm,
     layernorm_init,
     mlp,
+    masked_degree,
     mlp_init,
     scatter_messages,
 )
@@ -65,8 +72,13 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     return params
 
 
-def _expert_messages(layer: Params, h_src: jnp.ndarray, edge_type: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Masked sum over experts — T static matmuls, no gather of weights."""
+def _expert_messages_masked(
+    layer: Params, h_src: jnp.ndarray, edge_type: jnp.ndarray, dtype
+) -> jnp.ndarray:
+    """Masked sum over experts — T static matmuls, no gather of weights.
+    The T axis shards over 'ep' (each device computes its resident
+    experts, psum completes the sum), but every expert reads and writes
+    the full [E, H] edge axis: ~2·T·E·H bytes of mask traffic/layer."""
     t = layer["expert_w"].shape[0]
     out = jnp.zeros_like(h_src)
     for ti in range(t):
@@ -77,6 +89,32 @@ def _expert_messages(layer: Params, h_src: jnp.ndarray, edge_type: jnp.ndarray, 
     return out
 
 
+def _expert_messages_table(
+    layer: Params,
+    h: jnp.ndarray,
+    edge_src: jnp.ndarray,
+    edge_type: jnp.ndarray,
+    dtype,
+) -> jnp.ndarray:
+    """Dense-before-gather over experts: u_t = h @ W_t over N rows (T
+    cheap matmuls), then ONE row gather from the stacked [T·N, H] table
+    at (type, src) — same math as the masked sum with the edge-axis
+    traffic collapsed to a single row-op pass. Single-chip fast path;
+    under ep>1 sharding the [T, N, H] tables would all-gather, so the
+    sharded steps force the masked form (parallel/sharding.py)."""
+    t, hdim = layer["expert_w"].shape[0], h.shape[1]
+    n = h.shape[0]
+    w = layer["expert_w"].astype(dtype)  # [T, H, H]
+    b = layer["expert_b"].astype(dtype)  # [T, H]
+    u = jnp.einsum("nh,thk->tnk", h, w) + b[:, None, :]
+    flat = u.reshape(t * n, hdim)
+    idx = edge_type.astype(jnp.int32) * n + edge_src
+    # protocol codes outside [0, T) got zero messages from the masked
+    # form; clip + zero keeps that contract instead of clamp-gathering
+    valid = ((edge_type >= 0) & (edge_type < t)).astype(dtype)[:, None]
+    return flat[jnp.clip(idx, 0, t * n - 1)] * valid
+
+
 def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     dtype = compute_dtype(cfg)
     n = graph["node_feats"].shape[0]
@@ -85,16 +123,32 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
 
     h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
     ef = graph["edge_feats"].astype(dtype)
+    # degree is layer-invariant: one [E] scatter per forward, not per layer
+    deg = masked_degree(edge_mask, graph["edge_dst"], n, dtype)
 
-    for layer in params["layers"]:
-        msgs = _expert_messages(
-            layer,
-            gather_src(h, graph["edge_src"], n, cfg.src_gather),
-            graph["edge_type"],
-            dtype,
+    if cfg.expert_dispatch not in ("table", "masked"):
+        # a typo (EXPERT_DISPATCH=tabel) silently running the slow form
+        # would poison every '[experts]' benchmark row — same contract as
+        # gather_src's mode check
+        raise ValueError(
+            f"expert_dispatch {cfg.expert_dispatch!r}; expected 'table' or 'masked'"
         )
+    for layer in params["layers"]:
+        if cfg.expert_dispatch == "table":
+            msgs = _expert_messages_table(
+                layer, h, graph["edge_src"], graph["edge_type"], dtype
+            )
+        else:
+            msgs = _expert_messages_masked(
+                layer,
+                gather_src(h, graph["edge_src"], n, cfg.src_gather),
+                graph["edge_type"],
+                dtype,
+            )
         msgs = msgs + dense(layer["edge_proj"], ef)
-        agg, deg = scatter_messages(msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas)
+        agg, _ = scatter_messages(
+            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas, deg=deg
+        )
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
         h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
         h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))

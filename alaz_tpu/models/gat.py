@@ -27,6 +27,7 @@ from alaz_tpu.models.common import (
     layernorm,
     layernorm_init,
     mlp,
+    masked_degree,
     mlp_init,
     scatter_messages,
 )
@@ -75,6 +76,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     # slots 7..15 (builder.py), learned through edge_proj — no per-edge
     # embedding gather (row-op bound on TPU)
     ef = graph["edge_feats"].astype(dtype)
+    # degree is layer-invariant: one [E] scatter per forward, not per layer
+    deg = masked_degree(edge_mask, dst, n, dtype)
 
     def layer_fn(layer, h):
         # attention logit = a·[q_dst, kv_src, e_feat] re-associated into
@@ -103,7 +106,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         ).astype(dtype)  # [E, nh]
 
         msgs = ((kv_src + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
-        agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas)
+        agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas, deg=deg)
         h_new = dense(layer["out"], agg.astype(dtype))
         return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
 

@@ -27,6 +27,7 @@ from alaz_tpu.models.common import (
     layernorm,
     layernorm_init,
     mlp,
+    masked_degree,
     mlp_init,
     scatter_messages,
 )
@@ -77,6 +78,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     # so no per-edge [E]-row embedding gather is needed (row-op bound at
     # ~9ns/row on TPU — it would cost as much as the whole scatter).
     ef = graph["edge_feats"].astype(dtype)
+    # degree is layer-invariant: one [E] scatter per forward, not per layer
+    deg = masked_degree(edge_mask, graph["edge_dst"], n, dtype)
 
     def layer_fn(layer, h):
         # dense-before-gather: (h @ W)[src] == (h[src]) @ W, but the
@@ -85,8 +88,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
         msgs = gather_src(
             dense(layer["msg"], h), graph["edge_src"], n, cfg.src_gather
         ) + dense(layer["edge_proj"], ef)
-        agg, deg = scatter_messages(
-            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas
+        agg, _ = scatter_messages(
+            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas, deg=deg
         )
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
         h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))

@@ -64,6 +64,23 @@ def expand_dst(
     return v[segment_ids]
 
 
+def segment_sum_sorted_dispatch(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    use_pallas: bool | str = False,
+) -> jnp.ndarray:
+    """[E, F] → [N, F] sum over dst-SORTED segment ids, dispatched like
+    ``expand_dst``: Pallas one-hot scatter on TPU (DMA-bound, ~2× the
+    XLA scatter's row-op-bound rate — ARCHITECTURE.md §3b table),
+    interpret mode when forced, XLA ``segment_sum`` elsewhere."""
+    if (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret":
+        from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
+
+        return scatter_sum_sorted(data, segment_ids, num_segments)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
 _SRC_GATHER_MODES = ("xla", "banded", "banded-interpret")
 _banded_fallback_warned = False
 
@@ -127,7 +144,9 @@ def segment_softmax(
     exp = jnp.exp(logits - expand_dst(seg_max, segment_ids, num_segments, use_pallas))
     if mask is not None:
         exp = jnp.where(mask[:, None], exp, 0.0)
-    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    denom = segment_sum_sorted_dispatch(
+        exp, segment_ids, num_segments, use_pallas
+    )
     denom_e = expand_dst(denom, segment_ids, num_segments, use_pallas)
     out = exp / jnp.maximum(denom_e, 1e-30)
     return out[:, 0] if squeeze else out

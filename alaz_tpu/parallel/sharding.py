@@ -87,6 +87,17 @@ def stack_graphs(batches: list[GraphBatch]) -> tuple[dict, np.ndarray]:
 # ---------------------------------------------------------------------------
 
 
+def _ep_safe_cfg(cfg: ModelConfig, mesh: Mesh) -> ModelConfig:
+    """Force the masked expert dispatch when the expert axis actually
+    shards: the table form's [T, N, H] per-expert tables would all-gather
+    across 'ep' every layer (ModelConfig.expert_dispatch docs)."""
+    if mesh.shape.get("ep", 1) > 1 and cfg.expert_dispatch != "masked":
+        from dataclasses import replace
+
+        return replace(cfg, expert_dispatch="masked")
+    return cfg
+
+
 def make_sharded_train_step(
     cfg: ModelConfig,
     mesh: Mesh,
@@ -96,6 +107,7 @@ def make_sharded_train_step(
 ) -> Callable:
     """jit'd train step over a dp-sharded stack of graphs with tp-sharded
     params. Returns step(params, opt_state, stacked_graph, labels)."""
+    cfg = _ep_safe_cfg(cfg, mesh)
     _, apply = get_model(cfg.model)
     p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1), ep=mesh.shape.get("ep", 1))
     g_spec = graph_pspec(stacked=True)
@@ -136,6 +148,7 @@ def make_sharded_train_step(
 
 def make_sharded_score_step(cfg: ModelConfig, mesh: Mesh, params_example: Any) -> Callable:
     """jit'd inference over a dp-sharded stack of graphs."""
+    cfg = _ep_safe_cfg(cfg, mesh)
     _, apply = get_model(cfg.model)
     p_spec = param_pspec(params_example, tp=mesh.shape.get("tp", 1), ep=mesh.shape.get("ep", 1))
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)

@@ -85,6 +85,58 @@ class TestTgn:
         )
 
 
+class TestExpertDispatch:
+    def test_table_and_masked_forms_agree(self, small_batch):
+        """expert_dispatch='table' (dense-before-gather) and 'masked'
+        (ep-shardable Σ_t masked matmuls) are the same math — logits and
+        grads must match to float32 tolerance."""
+        from alaz_tpu.models import experts
+
+        graph = _graph(small_batch)
+        outs = {}
+        for form in ("table", "masked"):
+            cfg = ModelConfig(
+                model="experts", hidden_dim=32, use_pallas=False,
+                dtype="float32", expert_dispatch=form,
+            )
+            params = experts.init(jax.random.PRNGKey(0), cfg)
+            logits = experts.apply(params, graph, cfg)["edge_logits"]
+            grads = jax.grad(
+                lambda p: jnp.sum(experts.apply(p, graph, cfg)["edge_logits"])
+            )(params)
+            outs[form] = (np.asarray(logits), grads)
+        np.testing.assert_allclose(
+            outs["table"][0], outs["masked"][0], rtol=1e-5, atol=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(outs["table"][1]),
+            jax.tree_util.tree_leaves(outs["masked"][1]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            )
+
+    def test_out_of_range_types_get_zero_messages(self, small_batch):
+        """Both forms must zero messages for protocol codes ≥ T (the
+        masked form's implicit contract; the table form clips + masks)."""
+        from alaz_tpu.models import experts
+
+        graph = dict(_graph(small_batch))
+        # half the edges carry types outside [0, 4)
+        et = np.array(graph["edge_type"])
+        et[::2] = 7
+        graph["edge_type"] = jnp.asarray(et)
+        outs = {}
+        for form in ("table", "masked"):
+            cfg2 = ModelConfig(
+                model="experts", hidden_dim=32, use_pallas=False,
+                dtype="float32", num_edge_types=4, expert_dispatch=form,
+            )
+            params = experts.init(jax.random.PRNGKey(0), cfg2)
+            outs[form] = np.asarray(experts.apply(params, graph, cfg2)["edge_logits"])
+        np.testing.assert_allclose(outs["table"], outs["masked"], rtol=1e-5, atol=1e-5)
+
+
 class TestRegistry:
     def test_lookup(self):
         assert get_model("graphsage") == (graphsage.init, graphsage.apply)
