@@ -100,6 +100,27 @@ def _register(lib: ctypes.CDLL) -> None:
     lib.alz_close_window.argtypes = [ctypes.c_void_p, ctypes.c_uint32] + [ctypes.c_void_p] * 10
     lib.alz_export_nodes.restype = ctypes.c_uint32
     lib.alz_export_nodes.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p]
+    lib.alz_current_edge_count.restype = ctypes.c_int64
+    lib.alz_current_edge_count.argtypes = [ctypes.c_void_p]
+    lib.alz_close_window_feats.restype = ctypes.c_int32
+    lib.alz_close_window_feats.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_float,
+    ] + [ctypes.c_void_p] * 6
+    lib.alz_edge_feat_dim.restype = ctypes.c_uint32
+    lib.alz_node_feat_dim.restype = ctypes.c_uint32
+    # feature-layout contract: the C++ pass writes ef/nf rows with these
+    # strides — a drifted constant would silently misalign every feature.
+    # RuntimeError on purpose: _load's except clause swallows
+    # OSError/AttributeError (stale-.so fallback), but THIS condition must
+    # surface loudly, not degrade to the numpy path without a signal.
+    if (lib.alz_edge_feat_dim(), lib.alz_node_feat_dim()) != (
+        EDGE_FEATURE_DIM, NODE_FEATURE_DIM,
+    ):
+        raise RuntimeError(
+            "libalaz_ingest.so feature dims drifted from graph/builder.py; "
+            "rebuild with make -C alaz_tpu/native -B"
+        )
 
 
 def available() -> bool:
@@ -216,16 +237,6 @@ class NativeIngest:
         self._h = ctypes.c_void_p(
             lib.alz_create(self.window_ms, ring_capacity, max_edges, max_nodes)
         )
-        # reusable export buffers
-        self._src = np.zeros(max_edges, np.int32)
-        self._dst = np.zeros(max_edges, np.int32)
-        self._proto = np.zeros(max_edges, np.uint8)
-        self._count = np.zeros(max_edges, np.uint64)
-        self._lat_sum = np.zeros(max_edges, np.uint64)
-        self._lat_max = np.zeros(max_edges, np.uint64)
-        self._err5 = np.zeros(max_edges, np.uint32)
-        self._err4 = np.zeros(max_edges, np.uint32)
-        self._tls = np.zeros(max_edges, np.uint32)
 
     def close(self) -> None:
         if self._h:
@@ -324,99 +335,76 @@ class NativeIngest:
         return out
 
     def _close_current(self) -> GraphBatch:
+        """Close the oldest window via the C++ feature-assembly pass.
+
+        The core emits dst-sorted COO columns plus both feature matrices
+        straight into the padded numpy buffers the GraphBatch keeps, so
+        the former numpy stage (argsort + 8 bincounts + log1p features +
+        pad copies — ~120 ms per 256k-edge window) collapses to buffer
+        allocation and pad fills."""
+        from alaz_tpu.graph.snapshot import pad_to_bucket
+
+        e = int(self._lib.alz_current_edge_count(self._h))
+        if e < 0:
+            raise RuntimeError("alz_close_window called with no open window")
+        n_nodes = int(self._lib.alz_node_count(self._h))
+        e_pad = pad_to_bucket(e)
+        n_pad = pad_to_bucket(n_nodes)
+
+        es = np.zeros(e_pad, np.int32)
+        ed = np.zeros(e_pad, np.int32)
+        et = np.zeros(e_pad, np.int32)
+        cnt = np.zeros(e_pad, np.uint64)
+        ef = np.zeros((e_pad, EDGE_FEATURE_DIM), np.float32)
+        nf = np.zeros((n_pad, NODE_FEATURE_DIM), np.float32)
         ws = ctypes.c_int64(0)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
         n = int(
-            self._lib.alz_close_window(
-                self._h,
-                self.max_edges,
-                ctypes.byref(ws),
-                *(
-                    a.ctypes.data_as(ctypes.c_void_p)
-                    for a in (
-                        self._src, self._dst, self._proto, self._count,
-                        self._lat_sum, self._lat_max, self._err5, self._err4,
-                        self._tls,
-                    )
-                ),
+            self._lib.alz_close_window_feats(
+                self._h, e_pad, n_pad, ctypes.byref(ws),
+                ctypes.c_float(self.window_s),
+                ptr(es), ptr(ed), ptr(et), ptr(cnt), ptr(ef), ptr(nf),
             )
         )
         if n == -2:
             raise RuntimeError("alz_close_window called with no open window")
+        if n == -3:
+            raise RuntimeError("native node buffer too small; raise max_nodes")
         if n < 0:
             raise RuntimeError("native edge buffer overflow; raise max_edges")
 
-        n_nodes = int(self._lib.alz_node_count(self._h))
-        uids = np.zeros(n_nodes, np.int32)
-        types = np.zeros(n_nodes, np.uint8)
-        self._lib.alz_export_nodes(
-            self._h, n_nodes,
-            uids.ctypes.data_as(ctypes.c_void_p), types.ctypes.data_as(ctypes.c_void_p),
-        )
-        return self._assemble(
-            n, int(ws.value), uids, types.astype(np.int32)
-        )
-
-    def _assemble(self, n: int, window_start_ms: int, uids: np.ndarray, node_type: np.ndarray) -> GraphBatch:
-        count = self._count[:n].astype(np.float64)
-        lat_sum = self._lat_sum[:n].astype(np.float64)
-        lat_max = self._lat_max[:n].astype(np.float64)
-        err5 = self._err5[:n].astype(np.float64)
-        err4 = self._err4[:n].astype(np.float64)
-        tls = self._tls[:n].astype(np.float64)
-        src = self._src[:n].copy()
-        dst = self._dst[:n].copy()
-
-        window_s = max(self.window_s, 1e-6)
-        mean_lat = lat_sum / np.maximum(count, 1.0)
-        ef = np.zeros((n, EDGE_FEATURE_DIM), dtype=np.float32)
-        ef[:, 0] = np.log1p(count)
-        ef[:, 1] = np.log1p(mean_lat) / 20.0
-        ef[:, 2] = np.log1p(lat_max) / 20.0
-        ef[:, 3] = err5 / np.maximum(count, 1.0)
-        ef[:, 4] = err4 / np.maximum(count, 1.0)
-        ef[:, 5] = tls / np.maximum(count, 1.0)
-        ef[:, 6] = np.log1p(count / window_s)
-        # slots 7..15: protocol one-hot (matches GraphBuilder; saves a
-        # per-edge embedding gather on device)
-        proto_idx = np.clip(self._proto[:n].astype(np.int64), 0, 8)
-        ef[np.arange(n), 7 + proto_idx] = 1.0
-
-        n_nodes = uids.shape[0]
-        nf = np.zeros((n_nodes, NODE_FEATURE_DIM), dtype=np.float32)
-        for t in range(4):
-            nf[:, t] = node_type == t
-        out_cnt = np.bincount(src, weights=count, minlength=n_nodes)
-        in_cnt = np.bincount(dst, weights=count, minlength=n_nodes)
-        out_err = np.bincount(src, weights=err5, minlength=n_nodes)
-        in_err = np.bincount(dst, weights=err5, minlength=n_nodes)
-        out_lat = np.bincount(src, weights=lat_sum, minlength=n_nodes)
-        in_lat = np.bincount(dst, weights=lat_sum, minlength=n_nodes)
-        out_deg = np.bincount(src, minlength=n_nodes).astype(np.float64)
-        in_deg = np.bincount(dst, minlength=n_nodes).astype(np.float64)
-        nf[:, 4] = np.log1p(out_cnt)
-        nf[:, 5] = np.log1p(in_cnt)
-        nf[:, 6] = out_err / np.maximum(out_cnt, 1.0)
-        nf[:, 7] = in_err / np.maximum(in_cnt, 1.0)
-        nf[:, 8] = np.log1p(out_lat / np.maximum(out_cnt, 1.0)) / 20.0
-        nf[:, 9] = np.log1p(in_lat / np.maximum(in_cnt, 1.0)) / 20.0
-        nf[:, 10] = np.log1p(out_deg)
-        nf[:, 11] = np.log1p(in_deg)
+        uids = np.zeros(n_pad, np.int32)
+        types = np.zeros(n_pad, np.uint8)
+        self._lib.alz_export_nodes(self._h, n_pad, ptr(uids), ptr(types))
+        node_type = types.astype(np.int32)
+        window_start_ms = int(ws.value)
 
         if self.renumber and n > 0:
+            # the locality pass permutes node ids, which invalidates the
+            # core's dst-sort — rebuild (re-sort) through GraphBatch.build
             from alaz_tpu.graph.builder import apply_renumber, cluster_renumber
 
-            perm = cluster_renumber(src, dst, n_nodes, edge_weight=count)
-            src, dst, nf, node_type, uids = apply_renumber(
-                perm, src, dst, nf, node_type, uids
+            perm = cluster_renumber(
+                es[:n], ed[:n], n_nodes, edge_weight=cnt[:n].astype(np.float64)
+            )
+            src, dst, rnf, rnt, ruids = apply_renumber(
+                perm, es[:n], ed[:n], nf[:n_nodes], node_type[:n_nodes],
+                uids[:n_nodes],
+            )
+            return GraphBatch.build(
+                node_feats=rnf,
+                node_type=rnt,
+                edge_src=src,
+                edge_dst=dst,
+                edge_type=et[:n],
+                edge_feats=ef[:n],
+                node_uids=ruids,
+                window_start_ms=window_start_ms,
+                window_end_ms=window_start_ms + self.window_ms,
             )
 
-        return GraphBatch.build(
-            node_feats=nf,
-            node_type=node_type,
-            edge_src=src,
-            edge_dst=dst,
-            edge_type=self._proto[:n].astype(np.int32),
-            edge_feats=ef,
+        return GraphBatch.from_presorted(
+            nf, node_type, es, ed, et, ef, n_nodes, n,
             node_uids=uids,
             window_start_ms=window_start_ms,
             window_end_ms=window_start_ms + self.window_ms,

@@ -83,6 +83,58 @@ class GraphBatch:
         }
 
     @staticmethod
+    def from_presorted(
+        node_feats: np.ndarray,
+        node_type: np.ndarray,
+        edge_src: np.ndarray,
+        edge_dst: np.ndarray,
+        edge_type: np.ndarray,
+        edge_feats: np.ndarray,
+        n_nodes: int,
+        n_edges: int,
+        node_uids: Optional[np.ndarray] = None,
+        window_start_ms: int = 0,
+        window_end_ms: int = 0,
+    ) -> "GraphBatch":
+        """Wrap ALREADY dst-sorted, already PADDED arrays (the C++ core's
+        export path) into a GraphBatch. Owns the pad-slot policy so it
+        cannot diverge from ``build``: pad dsts land on the masked last
+        node slot (segment ops dump there instead of polluting node 0),
+        pad srcs repeat the last real src (a far-away pad id would blow
+        the straddling chunk's [min,max] band and cliff the banded
+        gather — ops/pallas_segment.py gather_rows_banded).
+
+        OWNERSHIP TRANSFER: the input arrays become the batch's arrays —
+        no copies — and the edge_src/edge_dst pad tails are rewritten in
+        place. Callers must hand over freshly allocated, writable
+        buffers and not reuse them afterwards (both internal callers
+        allocate per window)."""
+        e_pad = edge_src.shape[0]
+        n_pad = node_feats.shape[0]
+        edge_src[n_edges:] = edge_src[n_edges - 1] if n_edges > 0 else 0
+        edge_dst[n_edges:] = n_pad - 1
+        edge_mask = np.zeros(e_pad, dtype=bool)
+        edge_mask[:n_edges] = True
+        node_mask = np.zeros(n_pad, dtype=bool)
+        node_mask[:n_nodes] = True
+        return GraphBatch(
+            node_feats=node_feats,
+            node_type=node_type,
+            node_mask=node_mask,
+            edge_src=edge_src,
+            edge_dst=edge_dst,
+            edge_type=edge_type,
+            edge_feats=edge_feats,
+            edge_mask=edge_mask,
+            edge_label=np.zeros(e_pad, dtype=np.float32),
+            n_nodes=n_nodes,
+            n_edges=n_edges,
+            window_start_ms=window_start_ms,
+            window_end_ms=window_end_ms,
+            node_uids=node_uids,
+        )
+
+    @staticmethod
     def build(
         node_feats: np.ndarray,
         node_type: np.ndarray,
@@ -117,50 +169,29 @@ class GraphBatch:
         nf[:n] = node_feats
         nt = np.zeros(n_pad, dtype=np.int32)
         nt[:n] = node_type
-        nm = np.zeros(n_pad, dtype=bool)
-        nm[:n] = True
 
         es = np.zeros(e_pad, dtype=np.int32)
         ed = np.zeros(e_pad, dtype=np.int32)
         et = np.zeros(e_pad, dtype=np.int32)
         ef = np.zeros((e_pad, edge_feats.shape[1]), dtype=np.float32)
-        em = np.zeros(e_pad, dtype=bool)
-        el = np.zeros(e_pad, dtype=np.float32)
         es[:e] = edge_src
         ed[:e] = edge_dst
-        # padding DSTs point at the last padded node slot so segment ops
-        # dump them into a masked-out row instead of polluting node 0.
-        # Padding SRCs repeat the last real src instead: src values of
-        # masked edges are never consumed (edge_mask zeroes their
-        # messages), but a far-away pad id would blow the straddling
-        # chunk's [min,max] band to the whole table and cliff the banded
-        # gather kernel (ops/pallas_segment.py gather_rows_banded).
-        es[e:] = edge_src[-1] if e > 0 else 0
-        ed[e:] = n_pad - 1
         et[:e] = edge_type
         ef[:e] = edge_feats
-        em[:e] = True
-        if edge_label is not None:
-            el[:e] = edge_label
 
         uids = None
         if node_uids is not None:
             uids = np.zeros(n_pad, dtype=np.int32)
             uids[:n] = node_uids
 
-        return GraphBatch(
-            node_feats=nf,
-            node_type=nt,
-            node_mask=nm,
-            edge_src=es,
-            edge_dst=ed,
-            edge_type=et,
-            edge_feats=ef,
-            edge_mask=em,
-            edge_label=el,
-            n_nodes=n,
-            n_edges=e,
+        # pad-slot policy (pad dst → masked last node slot, pad src →
+        # last real src) lives in from_presorted — one place only
+        batch = GraphBatch.from_presorted(
+            nf, nt, es, ed, et, ef, n, e,
+            node_uids=uids,
             window_start_ms=window_start_ms,
             window_end_ms=window_end_ms,
-            node_uids=uids,
         )
+        if edge_label is not None:
+            batch.edge_label[:e] = edge_label
+        return batch

@@ -6,7 +6,12 @@ import jax
 import jax.numpy as jnp
 
 from alaz_tpu.config import ModelConfig
-from alaz_tpu.ops.segment import gather_scatter_sum, segment_mean  # noqa: F401
+from alaz_tpu.ops.segment import (  # noqa: F401
+    gather_scatter_sum,
+    pallas_enabled,
+    segment_mean,
+    segment_sum_sorted_dispatch,
+)
 
 
 def dense_init(key, in_dim: int, out_dim: int) -> dict:
@@ -50,6 +55,20 @@ def compute_dtype(cfg: ModelConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
+def scatter_sum(
+    msgs: jnp.ndarray,
+    edge_dst: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    num_nodes: int,
+    use_pallas: bool | str,
+) -> jnp.ndarray:
+    """Masked message scatter → sum [N,H], no degree — for aggregations
+    that don't normalize by count (GAT: attention weights already sum
+    to 1), so no [E]-row degree scatter is ever issued."""
+    m = msgs * edge_mask[:, None].astype(msgs.dtype)
+    return segment_sum_sorted_dispatch(m, edge_dst, num_nodes, use_pallas)
+
+
 def scatter_messages(
     msgs: jnp.ndarray,
     edge_dst: jnp.ndarray,
@@ -58,28 +77,22 @@ def scatter_messages(
     use_pallas: bool | str,
     deg: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Masked message scatter → (sum [N,H], degree [N]). Uses the Pallas
-    dst-sorted kernel on TPU, XLA segment_sum elsewhere. ``use_pallas``
-    may be the string ``"interpret"`` to force the Pallas path off-TPU
-    (pl.pallas_call interpret mode) — how the sharding tests exercise the
-    kernel+shard_map interaction on a CPU mesh."""
+    """Masked message scatter → (sum [N,H], degree [N]). Dispatches like
+    ``segment_sum_sorted_dispatch`` (Pallas dst-sorted kernel on TPU /
+    forced ``"interpret"``, XLA segment_sum elsewhere)."""
     mask_col = edge_mask[:, None].astype(msgs.dtype)
     m = msgs * mask_col
-    pallas = (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret"
-    if pallas:
+    if deg is None and pallas_enabled(use_pallas) and msgs.shape[1] % 128 != 0:
+        # the kernel pads features to the next 128-lane tile anyway, so
+        # the degree column rides in the pad slack for free (and the MXU
+        # accumulates the counts in f32)
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
-        if deg is None and msgs.shape[1] % 128 != 0:
-            # the kernel pads features to the next 128-lane tile anyway,
-            # so the degree column rides in the pad slack for free (and
-            # the MXU accumulates the counts in f32)
-            out = scatter_sum_sorted(
-                jnp.concatenate([m, mask_col], axis=1), edge_dst, num_nodes
-            )
-            return out[:, :-1], out[:, -1]
-        agg = scatter_sum_sorted(m, edge_dst, num_nodes)
-    else:
-        agg = jax.ops.segment_sum(m, edge_dst, num_segments=num_nodes)
+        out = scatter_sum_sorted(
+            jnp.concatenate([m, mask_col], axis=1), edge_dst, num_nodes
+        )
+        return out[:, :-1], out[:, -1]
+    agg = segment_sum_sorted_dispatch(m, edge_dst, num_nodes, use_pallas)
     if deg is None:
         # models hoist this via masked_degree (edge_dst/edge_mask are
         # layer-invariant); recomputed here only for direct callers

@@ -27,9 +27,8 @@ from alaz_tpu.models.common import (
     layernorm,
     layernorm_init,
     mlp,
-    masked_degree,
     mlp_init,
-    scatter_messages,
+    scatter_sum,
 )
 from alaz_tpu.ops.segment import expand_dst, gather_src, segment_softmax
 
@@ -76,8 +75,6 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     # slots 7..15 (builder.py), learned through edge_proj — no per-edge
     # embedding gather (row-op bound on TPU)
     ef = graph["edge_feats"].astype(dtype)
-    # degree is layer-invariant: one [E] scatter per forward, not per layer
-    deg = masked_degree(edge_mask, dst, n, dtype)
 
     def layer_fn(layer, h):
         # attention logit = a·[q_dst, kv_src, e_feat] re-associated into
@@ -105,8 +102,10 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
             logits, dst, n, mask=edge_mask, use_pallas=cfg.use_pallas
         ).astype(dtype)  # [E, nh]
 
+        # attention weights already sum to 1 per dst — no degree
+        # normalization, so no [E]-row degree scatter at all
         msgs = ((kv_src + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
-        agg, _deg = scatter_messages(msgs, dst, edge_mask, n, cfg.use_pallas, deg=deg)
+        agg = scatter_sum(msgs, dst, edge_mask, n, cfg.use_pallas)
         h_new = dense(layer["out"], agg.astype(dtype))
         return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
 

@@ -45,6 +45,15 @@ def segment_max(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) 
     return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
 
 
+def pallas_enabled(use_pallas: bool | str) -> bool:
+    """THE predicate for Pallas sorted-kernel dispatch: on when requested
+    and the backend is TPU, or when forced off-TPU with the string
+    ``"interpret"`` (pl.pallas_call interpret mode — how the sharding
+    tests exercise kernel+shard_map on a CPU mesh). One definition so a
+    new mode string cannot diverge between the dispatch sites."""
+    return (bool(use_pallas) and jax.default_backend() == "tpu") or use_pallas == "interpret"
+
+
 def expand_dst(
     v: jnp.ndarray,
     segment_ids: jnp.ndarray,
@@ -57,7 +66,7 @@ def expand_dst(
     row gather is row-op bound, ~9 ns/row on TPU): kernel on TPU,
     interpret mode when forced with ``"interpret"``, XLA gather
     elsewhere."""
-    if (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret":
+    if pallas_enabled(use_pallas):
         from alaz_tpu.ops.pallas_segment import segment_expand_sorted
 
         return segment_expand_sorted(v, segment_ids, num_segments)
@@ -74,7 +83,7 @@ def segment_sum_sorted_dispatch(
     ``expand_dst``: Pallas one-hot scatter on TPU (DMA-bound, ~2× the
     XLA scatter's row-op-bound rate — ARCHITECTURE.md §3b table),
     interpret mode when forced, XLA ``segment_sum`` elsewhere."""
-    if (use_pallas and jax.default_backend() == "tpu") or use_pallas == "interpret":
+    if pallas_enabled(use_pallas):
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
         return scatter_sum_sorted(data, segment_ids, num_segments)
@@ -148,7 +157,17 @@ def segment_softmax(
         exp, segment_ids, num_segments, use_pallas
     )
     denom_e = expand_dst(denom, segment_ids, num_segments, use_pallas)
-    out = exp / jnp.maximum(denom_e, 1e-30)
+    # double-where guard: an all-masked segment (the pad tail) has
+    # denom 0, and a bare eps-clamped division NaNs in the BACKWARD
+    # (d(x/y)/dy = -x/y² with y²=1e-60 → f32 underflow → 0/0). XLA's
+    # gather-VJP confines that NaN to the masked pad row, but the
+    # one-hot-matmul kernel VJPs spread any NaN row across the whole
+    # chunk (0·NaN=NaN in the MXU sum) — so make the division itself
+    # safe instead of relying on masking downstream.
+    nonempty = denom_e > 0.0
+    out = jnp.where(
+        nonempty, exp / jnp.where(nonempty, denom_e, 1.0), 0.0
+    )
     return out[:, 0] if squeeze else out
 
 

@@ -31,6 +31,7 @@ from alaz_tpu.models.common import (
     compute_dtype,
     dense,
     layernorm,
+    masked_degree,
     mlp,
     scatter_messages,
 )
@@ -111,6 +112,10 @@ def make_node_sharded_graphsage(
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))
         h = h * node_mask[:, None]
 
+        # degree is layer-invariant: one [E] scatter per forward (the
+        # same hoist the single-device models carry)
+        deg = masked_degree(edge_mask, dst_local, n_loc, jnp.float32)
+
         for layer in params["layers"]:
             # remote part: Σ_{dst local} (h W_msg)[src] via the ring
             hw = dense(layer["msg"], h)
@@ -122,9 +127,10 @@ def make_node_sharded_graphsage(
             # (edges are 128-padded by construction; node blocks need the
             # kernel's TILE_N alignment)
             ef_msgs = dense(layer["edge_proj"], ef).astype(jnp.float32)
-            ef_agg, deg = scatter_messages(
+            ef_agg, _ = scatter_messages(
                 ef_msgs, dst_local, edge_mask, n_loc,
                 cfg.use_pallas if n_loc % 128 == 0 else False,
+                deg=deg,
             )
             agg = (ring_agg + ef_agg) / jnp.maximum(deg, 1.0)[:, None]
             h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))

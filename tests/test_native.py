@@ -33,6 +33,10 @@ def _edge_map(b):
     }
 
 
+def _node_map(b):
+    return {int(b.node_uids[i]): b.node_feats[i] for i in range(b.n_nodes)}
+
+
 class TestNativeIngest:
     def test_record_layout_is_32_bytes(self):
         assert native.NATIVE_RECORD_DTYPE.itemsize == 32
@@ -49,6 +53,13 @@ class TestNativeIngest:
         assert set(m1) == set(m2)
         for k in m1:
             np.testing.assert_allclose(m1[k], m2[k], atol=1e-6)
+        # node features too — the 12 nf columns are computed by the C++
+        # close pass (ingest.cc alz_close_window_feats), not numpy; a
+        # drifted formula there must fail THIS comparison
+        n1, n2 = _node_map(batch), _node_map(ref)
+        assert set(n1) == set(n2)
+        for k in n1:
+            np.testing.assert_allclose(n1[k], n2[k], atol=1e-6)
         ni.close()
 
     def test_window_roll_and_late_drop(self):

@@ -49,6 +49,28 @@ class TestXlaSegment:
         np.testing.assert_allclose(np.asarray(sums)[present], 1.0, rtol=1e-5)
         assert float(alpha[0]) == 0.0  # masked edge gets zero weight
 
+    @pytest.mark.parametrize("up", [False, "interpret"])
+    def test_segment_softmax_empty_segment_grads_finite(self, up):
+        """A segment whose edges are ALL masked (the pad tail every
+        GraphBatch carries: dst=n_pad-1, mask 0) has softmax denom 0.
+        The backward of an eps-clamped division NaNs there (x/y² with
+        y²=1e-60 underflowing f32), and the one-hot-matmul kernel VJPs
+        then spread that NaN row across the whole chunk — this was a
+        real GAT-on-TPU training bug, invisible to forward-only tests."""
+        n, e = 128, 512  # kernel tile minima: e % TILE_E, n % 128
+        rng = np.random.default_rng(3)
+        dst = np.sort(rng.integers(0, 32, e - 64)).astype(np.int32)
+        dst = np.concatenate([dst, np.full(64, n - 1, np.int32)])  # pad tail
+        mask = jnp.asarray(np.arange(e) < e - 64)
+        logits0 = jnp.asarray(rng.normal(size=(e, 4)).astype(np.float32))
+
+        def loss(l):
+            a = segment_softmax(l, jnp.asarray(dst), n, mask=mask, use_pallas=up)
+            return (a * mask[:, None]).sum()
+
+        g = jax.grad(loss)(logits0)
+        assert bool(jnp.isfinite(g).all()), "NaN leaked out of the empty pad segment"
+
 
 class TestPallasScatter:
     def test_matches_xla_interpret(self, coo):
