@@ -55,20 +55,6 @@ def compute_dtype(cfg: ModelConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
-def scatter_sum(
-    msgs: jnp.ndarray,
-    edge_dst: jnp.ndarray,
-    edge_mask: jnp.ndarray,
-    num_nodes: int,
-    use_pallas: bool | str,
-) -> jnp.ndarray:
-    """Masked message scatter → sum [N,H], no degree — for aggregations
-    that don't normalize by count (GAT: attention weights already sum
-    to 1), so no [E]-row degree scatter is ever issued."""
-    m = msgs * edge_mask[:, None].astype(msgs.dtype)
-    return segment_sum_sorted_dispatch(m, edge_dst, num_nodes, use_pallas)
-
-
 def scatter_messages(
     msgs: jnp.ndarray,
     edge_dst: jnp.ndarray,

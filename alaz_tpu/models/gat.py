@@ -6,8 +6,10 @@ conditioned on source, destination, and edge features — which carry the
 protocol one-hot in slots 7..15 (the reference's per-protocol handler
 dispatch, SURVEY §2.3 P5, re-expressed as typed attention; the one-hot
 is folded into edge_feats at build time so no per-edge embedding gather
-runs on device). Per-destination normalization uses masked segment
-softmax with the sorted-expand kernel for its broadcasts.
+runs on device). Per-destination normalization is a fused
+softmax-aggregate: exp-weighted messages and the exp column share one
+segment sum, normalized per node (see layer_fn) — two row-op passes per
+layer instead of six.
 """
 
 from __future__ import annotations
@@ -28,9 +30,18 @@ from alaz_tpu.models.common import (
     layernorm_init,
     mlp,
     mlp_init,
-    scatter_sum,
 )
-from alaz_tpu.ops.segment import expand_dst, gather_src, segment_softmax
+from alaz_tpu.ops.segment import (
+    expand_dst,
+    gather_src,
+    segment_sum_accurate,
+)
+
+# attention-logit clamp replacing per-segment max subtraction (see
+# layer_fn): softmax(clip(x)) == softmax(x) whenever |x| <= the clamp,
+# and exp(30) ~ 1e13 keeps f32 segment sums far from overflow even at
+# million-edge fan-in
+_LOGIT_CLAMP = 30.0
 
 Params = Dict[str, Any]
 
@@ -98,14 +109,38 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
             expand_dst(q_part, dst, n, cfg.use_pallas) + k_src + e_part
         ).astype(jnp.float32)
         logits = jax.nn.leaky_relu(logits, 0.2)
-        alpha = segment_softmax(
-            logits, dst, n, mask=edge_mask, use_pallas=cfg.use_pallas
-        ).astype(dtype)  # [E, nh]
 
-        # attention weights already sum to 1 per dst — no degree
-        # normalization, so no [E]-row degree scatter at all
-        msgs = ((kv_src + e_feat) * alpha[:, :, None]).reshape(-1, nh * hd)
-        agg = scatter_sum(msgs, dst, edge_mask, n, cfg.use_pallas)
+        # fused softmax-aggregate: scatter exp-weighted messages and the
+        # exp column in ONE segment sum, normalize per NODE —
+        # Σe^x·m/Σe^x ≡ Σsoftmax(x)·m, but the explicit-alpha form costs
+        # a denominator scatter plus an [E]-row denominator broadcast
+        # that the fusion deletes. The usual per-segment max subtraction
+        # (another [E] scatter-max + [E] broadcast) is replaced by a
+        # fixed ±30 clamp: exp(±30) is exact and overflow-free in the
+        # f32 accumulators, and attention logits past ±30 only saturate
+        # (post-leaky-relu magnitudes are O(1-10) in practice). Net: 6
+        # row-op passes per layer → 2 (the src gather + this scatter).
+        logits = jnp.clip(logits, -_LOGIT_CLAMP, _LOGIT_CLAMP)
+        w = jnp.where(edge_mask[:, None], jnp.exp(logits), 0.0)  # [E, nh]
+        msgs = ((kv_src + e_feat) * w[:, :, None].astype(dtype)).reshape(
+            -1, nh * hd
+        )
+        # segment_sum_accurate: the denominator column must accumulate
+        # in f32 (a bf16 running sum stagnates at hub fan-in ~256); the
+        # kernel path still DMAs bf16 and accumulates f32 on the MXU
+        fused = jnp.concatenate([msgs, w.astype(msgs.dtype)], axis=1)
+        agg_all = segment_sum_accurate(fused, dst, n, cfg.use_pallas)
+        num = agg_all[:, : nh * hd].reshape(n, nh, hd)
+        denom = agg_all[:, nh * hd :]  # [N, nh]
+        # double-where: nodes with no unmasked in-edges (pad slot, loners)
+        # have denom 0 — guard the division so its backward cannot NaN
+        # (ops/segment.py segment_softmax has the full story)
+        nonempty = denom > 0.0
+        agg = jnp.where(
+            nonempty[:, :, None],
+            num / jnp.where(nonempty, denom, 1.0)[:, :, None],
+            0.0,
+        ).reshape(n, nh * hd)
         h_new = dense(layer["out"], agg.astype(dtype))
         return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
 

@@ -90,6 +90,28 @@ def segment_sum_sorted_dispatch(
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 
+def segment_sum_accurate(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    use_pallas: bool | str = False,
+) -> jnp.ndarray:
+    """``segment_sum_sorted_dispatch`` with guaranteed f32 ACCUMULATION,
+    returning f32. The Pallas kernel already accumulates f32 on the MXU
+    whatever the input dtype (bf16 input just halves the DMA bytes — its
+    out_shape is f32); XLA's segment_sum accumulates AT the input dtype,
+    and a bf16 running sum stagnates once increments fall below 2^-8 of
+    the partial (fan-in ~256: 2048 bf16 ones sum to 256) — so the
+    fallback path upcasts first. Use this wherever the sum feeds a
+    normalization (softmax denominators); plain feature scatters can
+    tolerate the cheaper dispatch."""
+    if not pallas_enabled(use_pallas):
+        data = data.astype(jnp.float32)
+    return segment_sum_sorted_dispatch(
+        data, segment_ids, num_segments, use_pallas
+    ).astype(jnp.float32)
+
+
 _SRC_GATHER_MODES = ("xla", "banded", "banded-interpret")
 _banded_fallback_warned = False
 

@@ -145,6 +145,7 @@ class TestRegistry:
             get_model("transformer")
 
 
+
 class TestRemat:
     @pytest.mark.parametrize("name", ["graphsage", "gat"])
     def test_remat_matches_plain_forward_and_grads(self, name, small_batch):

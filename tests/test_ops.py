@@ -11,6 +11,7 @@ from alaz_tpu.ops.segment import (
     segment_mean,
     segment_softmax,
     segment_sum,
+    segment_sum_accurate,
 )
 
 
@@ -70,6 +71,23 @@ class TestXlaSegment:
 
         g = jax.grad(loss)(logits0)
         assert bool(jnp.isfinite(g).all()), "NaN leaked out of the empty pad segment"
+
+    @pytest.mark.parametrize("up", [False, "interpret"])
+    def test_segment_sum_accurate_hub_fanin_bf16(self, up):
+        """GAT's fused softmax denominator scatters bf16 exp weights; a
+        bf16 RUNNING SUM stagnates once increments fall below 2^-8 of
+        the partial — 2048 bf16 ones segment_sum to 256, an 8x-deflated
+        denominator at hub nodes. segment_sum_accurate guarantees f32
+        accumulation on both dispatch paths."""
+        e, n = 2048, 128
+        ones = jnp.ones((e, 128), jnp.bfloat16)
+        ids = jnp.zeros(e, jnp.int32)
+        # the raw primitive really does stagnate — the premise, not ours
+        raw = jax.ops.segment_sum(ones[:, 0], ids, num_segments=n)
+        assert float(raw[0]) == 256.0
+        out = segment_sum_accurate(ones, ids, n, use_pallas=up)
+        assert out.dtype == jnp.float32
+        assert float(out[0, 0]) == float(e), f"stagnated: {float(out[0, 0])}"
 
 
 class TestPallasScatter:
