@@ -32,16 +32,11 @@ from alaz_tpu.models.common import (
     mlp_init,
 )
 from alaz_tpu.ops.segment import (
+    ATTENTION_LOGIT_CLAMP,
     expand_dst,
     gather_src,
     segment_sum_accurate,
 )
-
-# attention-logit clamp replacing per-segment max subtraction (see
-# layer_fn): softmax(clip(x)) == softmax(x) whenever |x| <= the clamp,
-# and exp(30) ~ 1e13 keeps f32 segment sums far from overflow even at
-# million-edge fan-in
-_LOGIT_CLAMP = 30.0
 
 Params = Dict[str, Any]
 
@@ -120,7 +115,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         # f32 accumulators, and attention logits past ±30 only saturate
         # (post-leaky-relu magnitudes are O(1-10) in practice). Net: 6
         # row-op passes per layer → 2 (the src gather + this scatter).
-        logits = jnp.clip(logits, -_LOGIT_CLAMP, _LOGIT_CLAMP)
+        logits = jnp.clip(logits, -ATTENTION_LOGIT_CLAMP, ATTENTION_LOGIT_CLAMP)
         w = jnp.where(edge_mask[:, None], jnp.exp(logits), 0.0)  # [E, nh]
         msgs = ((kv_src + e_feat) * w[:, :, None].astype(dtype)).reshape(
             -1, nh * hd

@@ -90,6 +90,15 @@ def segment_sum_sorted_dispatch(
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 
+# THE attention-logit clamp for the fused softmax-aggregate (models/gat.py
+# layer_fn and parallel/halo.py ring_attention_aggregate share it):
+# softmax(clip(x)) == softmax(x) whenever |x| <= the clamp, and exp(30)
+# ~ 1e13 keeps f32 segment sums far from overflow at million-edge fan-in.
+# One definition so the single-device and ring implementations of the
+# same math cannot drift.
+ATTENTION_LOGIT_CLAMP = 30.0
+
+
 def segment_sum_accurate(
     data: jnp.ndarray,
     segment_ids: jnp.ndarray,

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from alaz_tpu.ops.segment import ATTENTION_LOGIT_CLAMP
 from alaz_tpu.parallel.collectives import ring_shift
 
 
@@ -94,6 +95,90 @@ def ring_gather_edges(
     out0 = h_local[src_local] * jnp.zeros((), h_local.dtype)
     out, _ = jax.lax.fori_loop(0, d, body, (out0, h_local))
     return out
+
+
+def ring_attention_aggregate(
+    q_part: jnp.ndarray,  # [n_loc, nh] dst-side logit partials (local)
+    kv_local: jnp.ndarray,  # [n_loc, nh*hd] kv projections (the rotating block)
+    e_part: jnp.ndarray,  # [e_loc, nh] edge-feature logit partials (local edges)
+    e_feat: jnp.ndarray,  # [e_loc, nh, hd] edge-feature messages (local edges)
+    a_k: jnp.ndarray,  # [nh, hd] src-side attention vector
+    edge_src: jnp.ndarray,  # [e_loc] GLOBAL src ids of local-dst edges
+    edge_dst_local: jnp.ndarray,  # [e_loc] LOCAL dst ids
+    edge_mask: jnp.ndarray,  # [e_loc]
+    axis: str = "sp",
+    logit_clamp: float = ATTENTION_LOGIT_CLAMP,
+) -> jnp.ndarray:
+    """**Ring attention for graphs**: the fused GAT softmax-aggregate
+    (models/gat.py layer_fn) over a node-sharded graph. Per ring hop this
+    device holds one remote kv block and folds in the edges whose src
+    lives there: logits = leaky_relu(q_part[dst] + a_k·kv[src] + e_part)
+    clamped to ±logit_clamp, then the exp-weighted messages AND the exp
+    column accumulate in one segment sum; the per-node division happens
+    once after the ring. The fixed clamp is what removes classic ring
+    attention's running-max recurrence — every hop's exp is already safe
+    in f32, so numerator/denominator are plain ring-accumulated sums
+    (SURVEY §2.3 P4; the blockwise-normalizer trick of blockwise/ring
+    attention, degenerate because the max is a compile-time constant).
+
+    Must run inside shard_map over ``axis``. Returns [n_loc, nh*hd]
+    normalized attention aggregates for the local nodes.
+    """
+    n_loc = kv_local.shape[0]
+    nh, hd = a_k.shape
+    out_dtype = kv_local.dtype
+    d = jax.lax.axis_size(axis)
+    my_idx = jax.lax.axis_index(axis)
+
+    src_owner = edge_src // n_loc
+    src_local = edge_src % n_loc
+    # dst side is shard-local: one local gather, hoisted out of the ring.
+    # Logits and both ring accumulators run f32 regardless of input
+    # dtype — the same f32-denominator rule as segment_sum_accurate: a
+    # bf16 running sum stagnates at hub fan-in ~256, and here the sum
+    # also spans D hops.
+    q_e = q_part[edge_dst_local].astype(jnp.float32)  # [e_loc, nh]
+    e_part32 = e_part.astype(jnp.float32)
+    a_k32 = a_k.astype(jnp.float32)
+
+    def body(k, carry):
+        acc, blk = carry
+        owner = jax.lax.rem(my_idx - k + d, d)
+        sel = (src_owner == owner) & edge_mask
+        kv_src = blk[src_local].reshape(-1, nh, hd)
+        k_src = jnp.einsum(
+            "ehd,hd->eh", kv_src.astype(jnp.float32), a_k32
+        )
+        logits = jax.nn.leaky_relu(q_e + k_src + e_part32, 0.2)
+        logits = jnp.clip(logits, -logit_clamp, logit_clamp)
+        w = jnp.where(sel[:, None], jnp.exp(logits), 0.0)  # [e_loc, nh] f32
+        msgs = (
+            (kv_src + e_feat).astype(jnp.float32) * w[:, :, None]
+        ).reshape(-1, nh * hd)
+        fused = jnp.concatenate([msgs, w], axis=1)
+        acc = acc + jax.ops.segment_sum(fused, edge_dst_local, num_segments=n_loc)
+        blk = ring_shift(blk, axis, shift=1)
+        return acc, blk
+
+    # derive the zero init from the sharded inputs so its varying-axes
+    # annotation matches the loop body's output under shard_map (same
+    # trick as ring_gather_edges)
+    acc0 = jnp.concatenate([kv_local, q_part], axis=1).astype(
+        jnp.float32
+    ) * jnp.zeros((), jnp.float32)
+    acc, _ = jax.lax.fori_loop(0, d, body, (acc0, kv_local))
+    num = acc[:, : nh * hd].reshape(n_loc, nh, hd)
+    den = acc[:, nh * hd :]  # [n_loc, nh]
+    nonempty = den > 0.0
+    return (
+        jnp.where(
+            nonempty[:, :, None],
+            num / jnp.where(nonempty, den, 1.0)[:, :, None],
+            0.0,
+        )
+        .reshape(n_loc, nh * hd)
+        .astype(out_dtype)
+    )
 
 
 def partition_edges_by_dst(
