@@ -1,5 +1,6 @@
-"""Node-sharded GraphSAGE forward — the config-5 serving path
-(BASELINE.json: 100k-pod multi-cluster graphs sharded across a slice).
+"""Node-sharded model forwards (GraphSAGE + GAT) — the config-5 serving
+path (BASELINE.json: 100k-pod multi-cluster graphs sharded across a
+slice).
 
 For graphs too big for one chip, the node axis is partitioned over the
 ``sp`` mesh axis and the whole forward runs inside one shard_map:
@@ -37,6 +38,7 @@ from alaz_tpu.models.common import (
 )
 from alaz_tpu.parallel.halo import (
     partition_edges_by_dst,
+    ring_attention_aggregate,
     ring_gather_edges,
     ring_gather_scatter,
 )
@@ -82,6 +84,30 @@ def shard_graph_batch(batch: GraphBatch, n_shards: int) -> tuple[dict, np.ndarra
         out["edge_mask"][s, :k] = True
         perm[s, :k] = idx
     return out, perm
+
+
+def _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis):
+    """The split edge head + node head over one node shard (shared by
+    both node-sharded forwards so the serving paths cannot drift):
+    models/common.edge_head's re-association, with the remote src states
+    arriving via the per-edge ring gather."""
+    w1 = params["edge_head"][0]["w"].astype(dtype)
+    hdim = h.shape[-1]
+    u = h @ w1[:hdim]
+    v = h @ w1[hdim : 2 * hdim]
+    u_e = ring_gather_edges(u.astype(jnp.float32), src, edge_mask, axis=axis)
+    z = (
+        u_e.astype(dtype)
+        + v[dst_local]
+        + ef @ w1[2 * hdim :]
+        + params["edge_head"][0]["b"].astype(dtype)
+    )
+    edge_logits = mlp(params["edge_head"][1:], jax.nn.gelu(z))[:, 0]
+    node_logits = mlp(params["node_head"], h)[:, 0]
+    return (
+        edge_logits.astype(jnp.float32)[None],
+        node_logits.astype(jnp.float32)[None],
+    )
 
 
 def make_node_sharded_graphsage(
@@ -137,24 +163,61 @@ def make_node_sharded_graphsage(
             h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
             h = (h + h_new) * node_mask[:, None]
 
-        # split edge head (models/common.edge_head), ring for remote src
-        w1 = params["edge_head"][0]["w"].astype(dtype)
-        hdim = h.shape[-1]
-        u = h @ w1[:hdim]
-        v = h @ w1[hdim : 2 * hdim]
-        u_e = ring_gather_edges(u.astype(jnp.float32), src, edge_mask, axis=axis)
-        z = (
-            u_e.astype(dtype)
-            + v[dst_local]
-            + ef @ w1[2 * hdim :]
-            + params["edge_head"][0]["b"].astype(dtype)
-        )
-        edge_logits = mlp(params["edge_head"][1:], jax.nn.gelu(z))[:, 0]
-        node_logits = mlp(params["node_head"], h)[:, 0]
-        return (
-            edge_logits.astype(jnp.float32)[None],
-            node_logits.astype(jnp.float32)[None],
-        )
+        return _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis)
+
+    return jax.jit(run)
+
+
+def make_node_sharded_gat(
+    cfg: ModelConfig, mesh: Mesh, axis: str = "sp"
+) -> Callable:
+    """jit'd node-sharded GAT forward (BASELINE config 3 at fleet
+    scale): same signature as ``make_node_sharded_graphsage``. Attention
+    crosses shards via ``halo.ring_attention_aggregate`` — the fused
+    softmax-aggregate accumulates numerator and denominator over the
+    ring hops, so cross-shard normalization needs no extra collective
+    beyond the same D ppermutes the sum aggregation pays. Numerically
+    equivalent to the single-device ``gat.apply`` (same params);
+    validated edge-for-edge in tests/test_parallel.py."""
+    nh = cfg.num_heads
+    hd = cfg.hidden_dim // nh
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), {k: P(axis) for k in (
+            "node_feats", "node_type", "node_mask", "edge_src",
+            "edge_dst_local", "edge_type", "edge_feats", "edge_mask",
+        )}),
+        out_specs=(P(axis), P(axis)),
+    )
+    def run(params, g):
+        dtype = compute_dtype(cfg)
+        node_mask = g["node_mask"][0].astype(dtype)
+        edge_mask = g["edge_mask"][0]
+        src, dst_local = g["edge_src"][0], g["edge_dst_local"][0]
+        ef = g["edge_feats"][0].astype(dtype)
+        n_loc = g["node_feats"].shape[1]
+
+        h = dense(params["embed"], g["node_feats"][0].astype(dtype))
+        h = h * node_mask[:, None]
+
+        for layer in params["layers"]:
+            attn = layer["attn"].astype(dtype)  # [nh, 3hd]
+            a_q, a_k, a_e = attn[:, :hd], attn[:, hd : 2 * hd], attn[:, 2 * hd :]
+            q = dense(layer["q"], h).reshape(n_loc, nh, hd)
+            kv = dense(layer["kv"], h)  # [n_loc, nh*hd] — the ring block
+            e_feat = dense(layer["edge_proj"], ef).reshape(-1, nh, hd)
+            q_part = jnp.einsum("nhd,hd->nh", q, a_q)  # [n_loc, nh]
+            e_part = jnp.einsum("ehd,hd->eh", e_feat, a_e)  # [e_loc, nh]
+            agg = ring_attention_aggregate(
+                q_part, kv, e_part, e_feat, a_k,
+                src, dst_local, edge_mask, axis=axis,
+            )
+            h_new = dense(layer["out"], agg.astype(dtype))
+            h = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+
+        return _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis)
 
     return jax.jit(run)
 

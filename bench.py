@@ -212,10 +212,11 @@ def bench_e2e(args) -> dict:
     def run_once() -> int:
         ni = native.NativeIngest(window_s=1.0, ring_capacity=1 << 21)
         scored = 0
-        last = None  # single-device execution is in-order: blocking on
-        chunk = 1 << 16  # the LAST output proves all windows completed,
-        # with O(1) retention (keeping every handle would hold all score
-        # arrays in HBM at once)
+        # single-device execution is in-order, so blocking on the LAST
+        # output proves all windows completed — with O(1) retention
+        # (keeping every handle would hold all score arrays in HBM)
+        last = None
+        chunk = 1 << 16
         for i in range(0, n_rows, chunk):
             ni.push(rows[i : i + chunk])
             while True:

@@ -115,18 +115,16 @@ class TestRingAttention:
             @partial(
                 jax.shard_map,
                 mesh=mesh,
-                in_specs=(P("sp"),) * 6,
+                in_specs=(P("sp"),) * 7,
                 out_specs=P("sp"),
             )
-            def run(qp, kvb, ep, ef, s_, dl_mask):
-                dl, m = dl_mask[..., 0], dl_mask[..., 1].astype(bool)
+            def run(qp, kvb, ep, ef, s_, dl, m):
                 out = ring_attention_aggregate(
                     qp[0], kvb[0], ep[0], ef[0], jnp.asarray(a_k),
                     s_[0], dl[0], m[0], axis="sp",
                 )
                 return out[None]
 
-            dl_mask = np.stack([dstl, mask.astype(np.int32)], axis=-1)
             out = np.asarray(
                 jax.jit(run)(
                     jnp.asarray(q_part.reshape(sp, n_loc, nh)),
@@ -134,7 +132,8 @@ class TestRingAttention:
                     jnp.asarray(ep_s),
                     jnp.asarray(ef_s),
                     jnp.asarray(srcs),
-                    jnp.asarray(dl_mask),
+                    jnp.asarray(dstl),
+                    jnp.asarray(mask),
                 )
             ).reshape(n, f)
             np.testing.assert_allclose(out, ref, atol=2e-4)
@@ -150,7 +149,8 @@ class TestRingAttention:
                     jnp.asarray(ep_s, jnp.bfloat16),
                     jnp.asarray(ef_s, jnp.bfloat16),
                     jnp.asarray(srcs),
-                    jnp.asarray(dl_mask),
+                    jnp.asarray(dstl),
+                    jnp.asarray(mask),
                 ).astype(jnp.float32)
             ).reshape(n, f)
             np.testing.assert_allclose(out_bf, ref, atol=0.15, rtol=0.1)
