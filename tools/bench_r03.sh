@@ -16,7 +16,7 @@ run() { # run <label> <args...>
   local label="$1"; shift
   echo "== $label: python bench.py $*" >&2
   local line
-  line=$(python bench.py "$@" 2>/tmp/bench_r03_err.log | tail -1)
+  line=$(python bench.py --direct "$@" 2>/tmp/bench_r03_err.log | tail -1)
   rc=$?
   if [ -n "$line" ]; then
     echo "$line" >> "$OUT"
