@@ -115,6 +115,15 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         # f32 accumulators, and attention logits past ±30 only saturate
         # (post-leaky-relu magnitudes are O(1-10) in practice). Net: 6
         # row-op passes per layer → 2 (the src gather + this scatter).
+        # saturation gauge: fraction of live logits at/past the clamp.
+        # The O(1-10) magnitude assumption above is otherwise unchecked —
+        # if training drifts logits past ±30 the softmax silently
+        # flattens; this scalar makes that drift observable
+        # (runtime/metrics.py model.attn_clamp_saturation).
+        hit = (jnp.abs(logits) >= ATTENTION_LOGIT_CLAMP) & edge_mask[:, None]
+        sat = jnp.sum(hit.astype(jnp.float32)) / jnp.maximum(
+            jnp.sum(edge_mask.astype(jnp.float32)) * nh, 1.0
+        )
         logits = jnp.clip(logits, -ATTENTION_LOGIT_CLAMP, ATTENTION_LOGIT_CLAMP)
         w = jnp.where(edge_mask[:, None], jnp.exp(logits), 0.0)  # [E, nh]
         msgs = ((kv_src + e_feat) * w[:, :, None].astype(dtype)).reshape(
@@ -137,12 +146,15 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
             0.0,
         ).reshape(n, nh * hd)
         h_new = dense(layer["out"], agg.astype(dtype))
-        return (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+        h_out = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+        return h_out, sat
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
+    sats = []
     for layer in params["layers"]:
-        h = layer_fn(layer, h)
+        h, sat = layer_fn(layer, h)
+        sats.append(sat)
 
     edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]
@@ -150,4 +162,5 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         "node_h": h,
         "edge_logits": edge_logits.astype(jnp.float32),
         "node_logits": node_logits.astype(jnp.float32),
+        "attn_clamp_saturation": jnp.stack(sats).max(),
     }

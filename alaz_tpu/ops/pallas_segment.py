@@ -161,16 +161,20 @@ def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, in
     )(row_start, msgs, dst2d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def scatter_sum_sorted(msgs, edge_dst, num_nodes):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def scatter_sum_sorted(msgs, edge_dst, num_nodes, out_dtype=None):
     """out[d] = Σ_{e: dst[e]=d} msgs[e] for arbitrary per-edge messages
-    (models add edge features/type embeddings before scattering)."""
-    return _scatter_fwd_impl(msgs, edge_dst, num_nodes)
+    (models add edge features/type embeddings before scattering).
+    ``out_dtype=None`` returns the input dtype (one rounding of the f32
+    MXU accumulator for bf16 inputs); pass ``jnp.float32`` where the sum
+    feeds a normalization and that rounding matters
+    (``segment_sum_accurate``)."""
+    return _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype)
 
 
-def _scatter_fwd_impl(msgs, edge_dst, num_nodes):
-    dtype = msgs.dtype
-    if dtype not in (jnp.float32, jnp.bfloat16):
+def _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype=None):
+    dtype = msgs.dtype if out_dtype is None else jnp.dtype(out_dtype)
+    if msgs.dtype not in (jnp.float32, jnp.bfloat16):
         msgs = msgs.astype(jnp.float32)
     f = msgs.shape[1]
     f_pad = ((f + 127) // 128) * 128
@@ -181,13 +185,17 @@ def _scatter_fwd_impl(msgs, edge_dst, num_nodes):
     return out[:, :f].astype(dtype)
 
 
-def _scatter_vjp_fwd(msgs, edge_dst, num_nodes):
-    return _scatter_fwd_impl(msgs, edge_dst, num_nodes), (edge_dst,)
+def _scatter_vjp_fwd(msgs, edge_dst, num_nodes, out_dtype):
+    # residuals must be jax types: carry the input dtype as a 0-size token
+    return (
+        _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype),
+        (edge_dst, jnp.zeros((0,), msgs.dtype)),
+    )
 
 
-def _scatter_vjp_bwd(num_nodes, residuals, g):
-    (edge_dst,) = residuals
-    return (g[edge_dst], None)
+def _scatter_vjp_bwd(num_nodes, out_dtype, residuals, g):
+    edge_dst, dtype_token = residuals
+    return (g[edge_dst].astype(dtype_token.dtype), None)
 
 
 scatter_sum_sorted.defvjp(_scatter_vjp_fwd, _scatter_vjp_bwd)

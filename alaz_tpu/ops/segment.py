@@ -78,16 +78,20 @@ def segment_sum_sorted_dispatch(
     segment_ids: jnp.ndarray,
     num_segments: int,
     use_pallas: bool | str = False,
+    out_dtype=None,
 ) -> jnp.ndarray:
     """[E, F] → [N, F] sum over dst-SORTED segment ids, dispatched like
     ``expand_dst``: Pallas one-hot scatter on TPU (DMA-bound, ~2× the
     XLA scatter's row-op-bound rate — ARCHITECTURE.md §3b table),
-    interpret mode when forced, XLA ``segment_sum`` elsewhere."""
+    interpret mode when forced, XLA ``segment_sum`` elsewhere.
+    ``out_dtype`` requests the kernel path emit that dtype straight from
+    its f32 accumulator (no input-dtype rounding); the XLA path casts."""
     if pallas_enabled(use_pallas):
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
-        return scatter_sum_sorted(data, segment_ids, num_segments)
-    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+        return scatter_sum_sorted(data, segment_ids, num_segments, out_dtype)
+    out = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    return out if out_dtype is None else out.astype(out_dtype)
 
 
 # THE attention-logit clamp for the fused softmax-aggregate (models/gat.py
@@ -105,20 +109,21 @@ def segment_sum_accurate(
     num_segments: int,
     use_pallas: bool | str = False,
 ) -> jnp.ndarray:
-    """``segment_sum_sorted_dispatch`` with guaranteed f32 ACCUMULATION,
-    returning f32. The Pallas kernel already accumulates f32 on the MXU
-    whatever the input dtype (bf16 input just halves the DMA bytes — its
-    out_shape is f32); XLA's segment_sum accumulates AT the input dtype,
-    and a bf16 running sum stagnates once increments fall below 2^-8 of
-    the partial (fan-in ~256: 2048 bf16 ones sum to 256) — so the
-    fallback path upcasts first. Use this wherever the sum feeds a
-    normalization (softmax denominators); plain feature scatters can
-    tolerate the cheaper dispatch."""
+    """``segment_sum_sorted_dispatch`` with guaranteed f32 ACCUMULATION
+    and a LOSSLESS f32 result. The Pallas kernel accumulates f32 on the
+    MXU whatever the input dtype (bf16 input just halves the DMA bytes)
+    and ``out_dtype=f32`` makes it emit the accumulator directly — no
+    input-dtype rounding on the way out. XLA's segment_sum accumulates
+    AT the input dtype, and a bf16 running sum stagnates once increments
+    fall below 2^-8 of the partial (fan-in ~256: 2048 bf16 ones sum to
+    256) — so the fallback path upcasts first. Use this wherever the sum
+    feeds a normalization (softmax denominators); plain feature scatters
+    can tolerate the cheaper dispatch."""
     if not pallas_enabled(use_pallas):
         data = data.astype(jnp.float32)
     return segment_sum_sorted_dispatch(
-        data, segment_ids, num_segments, use_pallas
-    ).astype(jnp.float32)
+        data, segment_ids, num_segments, use_pallas, out_dtype=jnp.float32
+    )
 
 
 _SRC_GATHER_MODES = ("xla", "banded", "banded-interpret")

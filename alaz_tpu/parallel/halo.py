@@ -123,6 +123,12 @@ def ring_attention_aggregate(
 
     Must run inside shard_map over ``axis``. Returns [n_loc, nh*hd]
     normalized attention aggregates for the local nodes.
+
+    Src ownership is derived as ``edge_src // n_loc``: node shards MUST
+    be uniform and contiguous (shard k owns global ids [k·n_loc,
+    (k+1)·n_loc)), exactly what ``shard_graph_batch`` /
+    ``shard_graph`` produce. A non-uniform layout would silently route
+    edges to the wrong hop — repartition through those helpers first.
     """
     n_loc = kv_local.shape[0]
     nh, hd = a_k.shape

@@ -368,6 +368,14 @@ class Service:
                 t0 = time_module.perf_counter()
                 out = self._score_fn(self.model_state, graph)
                 logits = np.asarray(out["edge_logits"])
+                if "attn_clamp_saturation" in out:
+                    # GAT logit-clamp saturation (models/gat.py layer_fn):
+                    # nonzero means trained logits are hitting ±30 and the
+                    # softmax is flattening — the fixed-clamp assumption
+                    # needs revisiting if this climbs
+                    self.metrics.gauge("model.attn_clamp_saturation").set(
+                        float(out["attn_clamp_saturation"])
+                    )
                 self._scorer_busy_s += time_module.perf_counter() - t0
                 self.scored_batches += 1
                 self.scored_edges += batch.n_edges

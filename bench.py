@@ -538,8 +538,10 @@ def main() -> None:
     # the bare invocation — what the driver makes — is staged
     if not (args.direct or args.e2e or args.profile or args.probe_only):
         # an explicit --watchdog-s tighter than the stage budget bounds
-        # the whole staged run (the pre-rework meaning of the flag)
-        args.budget_s = min(args.budget_s, args.watchdog_s)
+        # the whole staged run (the pre-rework meaning of the flag);
+        # 0 still means "no watchdog", not "no budget"
+        if args.watchdog_s > 0:
+            args.budget_s = min(args.budget_s, args.watchdog_s)
         sys.exit(staged_main(args))
 
     # children / direct runs own the jax process: make JAX_PLATFORMS=cpu
@@ -560,7 +562,9 @@ def main() -> None:
         out = bench_model(args)
     if watchdog is not None:
         watchdog.cancel()
-    print(json.dumps(out))
+    # flush: stdout is a pipe under the staged parent, and a post-print
+    # teardown hang + SIGKILL would lose a buffered (unflushed) result
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

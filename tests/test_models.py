@@ -60,6 +60,22 @@ class TestStaticModels:
             assert np.isfinite(np.asarray(leaf)).all()
 
 
+class TestGatSaturationGauge:
+    def test_reports_zero_at_init_and_one_when_forced(self, small_batch):
+        """attn_clamp_saturation observes the fixed ±30 clamp's silent-
+        flattening failure mode: ~0 for fresh params (logits O(1)), →1
+        when the attention vectors are scaled so every logit saturates."""
+        cfg = ModelConfig(model="gat", hidden_dim=32, num_heads=4, use_pallas=False)
+        params = gat.init(jax.random.PRNGKey(0), cfg)
+        out = gat.apply(params, _graph(small_batch), cfg)
+        sat = float(out["attn_clamp_saturation"])
+        assert 0.0 <= sat < 0.05, sat
+        forced = dict(params["layers"][0], attn=params["layers"][0]["attn"] * 1e4)
+        params2 = dict(params, layers=[forced] + list(params["layers"][1:]))
+        out2 = gat.apply(params2, _graph(small_batch), cfg)
+        assert float(out2["attn_clamp_saturation"]) > 0.5
+
+
 class TestTgn:
     def test_memory_updates_only_active(self, small_batch):
         cfg = ModelConfig(model="tgn", hidden_dim=32, use_pallas=False)

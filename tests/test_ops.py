@@ -89,6 +89,34 @@ class TestXlaSegment:
         assert out.dtype == jnp.float32
         assert float(out[0, 0]) == float(e), f"stagnated: {float(out[0, 0])}"
 
+    @pytest.mark.parametrize("up", [False, "interpret"])
+    def test_segment_sum_accurate_result_not_bf16_rounded(self, up):
+        """2049 is NOT bf16-representable (rounds to 2048): a kernel path
+        that casts its f32 accumulator back through bf16 on the way out
+        loses the +1. segment_sum_accurate's result must carry the exact
+        f32 accumulator on both dispatch paths (out_dtype=f32 plumbing in
+        pallas_segment.scatter_sum_sorted)."""
+        e, n = 2176, 128  # kernel wants 128-multiples
+        vals = np.ones((e, 128), np.float32)
+        vals[2048:] = 1.0 / 128.0  # exact in bf16; total = 2048 + 1 = 2049
+        data = jnp.asarray(vals, jnp.bfloat16)
+        ids = jnp.zeros(e, jnp.int32)
+        out = segment_sum_accurate(data, ids, n, use_pallas=up)
+        assert float(out[0, 0]) == 2049.0, f"bf16-rounded: {float(out[0, 0])}"
+
+    def test_scatter_sum_sorted_out_dtype_grad_matches_input(self):
+        """out_dtype=f32 on bf16 inputs: gradients must come back in the
+        INPUT dtype (custom_vjp residual dtype token)."""
+        msgs = jnp.ones((128, 8), jnp.bfloat16)
+        ids = jnp.zeros(128, jnp.int32)
+
+        def loss(m):
+            return scatter_sum_sorted(m, ids, 128, jnp.float32).sum()
+
+        g = jax.grad(loss)(msgs)
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(g, np.float32), 1.0)
+
 
 class TestPallasScatter:
     def test_matches_xla_interpret(self, coo):
