@@ -1,0 +1,37 @@
+# Build/test entry points (the reference drives the same lifecycle from
+# its Makefile: native artifact build, image build, multi-arch buildx
+# push — Makefile:47-65).
+
+IMAGE     ?= alaz-tpu
+TAG       ?= latest
+# arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
+PLATFORMS ?= linux/amd64,linux/arm64
+
+.PHONY: native test image image-multiarch bench
+
+native:  ## libalaz_ingest.so + the out-of-process agent example
+	$(MAKE) -C alaz_tpu/native all agent
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+image:  ## single-arch local build (docker build)
+	docker build -t $(IMAGE):$(TAG) .
+
+# Multi-arch via buildx (reference Makefile:61-65 / ebpf-builder
+# analog): base images are multi-arch manifests and the native stage
+# compiles in-container, so each platform gets its own correctly-built
+# .so. TPU wheels are amd64-only — arm64 layers must build the
+# data-plane variant.
+image-multiarch:
+	docker buildx build --platform $(PLATFORMS) \
+		--build-arg JAX_VARIANT=cpu \
+		-t $(IMAGE):$(TAG) --push .
+
+image-multiarch-local:  ## cross-build without pushing (sanity)
+	docker buildx build --platform $(PLATFORMS) \
+		--build-arg JAX_VARIANT=cpu \
+		-t $(IMAGE):$(TAG) .

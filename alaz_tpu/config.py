@@ -193,6 +193,15 @@ class ModelConfig:
     expert_dispatch: str = "table"
     node_feature_dim: int = 32
     edge_feature_dim: int = 16
+    # append per-window z-scored copies of the leading stat columns
+    # (count/latency/error rates) to the edge features inside the model:
+    # each edge seen RELATIVE to the window's fleet baseline. Absolute
+    # log-latency shifts of a ramping-but-not-yet-spiking edge are ~1e-2
+    # of the feature scale (invisible next to node-embedding variance);
+    # the z-scored copy puts the same drift tens of σ out — the input
+    # representation that makes next-window forecasting learnable
+    # (replay/scenario.py run_forecast_scenario).
+    edge_feat_znorm: bool = True
     dropout: float = 0.1
     dtype: str = "bfloat16"
     use_pallas: bool = True
@@ -205,6 +214,15 @@ class ModelConfig:
     # growing fleet doesn't pay a serving-time recompile per
     # (bucket, memory-shape) pair
     tgn_max_nodes: int = 4096
+
+    @property
+    def edge_feat_dim_in(self) -> int:
+        """Edge-feature width as the model layers see it: the raw
+        builder features plus the z-scored stat columns when
+        ``edge_feat_znorm`` is on (models/common.py znorm_edge_feats)."""
+        from alaz_tpu.models.common import EDGE_STAT_COLS
+
+        return self.edge_feature_dim + (EDGE_STAT_COLS if self.edge_feat_znorm else 0)
 
     @classmethod
     def from_env(cls) -> "ModelConfig":

@@ -55,6 +55,60 @@ def compute_dtype(cfg: ModelConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
+# leading edge-feature columns that carry window STATS (count, mean/max
+# latency, 5xx/4xx rates, tls share, request rate — graph/builder.py
+# ef[:, 0:7]); the z-norm augmentation scores exactly these
+EDGE_STAT_COLS = 7
+
+
+def znorm_edge_feats(
+    ef: jnp.ndarray,
+    edge_mask: jnp.ndarray,
+    axis: str | None = None,
+    eps: float = 1e-8,
+    clip: float = 8.0,
+) -> jnp.ndarray:
+    """[E, F] → [E, F + EDGE_STAT_COLS]: append per-window z-scores of
+    the stat columns, each edge measured against the window's fleet
+    baseline. An edge whose latency drifts 2-4x reads as a shift of
+    ~1e-2 in absolute log-latency (lost next to node-embedding
+    variance) but tens of σ in the z-scored copy — the representation
+    that makes sub-threshold drift (and thus next-window forecasting,
+    BASELINE config 4) learnable. Stats accumulate in f32 whatever the
+    feature dtype; ``axis`` psums them across node shards inside
+    shard_map so sharded and single-device forwards agree; z of padded
+    edges is forced to 0."""
+    m = edge_mask.astype(jnp.float32)[:, None]
+    stats = ef[:, :EDGE_STAT_COLS].astype(jnp.float32)
+    cnt = m.sum()
+    s1 = (stats * m).sum(0)
+    s2 = (stats * stats * m).sum(0)
+    if axis is not None:
+        cnt = jax.lax.psum(cnt, axis)
+        s1 = jax.lax.psum(s1, axis)
+        s2 = jax.lax.psum(s2, axis)
+    cnt = jnp.maximum(cnt, 1.0)
+    mean = s1 / cnt
+    var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
+    z = (stats - mean) * jax.lax.rsqrt(var + eps)
+    z = jnp.clip(z, -clip, clip) * m
+    return jnp.concatenate([ef, z.astype(ef.dtype)], axis=1)
+
+
+def maybe_znorm_graph(graph: dict, cfg: ModelConfig, axis: str | None = None) -> dict:
+    """Model-entry hook: returns ``graph`` with augmented edge_feats when
+    cfg.edge_feat_znorm (idempotence guard: skips if the width already
+    matches edge_feat_dim_in, so wrappers can call it defensively)."""
+    if not cfg.edge_feat_znorm:
+        return graph
+    if graph["edge_feats"].shape[1] >= cfg.edge_feat_dim_in:
+        return graph
+    return dict(
+        graph,
+        edge_feats=znorm_edge_feats(graph["edge_feats"], graph["edge_mask"], axis=axis),
+    )
+
+
 def scatter_messages(
     msgs: jnp.ndarray,
     edge_dst: jnp.ndarray,

@@ -28,6 +28,7 @@ from alaz_tpu.models.common import (
     edge_head_init,
     layernorm,
     layernorm_init,
+    maybe_znorm_graph,
     mlp,
     mlp_init,
 )
@@ -48,7 +49,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     keys = jax.random.split(key, 4 + 6 * cfg.num_layers)
     params: Params = {
         "embed": dense_init(keys[0], cfg.node_feature_dim, h),
-        "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
+        "edge_head": edge_head_init(keys[2], h, cfg.edge_feat_dim_in),
         "node_head": mlp_init(keys[3], [h, h, 1]),
         "layers": [],
     }
@@ -58,7 +59,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
             {
                 "q": dense_init(k[0], h, h),
                 "kv": dense_init(k[1], h, h),
-                "edge_proj": dense_init(k[2], cfg.edge_feature_dim, h),
+                "edge_proj": dense_init(k[2], cfg.edge_feat_dim_in, h),
                 "attn": jax.random.normal(k[3], (nh, 3 * (h // nh)), jnp.float32) * 0.05,
                 "out": dense_init(k[4], h, h),
                 "ln": layernorm_init(h),
@@ -69,6 +70,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
 
 def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     dtype = compute_dtype(cfg)
+    graph = maybe_znorm_graph(graph, cfg)
     n = graph["node_feats"].shape[0]
     nh = cfg.num_heads
     hd = cfg.hidden_dim // nh

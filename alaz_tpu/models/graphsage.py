@@ -26,6 +26,7 @@ from alaz_tpu.models.common import (
     edge_head_init,
     layernorm,
     layernorm_init,
+    maybe_znorm_graph,
     mlp,
     masked_degree,
     mlp_init,
@@ -41,7 +42,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     keys = jax.random.split(key, 4 + 4 * cfg.num_layers)
     params: Params = {
         "embed": dense_init(keys[0], cfg.node_feature_dim, h),
-        "edge_head": edge_head_init(keys[2], h, cfg.edge_feature_dim),
+        "edge_head": edge_head_init(keys[2], h, cfg.edge_feat_dim_in),
         "node_head": mlp_init(keys[3], [h, h, 1]),
         "layers": [],
     }
@@ -50,7 +51,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
         params["layers"].append(
             {
                 "msg": dense_init(k[0], h, h),
-                "edge_proj": dense_init(k[1], cfg.edge_feature_dim, h),
+                "edge_proj": dense_init(k[1], cfg.edge_feat_dim_in, h),
                 "self": dense_init(k[2], h, h),
                 "neigh": dense_init(k[3], h, h),
                 "ln": layernorm_init(h),
@@ -64,6 +65,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     node state before message passing — the hook the temporal model (tgn)
     uses to condition on its node memory."""
     dtype = compute_dtype(cfg)
+    graph = maybe_znorm_graph(graph, cfg)
     n = graph["node_feats"].shape[0]
     node_mask = graph["node_mask"].astype(dtype)
     edge_mask = graph["edge_mask"]

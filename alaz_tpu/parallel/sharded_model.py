@@ -35,6 +35,7 @@ from alaz_tpu.models.common import (
     masked_degree,
     mlp,
     scatter_messages,
+    znorm_edge_feats,
 )
 from alaz_tpu.parallel.halo import (
     partition_edges_by_dst,
@@ -110,6 +111,17 @@ def _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis):
     )
 
 
+
+def _maybe_znorm_sharded(ef_raw, edge_mask, cfg, axis: str, dtype):
+    """Shard-side twin of models/common.py maybe_znorm_graph: the
+    fleet-baseline z-stats are a GLOBAL per-window reduction, psum'd over
+    the node shards so sharded forwards match the single-device apply
+    bit-for-tolerance (parity tests)."""
+    if cfg.edge_feat_znorm and ef_raw.shape[1] < cfg.edge_feat_dim_in:
+        ef_raw = znorm_edge_feats(ef_raw, edge_mask, axis=axis)
+    return ef_raw.astype(dtype)
+
+
 def make_node_sharded_graphsage(
     cfg: ModelConfig, mesh: Mesh, axis: str = "sp"
 ) -> Callable:
@@ -132,7 +144,7 @@ def make_node_sharded_graphsage(
         node_mask = g["node_mask"][0].astype(dtype)
         edge_mask = g["edge_mask"][0]
         src, dst_local = g["edge_src"][0], g["edge_dst_local"][0]
-        ef = g["edge_feats"][0].astype(dtype)
+        ef = _maybe_znorm_sharded(g["edge_feats"][0], edge_mask, cfg, axis, dtype)
         n_loc = g["node_feats"].shape[1]
 
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))
@@ -196,7 +208,7 @@ def make_node_sharded_gat(
         node_mask = g["node_mask"][0].astype(dtype)
         edge_mask = g["edge_mask"][0]
         src, dst_local = g["edge_src"][0], g["edge_dst_local"][0]
-        ef = g["edge_feats"][0].astype(dtype)
+        ef = _maybe_znorm_sharded(g["edge_feats"][0], edge_mask, cfg, axis, dtype)
         n_loc = g["node_feats"].shape[1]
 
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))

@@ -53,17 +53,19 @@ class ScenarioData:
         return self.train + self.eval
 
 
-def run_anomaly_scenario(
+def _run_scenario(
     sim_cfg: SimulationConfig,
-    n_windows: int = 10,
-    window_s: float = 1.0,
-    fault_fraction: float = 0.15,
-    train_frac: float = 0.6,
-    fault_kinds: tuple = faults_mod.FAULT_KINDS,
-    seed: int = 0,
+    n_windows: int,
+    window_s: float,
+    train_frac: float,
+    seed: int,
+    plan_fn,
+    label_fn,
 ) -> ScenarioData:
-    """Replay ``n_windows`` of traffic with a persistent fault plan, label
-    every closed window with the oracle, and split train/eval by time."""
+    """The shared scenario pipeline: simulate → inject per ``plan_fn(rng,
+    uid_pairs)`` → aggregate into labeled windows via ``label_fn(batch,
+    plan)`` → time-split. Both public scenarios are thin wrappers so the
+    replay plumbing (flush timing, store wiring) can never diverge."""
     rng = np.random.default_rng(seed)
     interner = Interner()
     sim = Simulator(
@@ -85,7 +87,7 @@ def run_anomaly_scenario(
         )
         for e in sim.edges
     ]
-    plan = faults_mod.make_plan(rng, pairs, fault_fraction, kinds=fault_kinds)
+    plan = plan_fn(rng, pairs)
 
     store = WindowedGraphStore(interner, window_s=window_s)
     injected = FaultInjectingStore(store, plan, rng)
@@ -100,9 +102,7 @@ def run_anomaly_scenario(
 
     batches = store.batches
     for b in batches:
-        b.edge_label = faults_mod.label_batch_edges(b, plan)
-        # per-class oracle for kind-broken-out AUROC (metrics.auroc_by_kind)
-        b.edge_fault_kind = faults_mod.label_batch_kinds(b, plan)
+        label_fn(b, plan)
 
     n_train = max(1, int(len(batches) * train_frac))
     return ScenarioData(
@@ -110,4 +110,77 @@ def run_anomaly_scenario(
         eval=batches[n_train:],
         interner=interner,
         plan=plan,
+    )
+
+
+def run_anomaly_scenario(
+    sim_cfg: SimulationConfig,
+    n_windows: int = 10,
+    window_s: float = 1.0,
+    fault_fraction: float = 0.15,
+    train_frac: float = 0.6,
+    fault_kinds: tuple = faults_mod.FAULT_KINDS,
+    seed: int = 0,
+) -> ScenarioData:
+    """Replay ``n_windows`` of traffic with a persistent fault plan, label
+    every closed window with the oracle, and split train/eval by time."""
+
+    def label(b, plan):
+        b.edge_label = faults_mod.label_batch_edges(b, plan)
+        # per-class oracle for kind-broken-out AUROC (metrics.auroc_by_kind)
+        b.edge_fault_kind = faults_mod.label_batch_kinds(b, plan)
+
+    return _run_scenario(
+        sim_cfg, n_windows, window_s, train_frac, seed,
+        plan_fn=lambda rng, pairs: faults_mod.make_plan(
+            rng, pairs, fault_fraction, kinds=fault_kinds
+        ),
+        label_fn=label,
+    )
+
+
+def run_forecast_scenario(
+    sim_cfg: SimulationConfig,
+    n_windows: int = 20,
+    window_s: float = 1.0,
+    fault_fraction: float = 0.15,
+    train_frac: float = 0.6,
+    ramp_windows: int = 4,
+    full_mult: float = 12.0,
+    seed: int = 0,
+) -> ScenarioData:
+    """BASELINE config 4's FORECASTING task: latency faults RAMP over
+    ``ramp_windows`` windows instead of stepping, and every batch carries
+    ``edge_label_next`` — what the edge's spike label WILL be at the end
+    of the NEXT window. A temporal model watching the sub-threshold
+    drift (the leading indicator) can call the spike one window early;
+    train on ``edge_label_next`` and evaluate AUROC against it
+    (train/trainstep.py train_tgn_unrolled(label_attr=...)).
+
+    Onsets are spread over the middle of the run so both the train and
+    eval spans contain pre-onset, ramping, and spiking states."""
+    window_ms = int(window_s * 1000)
+    base_ms = _BASE_TIME_NS // 1_000_000
+
+    def label(b, plan):
+        b.edge_label = faults_mod.label_batch_edges(b, plan)
+        b.edge_fault_kind = faults_mod.label_batch_kinds(b, plan)
+        # the forecast target: this edge's spike state at the END of the
+        # next window
+        b.edge_label_next = faults_mod.label_batch_edges(
+            b, plan, at_ms=int(b.window_end_ms) + window_ms
+        )
+
+    return _run_scenario(
+        sim_cfg, n_windows, window_s, train_frac, seed,
+        plan_fn=lambda rng, pairs: faults_mod.make_ramp_plan(
+            rng,
+            pairs,
+            fault_fraction,
+            onset_lo_ms=base_ms + window_ms,
+            onset_hi_ms=base_ms + (n_windows - ramp_windows // 2) * window_ms,
+            span_ms=ramp_windows * window_ms,
+            full_mult=full_mult,
+        ),
+        label_fn=label,
     )

@@ -347,10 +347,19 @@ _DEVICE_MEM_KEYS = (
 def device_gauges(metrics: Metrics) -> None:
     """Accelerator gauges (the gpu/ NVML collector analog, SURVEY §2.2
     G22, ~19 gauges): per-device memory-stat gauges, an HBM-utilization
-    percentage (the mem_utz analog), and device identity info (the
-    gpu_info/gpu_driver analog). Power/clock/fan have no TPU runtime
-    surface here; the compute-side utilization analog is the scorer
+    percentage (the mem_utz analog), device identity info (the
+    gpu_info/gpu_driver analog), and — where the host's libtpu runtime
+    metric service answers — environment legs (tensorcore duty cycle,
+    runtime HBM, temperature/power on platforms that expose them) via
+    runtime/tpu_env.py, completing the power/clock/temperature side of
+    the NVML analog. The in-process compute-side fallback is the scorer
     duty-cycle gauge the service registers."""
+    try:
+        from alaz_tpu.runtime.tpu_env import TpuEnvCollector
+
+        TpuEnvCollector().register(metrics)
+    except Exception:  # no libtpu metric service on this host
+        pass
     try:
         import jax
 

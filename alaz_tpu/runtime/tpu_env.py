@@ -1,0 +1,199 @@
+"""TPU environment metrics — the NVML collector analog for the legs the
+chip runtime CAN expose (gpu/collector.go:95-182 exports power, clocks,
+fan, temperature via NVML; TPUs have no NVML, but libtpu ships a runtime
+metric service on localhost:8431 — the surface `tpu-info` scrapes).
+
+A gRPC unary client (repo HTTP/2 + HPACK stack, sources/cri.py) calls
+``tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric`` per
+metric name and walks the protobuf response generically: each returned
+measurement is (attributes, gauge value); the ``device-id`` attribute
+fans the gauge out per chip. Default metric set covers tensorcore duty
+cycle and HBM usage/total (the documented names); extra names —
+temperature/power on platforms whose libtpu exposes them — ride
+``ALAZ_TPU_ENV_METRICS=name[,name...]`` and export under sanitized
+gauge names, so new libtpu surfaces need zero code here.
+
+Wire shapes follow tpu_metric_service.proto as implemented by the
+public tpu-info tool: MetricRequest{metric_name=1};
+MetricResponse{metric=1 TPUMetric{name=1, metrics=2 repeated
+Metric{attribute=1 Attribute{key=1, value=2 AttrValue{int_attr=1,
+str_attr=2}}, gauge=2 Gauge{as_double=1, as_int=2}}}}. The parser is
+deliberately permissive (unknown fields skipped) so minor proto
+revisions degrade to missing gauges, not crashes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from alaz_tpu.logging import get_logger
+from alaz_tpu.sources.cri import GrpcError, GrpcTcpClient, pb_fields, pb_len, pb_str
+
+log = get_logger("alaz_tpu.tpu_env")
+
+DEFAULT_ADDR = "localhost:8431"
+SERVICE = "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
+
+METRIC_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+METRIC_HBM_USED = "tpu.runtime.hbm.memory.usage.bytes"
+METRIC_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+
+DEFAULT_METRICS = (METRIC_DUTY_CYCLE, METRIC_HBM_USED, METRIC_HBM_TOTAL)
+
+# metric name -> short gauge suffix for the default set; extras sanitize
+_GAUGE_NAMES = {
+    METRIC_DUTY_CYCLE: "tensorcore_duty_cycle_pct",
+    METRIC_HBM_USED: "runtime_hbm_used_bytes",
+    METRIC_HBM_TOTAL: "runtime_hbm_total_bytes",
+}
+
+
+def gauge_suffix(metric_name: str) -> str:
+    """tpu.runtime.env.temperature.celsius → env_temperature_celsius."""
+    if metric_name in _GAUGE_NAMES:
+        return _GAUGE_NAMES[metric_name]
+    s = metric_name
+    if s.startswith("tpu.runtime."):
+        s = s[len("tpu.runtime."):]
+    return s.replace(".", "_").replace("-", "_")
+
+
+def _parse_attr(data: bytes) -> Tuple[str, Optional[object]]:
+    """Attribute{key=1 str, value=2 AttrValue{int_attr=1, str_attr=2}}."""
+    key, value = "", None
+    for f, wt, v in pb_fields(data):
+        if f == 1 and wt == 2:
+            key = bytes(v).decode("utf-8", "replace")
+        elif f == 2 and wt == 2:
+            for f2, wt2, v2 in pb_fields(bytes(v)):
+                if f2 == 1 and wt2 == 0:
+                    value = int(v2)
+                elif f2 == 2 and wt2 == 2:
+                    value = bytes(v2).decode("utf-8", "replace")
+    return key, value
+
+
+def _parse_gauge(data: bytes) -> Optional[float]:
+    """Gauge{as_double=1 (fixed64), as_int=2 (varint)}."""
+    for f, wt, v in pb_fields(data):
+        if f == 1 and wt == 1:
+            return struct.unpack("<d", int(v).to_bytes(8, "little"))[0]
+        if f == 2 and wt == 0:
+            return float(int(v))
+    return None
+
+
+def parse_metric_response(body: bytes) -> List[Tuple[Dict[str, object], float]]:
+    """MetricResponse → [(attributes, value)] measurement records."""
+    records: List[Tuple[Dict[str, object], float]] = []
+    for f, wt, v in pb_fields(body):
+        if f != 1 or wt != 2:
+            continue
+        for f2, wt2, v2 in pb_fields(bytes(v)):  # TPUMetric
+            if f2 != 2 or wt2 != 2:
+                continue
+            attrs: Dict[str, object] = {}
+            value: Optional[float] = None
+            for f3, wt3, v3 in pb_fields(bytes(v2)):  # Metric
+                if f3 == 1 and wt3 == 2:
+                    k, av = _parse_attr(bytes(v3))
+                    if k:
+                        attrs[k] = av
+                elif f3 == 2 and wt3 == 2:
+                    value = _parse_gauge(bytes(v3))
+            if value is not None:
+                records.append((attrs, value))
+    return records
+
+
+def build_metric_request(metric_name: str) -> bytes:
+    return pb_str(1, metric_name)
+
+
+class TpuEnvCollector:
+    """Samples the libtpu metric service, caching one sweep per
+    ``min_interval_s`` so a Prometheus scrape of N gauges costs one RPC
+    round, not N (the NVML collector batches the same way)."""
+
+    def __init__(
+        self,
+        addr: str | None = None,
+        metric_names: tuple | None = None,
+        timeout_s: float = 2.0,
+        min_interval_s: float = 5.0,
+    ):
+        addr = addr or os.environ.get("ALAZ_TPU_ENV_ADDR", DEFAULT_ADDR)
+        host, _, port_s = addr.rpartition(":")
+        self.host, self.port = host or "localhost", int(port_s)
+        extra = [
+            m.strip()
+            for m in os.environ.get("ALAZ_TPU_ENV_METRICS", "").split(",")
+            if m.strip()
+        ]
+        self.metric_names = tuple(metric_names or DEFAULT_METRICS) + tuple(extra)
+        self.timeout_s = timeout_s
+        self.min_interval_s = min_interval_s
+        self._cache: Dict[str, Dict[int, float]] = {}
+        self._last_sweep = 0.0
+
+    def sample(self) -> Dict[str, Dict[int, float]]:
+        """{metric_name: {device_id: value}} for every configured metric
+        the service answers; one fresh connection per sweep (the service
+        restarts with the runtime — a pooled conn would go stale)."""
+        out: Dict[str, Dict[int, float]] = {}
+        client = GrpcTcpClient(self.host, self.port, timeout_s=self.timeout_s)
+        try:
+            for name in self.metric_names:
+                try:
+                    body = client.call(SERVICE, build_metric_request(name))
+                except GrpcError as exc:
+                    log.debug(f"metric {name}: {exc}")
+                    continue
+                per_dev: Dict[int, float] = {}
+                for attrs, value in parse_metric_response(body):
+                    dev = attrs.get("device-id", attrs.get("device_id", 0))
+                    per_dev[int(dev) if isinstance(dev, int) else 0] = value
+                if per_dev:
+                    out[name] = per_dev
+        finally:
+            client.close()
+        return out
+
+    def _sweep_cached(self) -> Dict[str, Dict[int, float]]:
+        now = time.monotonic()
+        if now - self._last_sweep >= self.min_interval_s:
+            self._last_sweep = now
+            try:
+                self._cache = self.sample()
+            except (OSError, GrpcError) as exc:
+                log.debug(f"tpu env sweep failed: {exc}")
+                self._cache = {}
+        return self._cache
+
+    def register(self, metrics) -> bool:
+        """Probe once; when the service answers, register one gauge per
+        (metric, device) seen. Returns False (and registers nothing) when
+        the service is absent — CPU hosts, tests."""
+        try:
+            first = self.sample()
+        except (OSError, GrpcError) as exc:
+            log.debug(f"tpu env metric service unavailable: {exc}")
+            return False
+        if not first:
+            return False
+        self._cache, self._last_sweep = first, time.monotonic()
+        for name, per_dev in first.items():
+            for dev in per_dev:
+                def fn(n=name, d=dev):
+                    return self._sweep_cached().get(n, {}).get(d, float("nan"))
+
+                metrics.gauge(f"device{dev}.{gauge_suffix(name)}", fn)
+        log.info(
+            f"tpu env gauges: {len(first)} metrics x "
+            f"{max(len(v) for v in first.values())} devices from "
+            f"{self.host}:{self.port}"
+        )
+        return True

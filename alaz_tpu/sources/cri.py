@@ -177,6 +177,11 @@ class GrpcUnixClient:
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.settimeout(timeout_s)
         self._sock.connect(socket_path)
+        self._init_conn()
+
+    def _init_conn(self) -> None:
+        """Shared post-connect setup (TCP subclass reuses everything but
+        the dial)."""
         self._enc = hpack.Encoder()
         self._dec = hpack.Decoder()
         self._buf = b""
@@ -282,6 +287,16 @@ class GrpcUnixClient:
                 raise GrpcError("compressed gRPC responses unsupported")
             (msg_len,) = struct.unpack("!I", body[1:5])
             return body[5 : 5 + msg_len]
+
+
+class GrpcTcpClient(GrpcUnixClient):
+    """Same unary client over TCP (the libtpu runtime-metrics service
+    listens on localhost:8431 — runtime/tpu_env.py)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.timeout_s = timeout_s
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._init_conn()
 
 
 # ---------------------------------------------------------------------------

@@ -88,6 +88,25 @@ class SocketConnection(Connection):
             pass
 
 
+def _make_tls_context(ca_file: str | None) -> ssl.SSLContext:
+    """CA resolution chain (the caCert.go embedded-bundle analog):
+    an explicit ``ca_file`` pins a private CA; otherwise the system
+    trust store, and when THAT is empty (slim containers routinely ship
+    no /etc/ssl bundle — the situation the reference embeds its CA for)
+    fall back to certifi's bundled roots if importable. A context with
+    zero CAs would otherwise fail every handshake with a misleading
+    verify error."""
+    ctx = ssl.create_default_context(cafile=ca_file)
+    if ca_file is None and not ctx.get_ca_certs():
+        try:
+            import certifi
+
+            ctx.load_verify_locations(cafile=certifi.where())
+        except Exception:  # noqa: BLE001 - no bundle anywhere: leave as-is
+            pass
+    return ctx
+
+
 def dial(
     host: str,
     port: int,
@@ -97,13 +116,14 @@ def dial(
     timeout_s: float = 60.0,
 ) -> SocketConnection:
     """Production connection factory body (stream.go:81-105: 60 s dial
-    timeout, TLS by default). ``ca_file`` pins a private CA; None uses
-    the system trust store (the reference instead embeds its SaaS CA —
-    caCert.go — which only makes sense for a fixed backend)."""
+    timeout, TLS by default). ``ca_file`` pins a private CA; None falls
+    back to the system trust store, then certifi's bundled roots
+    (_make_tls_context — the analog of the reference's embedded SaaS CA,
+    caCert.go, generalized to any backend)."""
     if use_tls:
         # build the context BEFORE dialing: a bad ca_file path must not
         # leak an established TCP fd per attempt
-        ctx = ssl.create_default_context(cafile=ca_file)
+        ctx = _make_tls_context(ca_file)
     raw = socket.create_connection((host, port), timeout=timeout_s)
     if not use_tls:
         raw.settimeout(None)

@@ -97,48 +97,78 @@ def train_tgn_unrolled(
     lr: float = 3e-3,
     pos_weight: float = 10.0,
     seed: int = 0,
+    label_attr: str = "edge_label",
 ) -> tuple[TrainState, List[float]]:
     """Temporal training for TGN: unroll ``step`` across the window
     sequence with memory threaded through, so the GRU/memory parameters
     receive gradient (the memoryless registry ``apply`` trains only the
     snapshot encoder — its memory path stays at init). One jitted program
-    over the whole unroll; all windows must share a shape bucket."""
+    over the whole unroll; all windows must share a shape bucket.
+    ``label_attr="edge_label_next"`` trains the FORECAST objective
+    (replay/scenario.py run_forecast_scenario) — learnable because the
+    z-scored edge stats (models/common.py znorm_edge_feats) put the
+    sub-threshold latency drift tens of σ above the fleet baseline.
+
+    ``batches`` is one window sequence (List[GraphBatch]) or SEVERAL
+    (List[List[GraphBatch]]), each unrolled from fresh memory with the
+    loss averaged across sequences. Forecast training MUST use several
+    fault draws: with a single plan the faulty edge set is constant
+    across every window, so the model can memorize WHICH edges ramp
+    instead of learning the drift signature — and at eval time that
+    memorization is anti-predictive for fault sets it never saw."""
     from alaz_tpu.models import tgn
 
-    batch_list = list(batches)
-    assert batch_list, "no training windows"
+    seq_input = list(batches)
+    assert seq_input, "no training windows"
+    sequences: List[List[GraphBatch]] = (
+        [list(s) for s in seq_input]
+        if isinstance(seq_input[0], (list, tuple))
+        else [seq_input]
+    )
     params = tgn.init(jax.random.PRNGKey(seed), cfg)
     optimizer = optax.adamw(lr, weight_decay=1e-4)
     opt_state = optimizer.init(params)
     # the unroll is one program, so every window is padded up to the
     # largest bucket present (Poisson traffic routinely straddles bucket
     # boundaries between windows)
-    n_t = max(b.n_pad for b in batch_list)
-    e_t = max(b.e_pad for b in batch_list)
+    all_b = [b for s in sequences for b in s]
+    n_t = max(b.n_pad for b in all_b)
+    e_t = max(b.e_pad for b in all_b)
     max_nodes = max(cfg.tgn_max_nodes, n_t)
 
-    graphs = [
-        {
-            k: jnp.asarray(_pad_graph_field(k, v, n_t, e_t))
-            for k, v in b.device_arrays().items()
-        }
-        for b in batch_list
-    ]
-    labels = [
-        jnp.asarray(np.pad(b.edge_label, (0, e_t - b.e_pad))) for b in batch_list
-    ]
+    def prep_seq(batch_list):
+        graphs = [
+            {
+                k: jnp.asarray(_pad_graph_field(k, v, n_t, e_t))
+                for k, v in b.device_arrays().items()
+            }
+            for b in batch_list
+        ]
+        labels = [
+            jnp.asarray(np.pad(getattr(b, label_attr), (0, e_t - b.e_pad)))
+            for b in batch_list
+        ]
+        return graphs, labels
+
+    prepped = [prep_seq(s) for s in sequences]
 
     @jax.jit
-    def unrolled_step(params, opt_state, graphs, labels, memory0):
+    def unrolled_step(params, opt_state, prepped, memory0):
         def loss_fn(p):
-            mem = memory0
             total = 0.0
-            for g, lbl in zip(graphs, labels):
-                out, mem = tgn.step(p, g, mem, cfg)
-                total = total + edge_bce_loss(
-                    out["edge_logits"], lbl, g["edge_mask"].astype(jnp.float32), pos_weight
-                )
-            return total / len(graphs)
+            for graphs, labels in prepped:
+                mem = memory0
+                seq_total = 0.0
+                for g, lbl in zip(graphs, labels):
+                    out, mem = tgn.step(p, g, mem, cfg)
+                    seq_total = seq_total + edge_bce_loss(
+                        out["edge_logits"],
+                        lbl,
+                        g["edge_mask"].astype(jnp.float32),
+                        pos_weight,
+                    )
+                total = total + seq_total / len(graphs)
+            return total / len(prepped)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -148,7 +178,9 @@ def train_tgn_unrolled(
     memory0 = tgn.init_memory(cfg, max_nodes)
     losses: List[float] = []
     for _ in range(epochs):
-        params, opt_state, loss = unrolled_step(params, opt_state, graphs, labels, memory0)
+        params, opt_state, loss = unrolled_step(
+            params, opt_state, prepped, memory0
+        )
         losses.append(float(loss))
     return TrainState(params=params, opt_state=opt_state, step=len(losses)), losses
 

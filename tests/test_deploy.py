@@ -59,3 +59,26 @@ class TestDockerfile:
         assert "image: alaz-tpu:latest" in yaml_text
         assert "docker build -t alaz-tpu:latest" in yaml_text
         assert "python -m alaz_tpu serve" in yaml_text
+
+
+class TestMakefile:
+    """Multi-arch image story (reference Makefile:61-65 buildx analog):
+    the targets exist, cover amd64+arm64, and arm64 layers build the
+    data-plane JAX variant (TPU wheels are amd64-only)."""
+
+    def _mk(self) -> str:
+        return (REPO / "Makefile").read_text()
+
+    def test_multiarch_target_uses_buildx_both_platforms(self):
+        mk = self._mk()
+        assert "image-multiarch:" in mk
+        assert "docker buildx build" in mk
+        assert "linux/amd64,linux/arm64" in mk
+        assert "JAX_VARIANT=cpu" in mk
+
+    def test_native_target_drives_the_builder_stage_products(self):
+        mk = self._mk()
+        assert "-C alaz_tpu/native all agent" in mk
+        # same products the Dockerfile's builder stage compiles
+        df = (REPO / "Dockerfile").read_text()
+        assert "make -C alaz_tpu/native clean && make -C alaz_tpu/native all agent" in df

@@ -283,6 +283,33 @@ class TestTlsTransport:
     server-closed conns from the pool (stream.go:51-66,214-289,
     pool.go:24-45)."""
 
+    def test_default_context_has_roots(self):
+        """No pinned CA: the context must still end up with trust roots
+        (system store or certifi fallback — the caCert.go analog)."""
+        from alaz_tpu.sources.logstream import _make_tls_context
+
+        ctx = _make_tls_context(None)
+        assert ctx.cert_store_stats()["x509_ca"] > 0
+
+    def test_certifi_fallback_when_system_store_empty(self, monkeypatch):
+        """Simulate a slim container with no /etc/ssl bundle: the default
+        context comes back empty and certifi's roots must be loaded."""
+        import ssl as ssl_mod
+
+        from alaz_tpu.sources import logstream
+
+        pytest.importorskip("certifi")
+
+        def bare_context(cafile=None):
+            ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+            if cafile:
+                ctx.load_verify_locations(cafile=cafile)
+            return ctx
+
+        monkeypatch.setattr(logstream.ssl, "create_default_context", bare_context)
+        ctx = logstream._make_tls_context(None)
+        assert ctx.cert_store_stats()["x509_ca"] > 0
+
     def test_logs_flow_over_loopback_tls(self, tmp_path, monkeypatch):
         import time as time_mod
 

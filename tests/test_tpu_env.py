@@ -1,0 +1,227 @@
+"""TPU environment gauges (runtime/tpu_env.py): the libtpu
+RuntimeMetricService client against a protocol-level fake (HTTP/2 + gRPC
+over TCP via the repo codecs — the FakeCriServer pattern), completing
+the NVML power/temperature analog (gpu/collector.go:95-182)."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from alaz_tpu.runtime.metrics import Metrics
+from alaz_tpu.runtime.tpu_env import (
+    METRIC_DUTY_CYCLE,
+    METRIC_HBM_TOTAL,
+    METRIC_HBM_USED,
+    TpuEnvCollector,
+    build_metric_request,
+    gauge_suffix,
+    parse_metric_response,
+)
+from alaz_tpu.sources.cri import pb_fields, pb_len, pb_str, pb_varint
+
+
+def _attr_int(key: str, val: int) -> bytes:
+    return pb_len(1, pb_str(1, key) + pb_len(2, pb_varint(1, val)))
+
+
+def _gauge_double(v: float) -> bytes:
+    return pb_len(2, b"\x09" + struct.pack("<d", v))
+
+
+def _gauge_int(v: int) -> bytes:
+    return pb_len(2, pb_varint(2, v))
+
+
+def _metric_response(name: str, per_device: dict) -> bytes:
+    """MetricResponse{metric=1 TPUMetric{name=1, metrics=2 repeated}}."""
+    entries = b""
+    for dev, value in per_device.items():
+        g = _gauge_double(value) if isinstance(value, float) else _gauge_int(value)
+        entries += pb_len(2, _attr_int("device-id", dev) + g)
+    return pb_len(1, pb_str(1, name) + entries)
+
+
+class FakeTpuMetricServer:
+    """RuntimeMetricService over loopback TCP: answers GetRuntimeMetric
+    per requested metric name from a canned table; counts RPCs so cache
+    behavior is observable."""
+
+    def __init__(self, table: dict):
+        self.table = table  # metric name -> {device: value}
+        self.rpcs = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.bind(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._srv.listen(4)
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        from alaz_tpu.protocols import hpack, http2
+
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            try:
+                buf = b""
+                while len(buf) < 24:
+                    buf += conn.recv(4096)
+                assert buf[:24] == http2.MAGIC
+                buf = buf[24:]
+                conn.sendall(http2.build_frame(http2.FRAME_SETTINGS, 0, 0))
+                enc, dec = hpack.Encoder(), hpack.Decoder()
+                bodies = {}
+                while True:
+                    while True:
+                        if len(buf) >= 9:
+                            ln = int.from_bytes(buf[:3], "big")
+                            if len(buf) >= 9 + ln:
+                                break
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            return
+                        buf += chunk
+                    f = http2.parse_frame_header(buf)
+                    buf = buf[9 + f.length :]
+                    if f.type == http2.FRAME_SETTINGS and not f.flags & 1:
+                        conn.sendall(http2.build_frame(http2.FRAME_SETTINGS, 1, 0))
+                    elif f.type == http2.FRAME_HEADERS:
+                        dec.decode(http2.headers_block(f))
+                    elif f.type == http2.FRAME_DATA:
+                        bodies[f.stream_id] = bodies.get(f.stream_id, b"") + f.payload
+                        if not f.flags & http2.FLAG_END_STREAM:
+                            continue
+                        req = bodies.pop(f.stream_id)[5:]
+                        name = ""
+                        for fld, wt, v in pb_fields(req):
+                            if fld == 1 and wt == 2:
+                                name = bytes(v).decode()
+                        self.rpcs += 1
+                        msg = (
+                            _metric_response(name, self.table[name])
+                            if name in self.table
+                            else b""
+                        )
+                        status = "0" if name in self.table else "5"
+                        grpc_frame = b"\x00" + struct.pack("!I", len(msg)) + msg
+                        conn.sendall(
+                            http2.build_frame(
+                                http2.FRAME_HEADERS, http2.FLAG_END_HEADERS, f.stream_id,
+                                enc.encode([(":status", "200"), ("content-type", "application/grpc")]),
+                            )
+                            + http2.build_frame(http2.FRAME_DATA, 0, f.stream_id, grpc_frame)
+                            + http2.build_frame(
+                                http2.FRAME_HEADERS,
+                                http2.FLAG_END_HEADERS | http2.FLAG_END_STREAM,
+                                f.stream_id,
+                                enc.encode([("grpc-status", status)]),
+                            )
+                        )
+            except (AssertionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+class TestWireCodec:
+    def test_request_roundtrip(self):
+        req = build_metric_request(METRIC_DUTY_CYCLE)
+        fields = list(pb_fields(req))
+        assert fields == [(1, 2, METRIC_DUTY_CYCLE.encode())]
+
+    def test_response_parse_per_device(self):
+        body = _metric_response(METRIC_DUTY_CYCLE, {0: 12.5, 1: 99.0})
+        recs = parse_metric_response(body)
+        assert [(a["device-id"], v) for a, v in recs] == [(0, 12.5), (1, 99.0)]
+
+    def test_response_parse_int_gauge(self):
+        body = _metric_response(METRIC_HBM_USED, {0: 123456789})
+        assert parse_metric_response(body) == [({"device-id": 0}, 123456789.0)]
+
+    def test_unknown_fields_skipped(self):
+        body = pb_len(1, pb_str(1, "x") + pb_len(9, b"\x01\x02") + pb_len(
+            2, _attr_int("device-id", 3) + _gauge_double(7.0) + pb_varint(7, 1)
+        ))
+        assert parse_metric_response(body) == [({"device-id": 3}, 7.0)]
+
+    def test_gauge_suffixes(self):
+        assert gauge_suffix(METRIC_DUTY_CYCLE) == "tensorcore_duty_cycle_pct"
+        assert gauge_suffix("tpu.runtime.env.temperature.celsius") == (
+            "env_temperature_celsius"
+        )
+
+
+class TestCollector:
+    def _table(self):
+        return {
+            METRIC_DUTY_CYCLE: {0: 37.5, 1: 12.0},
+            METRIC_HBM_USED: {0: 1 << 30, 1: 2 << 30},
+            METRIC_HBM_TOTAL: {0: 16 << 30, 1: 16 << 30},
+            # a platform-specific extra (temperature) rides the env knob
+            "tpu.runtime.env.temperature.celsius": {0: 54.0, 1: 51.5},
+        }
+
+    def test_register_exports_per_device_gauges(self, monkeypatch):
+        srv = FakeTpuMetricServer(self._table())
+        try:
+            monkeypatch.setenv(
+                "ALAZ_TPU_ENV_METRICS", "tpu.runtime.env.temperature.celsius"
+            )
+            m = Metrics()
+            col = TpuEnvCollector(addr=f"127.0.0.1:{srv.port}", min_interval_s=60.0)
+            assert col.register(m)
+            snap = m.snapshot()
+            assert snap["device0.tensorcore_duty_cycle_pct"] == 37.5
+            assert snap["device1.tensorcore_duty_cycle_pct"] == 12.0
+            assert snap["device0.runtime_hbm_used_bytes"] == float(1 << 30)
+            assert snap["device1.env_temperature_celsius"] == 51.5
+            prom = m.render_prometheus()
+            assert "alaz_tpu_device0_tensorcore_duty_cycle_pct 37.5" in prom
+        finally:
+            srv.close()
+
+    def test_scrapes_are_batched_by_ttl(self):
+        srv = FakeTpuMetricServer(self._table())
+        try:
+            m = Metrics()
+            col = TpuEnvCollector(
+                addr=f"127.0.0.1:{srv.port}",
+                metric_names=(METRIC_DUTY_CYCLE,),
+                min_interval_s=60.0,
+            )
+            assert col.register(m)
+            probe_rpcs = srv.rpcs
+            m.snapshot()
+            m.snapshot()  # N gauges, TTL not expired: no further RPCs
+            assert srv.rpcs == probe_rpcs
+        finally:
+            srv.close()
+
+    def test_register_false_when_service_absent(self):
+        m = Metrics()
+        col = TpuEnvCollector(addr="127.0.0.1:1")  # nothing listens
+        assert not col.register(m)
+        assert "device0.tensorcore_duty_cycle_pct" not in m.snapshot()
+
+    def test_partial_metric_support(self):
+        """Service knows duty cycle but not HBM names: only the known
+        gauge registers (grpc-status 5 per unknown metric, no crash)."""
+        srv = FakeTpuMetricServer({METRIC_DUTY_CYCLE: {0: 5.0}})
+        try:
+            m = Metrics()
+            col = TpuEnvCollector(addr=f"127.0.0.1:{srv.port}", min_interval_s=60.0)
+            assert col.register(m)
+            snap = m.snapshot()
+            assert snap["device0.tensorcore_duty_cycle_pct"] == 5.0
+            assert "device0.runtime_hbm_used_bytes" not in snap
+        finally:
+            srv.close()
