@@ -28,12 +28,18 @@ RUN apt-get update \
 
 # TPU nodes: jax[tpu] pulls libtpu via the Google releases index.
 # JAX_VARIANT=cpu builds a CPU-only image for data-plane nodes.
-ARG JAX_VARIANT=tpu
+# Default is per-arch: TPU hosts are amd64, so a multi-arch buildx run
+# (no explicit JAX_VARIANT) gets jax[tpu] on amd64 and jax[cpu] on
+# arm64 — one manifest serves both node pools without clobbering the
+# TPU-capable amd64 layer with a CPU-only build.
+ARG TARGETARCH=amd64
+ARG JAX_VARIANT=
 # No kubernetes client dependency: the live LIST+WATCH collector speaks
 # the apiserver REST protocol itself (sources/k8s_client.py) using the
 # in-cluster serviceaccount — the manifest's RBAC exists for this client
-RUN pip install --no-cache-dir \
-    "jax[${JAX_VARIANT}]" \
+RUN VARIANT="${JAX_VARIANT:-$([ "$TARGETARCH" = "amd64" ] && echo tpu || echo cpu)}" \
+    && pip install --no-cache-dir \
+    "jax[${VARIANT}]" \
     flax \
     optax \
     orbax-checkpoint \

@@ -24,14 +24,14 @@ image:  ## single-arch local build (docker build)
 # Multi-arch via buildx (reference Makefile:61-65 / ebpf-builder
 # analog): base images are multi-arch manifests and the native stage
 # compiles in-container, so each platform gets its own correctly-built
-# .so. TPU wheels are amd64-only — arm64 layers must build the
-# data-plane variant.
+# .so. The Dockerfile selects the JAX variant per-arch from TARGETARCH
+# (tpu on amd64, cpu on arm64 — TPU wheels are amd64-only), so one
+# manifest serves both node pools and the amd64 layer keeps TPU
+# capability.
 image-multiarch:
 	docker buildx build --platform $(PLATFORMS) \
-		--build-arg JAX_VARIANT=cpu \
 		-t $(IMAGE):$(TAG) --push .
 
 image-multiarch-local:  ## cross-build without pushing (sanity)
 	docker buildx build --platform $(PLATFORMS) \
-		--build-arg JAX_VARIANT=cpu \
 		-t $(IMAGE):$(TAG) .

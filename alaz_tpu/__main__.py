@@ -9,6 +9,7 @@
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -66,6 +67,13 @@ def _stream_tgn_eval(cfg, params, data, collect_next: bool = False):
 
     from alaz_tpu.models import tgn
 
+    if not data.eval:
+        # possible at --windows 1: n_train = max(1, ...) can consume
+        # every batch — fail here with the fix, not 4-way-unpack later
+        raise RuntimeError(
+            "no eval windows were produced (every window landed in the "
+            "train split); increase --windows"
+        )
     mem = tgn.init_memory(
         cfg, max(cfg.tgn_max_nodes, max(b.n_pad for b in data.all_batches))
     )
@@ -107,8 +115,19 @@ def _train_eval_one(model: str, sim_cfg, windows: int, epochs: int, seed: int,
         train_tgn_unrolled,
     )
 
-    cfg = ModelConfig(model=model)
+    # from_env so knobs like EDGE_FEAT_ZNORM=0 shape the TRAINED model
+    # too — otherwise no checkpoint matching a znorm-off serve config
+    # could ever be produced and the contract gate's "set the env to
+    # match" advice would be unsatisfiable
+    cfg = dataclasses.replace(ModelConfig.from_env(), model=model)
     data = run_anomaly_scenario(sim_cfg, n_windows=windows, fault_fraction=0.15, seed=seed)
+    if not data.eval:
+        # possible at --windows 1: n_train = max(1, ...) can consume
+        # every batch; fail with the fix, not an opaque concatenate error
+        raise RuntimeError(
+            "no eval windows were produced (every window landed in the "
+            "train split); increase --windows"
+        )
     if model == "tgn":
         # temporal model: unroll windows with memory threaded so the
         # GRU/memory params train. One update per epoch covers the whole
@@ -149,7 +168,10 @@ def _train_eval_one(model: str, sim_cfg, windows: int, epochs: int, seed: int,
             ).items()
         }
     if ckpt:
-        checkpoint.save(ckpt, step=state.step, params=state.params)
+        checkpoint.save(
+            ckpt, step=state.step, params=state.params,
+            contract=checkpoint.feature_contract(cfg),
+        )
     return {
         "model": model, "auroc": round(float(a), 4),
         "auroc_by_kind": by_kind,
@@ -176,7 +198,7 @@ def _tgn_forecast_eval(
     from alaz_tpu.train.metrics import auroc
     from alaz_tpu.train.trainstep import train_tgn_unrolled
 
-    cfg = ModelConfig(model="tgn")
+    cfg = dataclasses.replace(ModelConfig.from_env(), model="tgn")
     train_seqs = [
         run_forecast_scenario(
             sim_cfg, n_windows=windows, fault_fraction=0.15, seed=seed + s
@@ -302,7 +324,10 @@ def cmd_serve(args) -> int:
     if args.ckpt:
         from alaz_tpu.train import checkpoint
 
-        _, state = checkpoint.restore(args.ckpt)
+        _, state = checkpoint.restore(
+            args.ckpt,
+            expect_contract=checkpoint.feature_contract(cfg.model),
+        )
         params = state["params"]
 
     export_backend = None

@@ -233,6 +233,7 @@ class ModelConfig:
             use_pallas=env_bool("USE_PALLAS", True),
             src_gather=env_str("SRC_GATHER", "xla"),
             expert_dispatch=env_str("EXPERT_DISPATCH", "table"),
+            edge_feat_znorm=env_bool("EDGE_FEAT_ZNORM", True),
             remat=env_bool("REMAT", False),
             tgn_max_nodes=env_int("TGN_MAX_NODES", 4096),
         )

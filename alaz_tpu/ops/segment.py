@@ -63,10 +63,27 @@ def expand_dst(
     """[N, F] → [E, F] broadcast ``v[segment_ids]`` for dst-SORTED ids.
 
     The single dispatch point for the sorted-expand Pallas kernel (an XLA
-    row gather is row-op bound, ~9 ns/row on TPU): kernel on TPU,
-    interpret mode when forced with ``"interpret"``, XLA gather
-    elsewhere."""
-    if pallas_enabled(use_pallas):
+    row gather is row-op bound, ~9 ns/row on TPU *in isolation*): kernel
+    on TPU, interpret mode when forced with ``"interpret"``, XLA gather
+    elsewhere.
+
+    ``ALAZ_EXPAND_DST=xla|pallas`` overrides the dispatch: the r03 trace
+    (ARCHITECTURE §3d) shows the in-graph XLA gather at F=128 costs
+    1.9 ms vs the kernel's 2.4 ms — XLA pipelines row descriptors across
+    the step far better than the isolated microbenchmark suggested — so
+    the next capture A/Bs this knob before any default flips."""
+    import os
+
+    forced = os.environ.get("ALAZ_EXPAND_DST", "")
+    if forced not in ("", "xla", "pallas"):
+        # a typo'd A/B run must not silently measure the default path
+        # under the override's label
+        raise ValueError(
+            f"ALAZ_EXPAND_DST={forced!r}: must be 'xla' or 'pallas'"
+        )
+    if forced == "xla":
+        return v[segment_ids]
+    if (forced == "pallas") or pallas_enabled(use_pallas):
         from alaz_tpu.ops.pallas_segment import segment_expand_sorted
 
         return segment_expand_sorted(v, segment_ids, num_segments)

@@ -153,9 +153,18 @@ class TpuEnvCollector:
                     log.debug(f"metric {name}: {exc}")
                     continue
                 per_dev: Dict[int, float] = {}
-                for attrs, value in parse_metric_response(body):
-                    dev = attrs.get("device-id", attrs.get("device_id", 0))
-                    per_dev[int(dev) if isinstance(dev, int) else 0] = value
+                for idx, (attrs, value) in enumerate(parse_metric_response(body)):
+                    dev = attrs.get("device-id", attrs.get("device_id", idx))
+                    try:
+                        key = int(str(dev))
+                    except ValueError:
+                        # non-numeric id (e.g. "pci:0000:05"): fall back
+                        # to a NEGATIVE enumeration key — distinct per
+                        # record but outside the real device-id range, so
+                        # it can never clobber a parsed id in the same
+                        # response
+                        key = -(idx + 1)
+                    per_dev[key] = value
                 if per_dev:
                     out[name] = per_dev
         finally:

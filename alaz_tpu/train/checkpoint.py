@@ -20,7 +20,14 @@ log = get_logger("alaz_tpu.checkpoint")
 # v2: edge-type embeddings moved into edge-feature one-hot slots 7..15
 # (type_emb removed; edge_proj rows 7..15 now carry learned type offsets)
 # — restoring a v1 checkpoint would silently inject untrained weights.
-SCHEMA_VERSION = 2
+# v3: edge_feat_znorm=True default appends EDGE_STAT_COLS z-scored
+# columns, widening edge_head/edge_proj inputs from edge_feature_dim to
+# edge_feat_dim_in — a v2 checkpoint would fail with a dot-dimension
+# error only at jit trace time in serve. EDGE_FEAT_ZNORM=0 rebuilds the
+# v2-width model, but the version gate still refuses the cross-load
+# (params trained with one input representation score garbage under the
+# other).
+SCHEMA_VERSION = 3
 
 
 def _manager(directory: str | Path, max_to_keep: int = 3):
@@ -32,6 +39,25 @@ def _manager(directory: str | Path, max_to_keep: int = 3):
     )
 
 
+def feature_contract(model_cfg) -> dict:
+    """The shape-determining facts a checkpoint's params are only valid
+    under. SCHEMA_VERSION gates code-level contract changes; this gates
+    CONFIG-level ones — every ModelConfig.from_env knob that changes a
+    param shape (MODEL, HIDDEN_DIM, NUM_LAYERS, EDGE_FEAT_ZNORM) so a
+    mismatched serve fails at restore with the fix named, not at jit
+    trace with a dot-dimension error. Values must be ints (orbax state
+    is numeric): the model name rides as a stable crc32."""
+    import zlib
+
+    return {
+        "model_crc": zlib.crc32(model_cfg.model.encode()),
+        "hidden_dim": int(model_cfg.hidden_dim),
+        "num_layers": int(model_cfg.num_layers),
+        "edge_feat_dim_in": int(model_cfg.edge_feat_dim_in),
+        "edge_feat_znorm": bool(model_cfg.edge_feat_znorm),
+    }
+
+
 def save(
     directory: str | Path,
     step: int,
@@ -39,10 +65,15 @@ def save(
     opt_state: Any = None,
     memory: Any = None,
     max_to_keep: int = 3,
+    contract: dict | None = None,
 ) -> None:
     import orbax.checkpoint as ocp
 
     state = {"params": params, "schema_version": np.int64(SCHEMA_VERSION)}
+    if contract:
+        state["contract"] = {
+            k: np.int64(v) for k, v in sorted(contract.items())
+        }
     if opt_state is not None:
         state["opt_state"] = opt_state
     if memory is not None:
@@ -53,8 +84,17 @@ def save(
     mgr.close()
 
 
-def restore(directory: str | Path, step: Optional[int] = None) -> tuple[int, dict]:
-    """→ (step, state dict). Raises FileNotFoundError when no checkpoint."""
+def restore(
+    directory: str | Path,
+    step: Optional[int] = None,
+    expect_contract: dict | None = None,
+) -> tuple[int, dict]:
+    """→ (step, state dict). Raises FileNotFoundError when no checkpoint.
+
+    ``expect_contract`` (see :func:`feature_contract`) rejects a
+    checkpoint whose saved input representation disagrees with the live
+    config — the failure otherwise surfaces as a cryptic dot-dimension
+    error at jit trace time in serve."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory)
@@ -72,6 +112,18 @@ def restore(directory: str | Path, step: Optional[int] = None) -> tuple[int, dic
                 "changed — retrain or convert; restoring would silently "
                 "degrade scores)"
             )
+        saved_contract = {
+            k: int(v) for k, v in (state.pop("contract", None) or {}).items()
+        }
+        if expect_contract is not None and saved_contract:
+            want = {k: int(v) for k, v in sorted(expect_contract.items())}
+            if saved_contract != want:
+                raise ValueError(
+                    f"checkpoint {directory} was trained under feature "
+                    f"contract {saved_contract}, this process runs "
+                    f"{want} (EDGE_FEAT_ZNORM or feature widths differ "
+                    "— retrain, or set the env to match the checkpoint)"
+                )
         return int(target), state
     finally:
         mgr.close()

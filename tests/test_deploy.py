@@ -74,7 +74,17 @@ class TestMakefile:
         assert "image-multiarch:" in mk
         assert "docker buildx build" in mk
         assert "linux/amd64,linux/arm64" in mk
-        assert "JAX_VARIANT=cpu" in mk
+
+    def test_dockerfile_selects_jax_variant_per_arch(self):
+        # the amd64 layer of a multi-arch build must stay TPU-capable:
+        # the variant comes from TARGETARCH (tpu on amd64, cpu on arm64)
+        # unless explicitly overridden, so the Makefile must NOT pin a
+        # global JAX_VARIANT that would clobber it
+        df = (REPO / "Dockerfile").read_text()
+        assert "ARG TARGETARCH" in df
+        assert '[ "$TARGETARCH" = "amd64" ] && echo tpu || echo cpu' in df
+        mk = self._mk()
+        assert "--build-arg JAX_VARIANT" not in mk
 
     def test_native_target_drives_the_builder_stage_products(self):
         mk = self._mk()

@@ -198,6 +198,48 @@ class TestCheckpoint:
         checkpoint.save(tmp_path / "c", step=2, params=params)
         assert checkpoint.latest_step(tmp_path / "c") == 2
 
+    def test_schema_version_gate_refuses_old_checkpoint(self, tmp_path):
+        from alaz_tpu.train import checkpoint
+
+        checkpoint.save(tmp_path / "c", step=1, params={"w": np.ones(3)})
+        old = checkpoint.SCHEMA_VERSION
+        try:
+            checkpoint.SCHEMA_VERSION = old + 1
+            with pytest.raises(ValueError, match="schema"):
+                checkpoint.restore(tmp_path / "c")
+        finally:
+            checkpoint.SCHEMA_VERSION = old
+
+    def test_feature_contract_gate(self, tmp_path):
+        # EDGE_FEAT_ZNORM is an env knob: two builds at the SAME schema
+        # version can disagree on edge-head input width. The contract
+        # saved alongside params must refuse the cross-load instead of
+        # letting serve die with a dot-dimension error at jit trace.
+        from alaz_tpu.train import checkpoint
+
+        cfg_on = ModelConfig(edge_feat_znorm=True)
+        cfg_off = ModelConfig(edge_feat_znorm=False)
+        assert cfg_on.edge_feat_dim_in > cfg_off.edge_feat_dim_in
+        checkpoint.save(
+            tmp_path / "c", step=1, params={"w": np.ones(3)},
+            contract=checkpoint.feature_contract(cfg_off),
+        )
+        step, _ = checkpoint.restore(
+            tmp_path / "c", expect_contract=checkpoint.feature_contract(cfg_off)
+        )
+        assert step == 1
+        with pytest.raises(ValueError, match="feature\\s+contract"):
+            checkpoint.restore(
+                tmp_path / "c",
+                expect_contract=checkpoint.feature_contract(cfg_on),
+            )
+        # contract-less (legacy) checkpoints restore without false refusal
+        checkpoint.save(tmp_path / "d", step=2, params={"w": np.ones(3)})
+        step, _ = checkpoint.restore(
+            tmp_path / "d", expect_contract=checkpoint.feature_contract(cfg_on)
+        )
+        assert step == 2
+
 
 class TestPauseGatesEverything:
     def test_all_submit_paths_respect_pause(self):

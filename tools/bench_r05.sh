@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-5 TPU capture: everything VERDICT r4 asked for, runnable the
+# moment the tunnel answers. SERIAL (two concurrent benches starve each
+# other). Each line lands in BENCH_MODELS_r05.json; a fresh trace lands
+# in traces/r05_graphsage.
+#
+#   bash tools/bench_r05.sh [out.json]
+#
+# Prereq: `python bench.py --direct --probe-only --watchdog-s 120`
+# answers. Every invocation below carries its own watchdog so a
+# mid-suite tunnel death costs one row, not the capture.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-BENCH_MODELS_r05.json}"
+: > "$OUT"
+
+run() { # run <label> <args...>
+  local label="$1"; shift
+  echo "== $label: python bench.py --direct --watchdog-s 420 $*" >&2
+  local line rc
+  # no pipe: $? after a `line=$(... | tail -1)` would be tail's rc
+  python bench.py --direct --watchdog-s 420 "$@" \
+    >/tmp/bench_r05_out.log 2>/tmp/bench_r05_err.log
+  rc=$?
+  line=$(tail -1 /tmp/bench_r05_out.log)
+  if [ -n "$line" ]; then
+    echo "$line" >> "$OUT"
+  else
+    echo "{\"metric\": \"$label\", \"value\": 0, \"error\": \"empty output rc=$rc\"}" >> "$OUT"
+  fi
+  tail -2 /tmp/bench_r05_err.log >&2 || true
+  date -u +"%Y-%m-%dT%H:%M:%SZ $label done" >&2
+}
+
+# headline first — bank the flagship number before anything exploratory
+run graphsage
+# §3d conclusion 3: is the 9.3ms/step gap per-dispatch overhead (rises
+# with K) or device idle (flat)?
+run iters50   --iters 50
+run iters100  --iters 100
+# §3d conclusion 2: pallas sorted-expand vs in-graph XLA gather at F=128
+# (subshell: `VAR=x fn` would leak the var into later runs in bash)
+( export ALAZ_EXPAND_DST=xla; run expand-xla )
+# per-model rows (BASELINE configs 3/4 evidence)
+run gat      --model gat
+run experts  --model experts
+run tgn      --model tgn
+# full-pipeline ingest->score rows/s (VERDICT task 6 target >=1M)
+run e2e      --e2e
+# locality study + the banded hybrid's first post-redesign TPU row
+# (VERDICT task 4: beat the 27.1M XLA row on the same layout or delete)
+run layout-community        --structure community --layout random
+run layout-clustered        --structure community --layout clustered
+run layout-clustered-banded --structure community --layout clustered --src-gather banded
+# fresh trace for §3d confirmation
+mkdir -p traces
+run profile  --profile traces/r05_graphsage --iters 5 --repeats 1
+
+echo "--- $OUT ---"
+cat "$OUT"

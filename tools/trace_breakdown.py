@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Per-op step-time breakdown from a committed xplane/trace.json capture.
+
+Parses the Chrome-trace JSON that `jax.profiler.trace` writes next to the
+xplane pb (vm.trace.json.gz), groups device ops by HLO name, attributes
+each to the repo source line XLA recorded, and prints a per-iteration
+table: the fori_loop body runs K times per dispatch, so ops with n == K
+are per-step and ops with n == 1 are one-time prologue (e.g. the dst
+sort bench pays because _example_batch synthesizes unsorted edges — the
+serve path gets dst-sorted COO from native ingest for free).
+
+Usage:
+    python tools/trace_breakdown.py \
+        traces/r03_graphsage/plugins/profile/*/vm.trace.json.gz
+
+The r03 numbers this printed are committed as ARCHITECTURE.md §3d.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", [])
+
+
+def device_pid(events: list[dict]) -> int | None:
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "TPU" in e["args"].get("name", ""):
+                return e["pid"]
+    return None
+
+
+def breakdown(events: list[dict]) -> None:
+    pid = device_pid(events)
+    if pid is None:
+        print("no TPU device process in trace", file=sys.stderr)
+        raise SystemExit(1)
+    # tid for 'XLA Ops' (the op-level rows; 'XLA Modules' is the whole
+    # executable, 'Async XLA Ops' DMAs)
+    tids = {
+        e["tid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name" and e.get("pid") == pid
+    }
+    op_tid = next((t for t, n in tids.items() if n == "XLA Ops"), None)
+    ops = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("pid") == pid and e.get("tid") == op_tid
+    ]
+    agg: dict[str, dict] = {}
+    wrapper_ms = 0.0
+    for e in ops:
+        a = e.get("args", {})
+        cat = a.get("hlo_category", "")
+        # the outer while is the loop wrapper: its duration IS the whole
+        # body; counting it alongside its children double-books
+        if cat == "while" and e.get("dur", 0) > 1e4:
+            wrapper_ms = max(wrapper_ms, e["dur"] / 1e3)
+            continue
+        r = agg.setdefault(
+            e["name"],
+            {"n": 0, "ms": 0.0, "src": a.get("source", ""), "cat": cat},
+        )
+        r["n"] += 1
+        r["ms"] += e.get("dur", 0) / 1e3
+    if not agg:
+        print("no XLA ops found", file=sys.stderr)
+        raise SystemExit(1)
+    # per-step count = the mode of n across ops (the loop trip count)
+    k = collections.Counter(r["n"] for r in agg.values()).most_common(1)[0][0]
+    print(f"loop trip count K={k}; while-body wall {wrapper_ms:.3f}ms "
+          f"({wrapper_ms / k:.3f}ms/step)")
+    per_step = [(n, r) for n, r in agg.items() if r["n"] % k == 0]
+    prologue = [(n, r) for n, r in agg.items() if r["n"] % k != 0]
+    print(f"\nPER-STEP ops (n divisible by {k}):")
+    tot = 0.0
+    for name, r in sorted(per_step, key=lambda kv: -kv[1]["ms"]):
+        ms = r["ms"] / k
+        tot += ms
+        if ms >= 0.005:
+            print(f"  {ms:8.3f}ms  {name[:28]:28s} {r['cat'][:20]:20s} {r['src']}")
+    print(f"  {tot:8.3f}ms  TOTAL per step")
+    print("\nONE-TIME prologue (per dispatch, amortized /K in bench):")
+    ptot = 0.0
+    for name, r in sorted(prologue, key=lambda kv: -kv[1]["ms"]):
+        ptot += r["ms"]
+        if r["ms"] >= 0.05:
+            print(f"  {r['ms']:8.3f}ms  {name[:28]:28s} {r['cat'][:20]:20s} {r['src']}")
+    print(f"  {ptot:8.3f}ms  TOTAL prologue")
+
+
+if __name__ == "__main__":
+    pats = sys.argv[1:] or [
+        "traces/r03_graphsage/plugins/profile/*/vm.trace.json.gz"
+    ]
+    paths = [p for pat in pats for p in sorted(glob.glob(pat))]
+    if not paths:
+        print(f"no trace matches {pats}", file=sys.stderr)
+        raise SystemExit(1)
+    breakdown(load_events(paths[0]))
