@@ -154,15 +154,16 @@ class TpuEnvCollector:
                     continue
                 per_dev: Dict[int, float] = {}
                 for idx, (attrs, value) in enumerate(parse_metric_response(body)):
-                    dev = attrs.get("device-id", attrs.get("device_id", idx))
+                    dev = attrs.get("device-id", attrs.get("device_id"))
                     try:
                         key = int(str(dev))
                     except ValueError:
-                        # non-numeric id (e.g. "pci:0000:05"): fall back
-                        # to a NEGATIVE enumeration key — distinct per
-                        # record but outside the real device-id range, so
-                        # it can never clobber a parsed id in the same
-                        # response
+                        # non-numeric ("pci:0000:05") or MISSING id: fall
+                        # back to a NEGATIVE enumeration key — distinct
+                        # per record but outside the real device-id
+                        # range, so it can never clobber a parsed id in
+                        # the same response (a missing id maps through
+                        # int(str(None)) → ValueError → here)
                         key = -(idx + 1)
                     per_dev[key] = value
                 if per_dev:

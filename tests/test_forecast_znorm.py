@@ -1,0 +1,212 @@
+"""The round-4 forecast surface: ramp labeling, z-scored edge features,
+multi-sequence TGN training, and the one-command eval artifact path.
+
+Reference analog: the forecasting leg is BASELINE config 4; the test
+strategy mirrors main_benchmark_test.go's "assert against the live
+stack" discipline — every invariant here was previously only implicit
+in the committed EVAL numbers (VERDICT r4 weak #3)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from alaz_tpu.config import ModelConfig, SimulationConfig
+from alaz_tpu.datastore.dto import make_requests
+from alaz_tpu.models.common import EDGE_STAT_COLS, znorm_edge_feats
+from alaz_tpu.replay import faults
+from alaz_tpu.replay.scenario import run_forecast_scenario
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY_SIM = SimulationConfig(
+    test_duration_s=0.5, pod_count=30, service_count=10, edge_count=12,
+    edge_rate=2_000, chunk_size=2_048, seed=3,
+)
+
+
+class TestRampLabeling:
+    """inject() on a ramped edge: rows are faulty iff their own-time
+    multiplier has crossed SPIKE_THRESHOLD (faults.py ramp branch)."""
+
+    def _rows_for_pair(self, fu, tu, times_ms):
+        rows = make_requests(len(times_ms))
+        rows["from_uid"], rows["to_uid"] = fu, tu
+        rows["start_time_ms"] = np.asarray(times_ms, np.int64)
+        rows["latency_ns"] = 10_000
+        rows["completed"] = True
+        rows["status_code"] = 200
+        return rows
+
+    def test_rows_below_and_above_threshold_get_0_and_1(self):
+        plan = faults.FaultPlan()
+        plan.edges[(7, 9)] = faults.LATENCY_SPIKE
+        # onset t=0, span 4000ms, full 12x: multiplier(t) = 1 + 11*t/4000
+        # crosses SPIKE_THRESHOLD=4.0 at t = 3/11*4000 ≈ 1090.9ms
+        plan.ramps[(7, 9)] = (0, 4000, 12.0)
+        t_cross = 3.0 / 11.0 * 4000.0
+        times = [0, int(t_cross) - 200, int(t_cross) + 200, 4000, 8000]
+        rows = self._rows_for_pair(7, 9, times)
+        base_latency = rows["latency_ns"].copy()
+        labels = faults.inject(rows, plan, np.random.default_rng(0))
+        np.testing.assert_array_equal(labels, [0.0, 0.0, 1.0, 1.0, 1.0])
+        # the pre-threshold row still DRIFTS (the leading indicator the
+        # forecast model reads) even though its label is 0
+        assert rows["latency_ns"][1] > base_latency[1]
+        # multiplier saturates at full_mult past the span
+        assert rows["latency_ns"][4] > rows["latency_ns"][2]
+
+    def test_unramped_edges_and_other_pairs_untouched(self):
+        plan = faults.FaultPlan()
+        plan.edges[(7, 9)] = faults.LATENCY_SPIKE
+        plan.ramps[(7, 9)] = (0, 4000, 12.0)
+        rows = self._rows_for_pair(1, 2, [0, 2000, 8000])
+        labels = faults.inject(rows, plan, np.random.default_rng(0))
+        np.testing.assert_array_equal(labels, 0.0)
+        np.testing.assert_array_equal(rows["latency_ns"], 10_000)
+
+    def test_ramp_multiplier_clamps_to_support(self):
+        plan = faults.FaultPlan()
+        plan.ramps[(1, 2)] = (1000, 2000, 5.0)
+        m = plan.ramp_multiplier((1, 2), [0, 1000, 2000, 3000, 99_000])
+        np.testing.assert_allclose(m, [1.0, 1.0, 3.0, 5.0, 5.0])
+
+
+class TestZnormEdgeFeats:
+    def test_output_width_is_edge_feat_dim_in(self):
+        cfg = ModelConfig()
+        ef = jnp.ones((64, cfg.edge_feature_dim), jnp.float32)
+        out = znorm_edge_feats(ef, jnp.ones(64))
+        assert out.shape == (64, cfg.edge_feat_dim_in)
+        assert cfg.edge_feat_dim_in == cfg.edge_feature_dim + EDGE_STAT_COLS
+
+    def test_f32_stats_under_bf16_inputs(self):
+        # 4096 bf16 ones would stagnate at 256 if summed in bf16
+        # (ARCHITECTURE §3c's precision rule); a correct f32 accumulation
+        # gives exact mean 1.0 → z == 0 for a constant column
+        e = 4096
+        ef = jnp.ones((e, 16), jnp.bfloat16)
+        out = np.asarray(znorm_edge_feats(ef, jnp.ones(e)), np.float32)
+        np.testing.assert_allclose(out[:, 16:], 0.0, atol=1e-3)
+
+    def test_padded_edges_z_forced_to_zero_and_excluded_from_stats(self):
+        rng = np.random.default_rng(0)
+        real = rng.normal(2.0, 1.0, (100, 16)).astype(np.float32)
+        ef = np.concatenate([real, np.full((28, 16), 1e6, np.float32)])
+        mask = np.concatenate([np.ones(100), np.zeros(28)])
+        out = np.asarray(znorm_edge_feats(jnp.asarray(ef), jnp.asarray(mask)))
+        # pad rows: z exactly 0
+        np.testing.assert_array_equal(out[100:, 16:], 0.0)
+        # stats came from the REAL rows only: z of real rows is standard
+        z = out[:100, 16:]
+        assert abs(z.mean()) < 0.15 and 0.7 < z.std() < 1.3
+
+    def test_sharded_psum_matches_single_device(self):
+        # fleet-baseline stats are a global reduction: computing them
+        # per-shard with axis=psum must equal the unsharded call
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs), ("x",))
+        e = 256
+        rng = np.random.default_rng(1)
+        ef = rng.normal(0, 1, (e, 16)).astype(np.float32)
+        mask = (rng.random(e) > 0.2).astype(np.float32)
+        want = np.asarray(znorm_edge_feats(jnp.asarray(ef), jnp.asarray(mask)))
+
+        shard_fn = jax.jit(
+            jax.shard_map(
+                lambda a, m: znorm_edge_feats(a, m, axis="x"),
+                mesh=mesh,
+                in_specs=(P("x"), P("x")),
+                out_specs=P("x"),
+            )
+        )
+        got = np.asarray(
+            jax.device_get(
+                shard_fn(
+                    jax.device_put(ef, NamedSharding(mesh, P("x"))),
+                    jax.device_put(mask, NamedSharding(mesh, P("x"))),
+                )
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+class TestMultiSequenceTgnTraining:
+    def _seqs(self, n, seed0=0, windows=4):
+        return [
+            run_forecast_scenario(
+                TINY_SIM, n_windows=windows, fault_fraction=0.3, seed=seed0 + s
+            ).all_batches
+            for s in range(n)
+        ]
+
+    def test_forecast_scenario_carries_edge_label_next(self):
+        seq = self._seqs(1)[0]
+        assert all(hasattr(b, "edge_label_next") for b in seq)
+        # ramps make labels evolve: at least one batch's next-window
+        # label differs from its current label
+        assert any(
+            not np.array_equal(b.edge_label, b.edge_label_next) for b in seq
+        )
+
+    def test_accepts_multiple_sequences_and_they_matter(self):
+        from alaz_tpu.train.trainstep import train_tgn_unrolled
+
+        cfg = ModelConfig(model="tgn", hidden_dim=32, tgn_max_nodes=256)
+        two = self._seqs(2)
+        state_multi, losses_multi = train_tgn_unrolled(
+            cfg, two, epochs=2, seed=0, label_attr="edge_label_next"
+        )
+        state_single, _ = train_tgn_unrolled(
+            cfg, two[0], epochs=2, seed=0, label_attr="edge_label_next"
+        )
+        assert len(losses_multi) == 2 and np.isfinite(losses_multi).all()
+        # a second fault draw must change the gradient signal (the
+        # anti-memorization property the docstring promises)
+        diffs = [
+            float(np.abs(np.asarray(a) - np.asarray(b)).max())
+            for a, b in zip(
+                jax.tree.leaves(state_multi.params),
+                jax.tree.leaves(state_single.params),
+            )
+        ]
+        assert max(diffs) > 0
+
+
+@pytest.mark.slow
+class TestEvalSmoke:
+    def test_cmd_eval_tiny_end_to_end(self, tmp_path):
+        """The one-command quality artifact stays runnable: 2 windows,
+        1 epoch, one model + the forecast leg, JSON lands on disk."""
+        out = tmp_path / "eval.json"
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "alaz_tpu", "eval",
+                "--config", "testconfig/config2_1k_pods.json",
+                "--forecast-config", "testconfig/config2_1k_pods.json",
+                "--models", "graphsage",
+                "--windows", "3", "--forecast-windows", "6",
+                "--epochs", "1", "--out", str(out),
+            ],
+            cwd=REPO,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+            capture_output=True, text=True, timeout=900,
+        )
+        # rc 1 == the ≥0.9 quality gate voting "fail" at smoke scale
+        # (1 epoch); anything else is a crash. The smoke asserts the
+        # artifact path, not the quality bar (EVAL_rN.json does that).
+        assert r.returncode in (0, 1), r.stderr[-2000:]
+        doc = json.loads(out.read_text())
+        models = {row["model"]: row for row in doc["results"]}
+        assert "graphsage" in models and 0.0 <= models["graphsage"]["auroc"] <= 1.0
+        assert "forecast_auroc" in doc["forecast"]

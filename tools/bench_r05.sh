@@ -45,8 +45,11 @@ run iters100  --iters 100
 run gat      --model gat
 run experts  --model experts
 run tgn      --model tgn
-# full-pipeline ingest->score rows/s (VERDICT task 6 target >=1M)
-run e2e      --e2e
+# full-pipeline ingest->score rows/s (VERDICT task 6 target >=1M):
+# unbatched, then micro-batched (ARCHITECTURE §3e predicts batch4
+# amortizes the ~190ms/dispatch relay overhead and crosses 1M)
+run e2e        --e2e
+run e2e-batch4 --e2e --e2e-batch 4
 # locality study + the banded hybrid's first post-redesign TPU row
 # (VERDICT task 4: beat the 27.1M XLA row on the same layout or delete)
 run layout-community        --structure community --layout random

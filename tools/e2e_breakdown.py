@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Host/device breakdown of the e2e ingest→score pipe (VERDICT r4 #6).
+
+Same flow as bench.py bench_e2e (REQUEST rows → native windowed ingest →
+graph assembly → jit'd scoring) but with per-stage host timers:
+
+  push     alz_push into the SPSC ring + windowed accumulators (C++)
+  poll     window close: counting-sort COO + feature export (C++) +
+           GraphBatch wrap (python)
+  h2d      jnp.asarray of the exported arrays (host→device transfer)
+  dispatch jit dispatch of the score fn (async — returns immediately)
+  drain    final block_until_ready (device catches up with the host)
+
+On CPU the "device" shares the host, so drain ≈ device compute; on TPU
+drain is whatever the device hadn't overlapped. The host stages are
+TPU-independent — this is the CPU-side profile the round-4 verdict asked
+for. Prints one JSON line.
+
+Usage: JAX_PLATFORMS=cpu python tools/e2e_breakdown.py [--rows N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=1_048_576)
+    p.add_argument("--pods", type=int, default=100_000)
+    p.add_argument("--svcs", type=int, default=10_000)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--windows", type=int, default=4)
+    p.add_argument("--chunk", type=int, default=1 << 16)
+    args = p.parse_args()
+
+    import numpy as np
+
+    # honor JAX_PLATFORMS BEFORE any device query: the site plugin
+    # force-registers the accelerator backend, and a dead tunnel hangs
+    # the first device query of any process that doesn't pin cpu first
+    from alaz_tpu.__main__ import _honor_jax_platforms
+
+    _honor_jax_platforms()
+    import jax
+    import jax.numpy as jnp
+
+    from alaz_tpu.config import ModelConfig
+    from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+    from alaz_tpu.graph import native
+    from alaz_tpu.models.registry import get_model
+
+    if not native.available():
+        print(json.dumps({"error": "libalaz_ingest.so unavailable"}))
+        return
+
+    cfg = ModelConfig(model="graphsage", hidden_dim=args.hidden, num_layers=2)
+    init, apply = get_model(cfg.model)
+    params = init(jax.random.PRNGKey(0), cfg)
+    score = jax.jit(lambda p, g: apply(p, g, cfg)["edge_logits"])
+
+    rng = np.random.default_rng(0)
+    n_rows = args.rows
+    rows = make_requests(n_rows)
+    rows["from_uid"] = rng.integers(1, args.pods, n_rows)
+    rows["to_uid"] = rng.integers(args.pods, args.pods + args.svcs, n_rows)
+    rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
+    rows["protocol"] = rng.integers(1, 9, n_rows)
+    rows["latency_ns"] = rng.integers(1000, 100000, n_rows)
+    rows["status_code"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
+    rows["completed"] = True
+    rows["start_time_ms"] = 1000 + (np.arange(n_rows) * args.windows // n_rows) * 1000
+
+    def run_once() -> dict:
+        t = dict(push=0.0, poll=0.0, h2d=0.0, dispatch=0.0, drain=0.0)
+        ni = native.NativeIngest(window_s=1.0, ring_capacity=1 << 21)
+        last = None
+        scored = 0
+        t_all = time.perf_counter()
+        for i in range(0, n_rows, args.chunk):
+            t0 = time.perf_counter()
+            ni.push(rows[i : i + args.chunk])
+            t["push"] += time.perf_counter() - t0
+            while True:
+                t0 = time.perf_counter()
+                b = ni.poll()
+                t["poll"] += time.perf_counter() - t0
+                if b is None:
+                    break
+                t0 = time.perf_counter()
+                g = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+                t["h2d"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                last = score(params, g)
+                t["dispatch"] += time.perf_counter() - t0
+                scored += int(last.shape[0])
+        for b in ni.flush():
+            t0 = time.perf_counter()
+            g = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+            t["h2d"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            last = score(params, g)
+            t["dispatch"] += time.perf_counter() - t0
+            scored += int(last.shape[0])
+        if last is not None:
+            t0 = time.perf_counter()
+            jax.block_until_ready(last)
+            t["drain"] += time.perf_counter() - t0
+        ni.close()
+        t["wall"] = time.perf_counter() - t_all
+        t["scored"] = scored
+        return t
+
+    run_once()  # warm compiles for every bucket
+    best = min((run_once() for _ in range(3)), key=lambda r: r["wall"])
+    host = best["push"] + best["poll"] + best["h2d"] + best["dispatch"]
+    out = {
+        "metric": "e2e_breakdown_rows_per_sec",
+        "value": round(n_rows / best["wall"]),
+        "unit": "rows/s",
+        "backend": jax.default_backend(),
+        "wall_ms": round(best["wall"] * 1e3, 1),
+        "host_ms": {
+            k: round(best[k] * 1e3, 1)
+            for k in ("push", "poll", "h2d", "dispatch")
+        },
+        "drain_ms": round(best["drain"] * 1e3, 1),
+        "host_share": round(host / best["wall"], 3),
+        "rows": n_rows,
+        "scored": best["scored"],
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -107,4 +107,8 @@ if __name__ == "__main__":
     if not paths:
         print(f"no trace matches {pats}", file=sys.stderr)
         raise SystemExit(1)
+    if len(paths) > 1:
+        print(f"{len(paths)} traces match; analyzing the first — "
+              f"skipping: {paths[1:]}", file=sys.stderr)
+    print(f"trace: {paths[0]}")
     breakdown(load_events(paths[0]))
