@@ -298,6 +298,13 @@ class RuntimeConfig:
     # stalled rows as late when they arrive — size this above the longest
     # stall worth riding out, not at the window length.
     idle_flush_grace_s: float = 30.0
+    # scorer backlog micro-batching: when >1 and the model is
+    # window-independent (not tgn), up to this many ALREADY-QUEUED
+    # same-bucket windows are stacked and scored through one vmapped
+    # dispatch — zero added latency when current (only a backlog
+    # batches), amortized dispatch overhead when behind
+    # (ARCHITECTURE §3e's measured ~190 ms/dispatch through the relay)
+    score_batch_windows: int = 1
 
     @classmethod
     def from_env(cls) -> "RuntimeConfig":
@@ -317,4 +324,5 @@ class RuntimeConfig:
             proc_root=env_str("PROC_ROOT", "/proc"),
             renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
+            score_batch_windows=env_int("SCORE_BATCH_WINDOWS", 1),
         )

@@ -199,6 +199,25 @@ class Service:
                 from alaz_tpu.train.trainstep import make_score_fn
 
                 self._score_fn = make_score_fn(self.config.model)
+        # backlog micro-batching (config.score_batch_windows): vmapped
+        # twin of the score fn for window-independent models. TGN is
+        # excluded — its memory threads sequentially through windows.
+        self._score_many_fn = None
+        self._batch_windows = max(1, int(self.config.score_batch_windows))
+        if (
+            self._score_fn is not None
+            and self._batch_windows > 1
+            and self.config.model.model != "tgn"
+        ):
+            import jax
+
+            from alaz_tpu.models.registry import get_model as _get_model
+
+            _, _apply = _get_model(self.config.model.model)
+            _mcfg = self.config.model
+            self._score_many_fn = jax.jit(
+                jax.vmap(lambda p, g: _apply(p, g, _mcfg), in_axes=(None, 0))
+            )
 
         self.housekeeping_interval_s = 120.0  # reference ticker cadence
         self.scored_batches = 0
@@ -387,17 +406,81 @@ class Service:
             finally:
                 self.window_queue.task_done()
 
+        def score_group(batches) -> None:
+            """Score same-bucket windows through ONE vmapped dispatch;
+            settles every window's task_done (even when the host→device
+            transfer itself raises — the same accounting guarantee the
+            serial path's try/except gives a single window). Only ever
+            called with an already-queued backlog, so it adds no latency
+            over scoring them serially — it removes per-dispatch
+            overhead (ARCHITECTURE §3e)."""
+            try:
+                t0 = time_module.perf_counter()
+                cols = [b.device_arrays() for b in batches]
+                stacked = {
+                    k: jnp.asarray(np.stack([c[k] for c in cols]))
+                    for k in cols[0]
+                }
+                out = self._score_many_fn(self.model_state, stacked)
+                logits = np.asarray(out["edge_logits"])
+                if "attn_clamp_saturation" in out:
+                    self.metrics.gauge("model.attn_clamp_saturation").set(
+                        float(np.max(np.asarray(out["attn_clamp_saturation"])))
+                    )
+                self._scorer_busy_s += time_module.perf_counter() - t0
+                for i, batch in enumerate(batches):
+                    self.scored_batches += 1
+                    self.scored_edges += batch.n_edges
+                    self.metrics.counter("scored.edges").inc(batch.n_edges)
+                    if self.score_sink is not None:
+                        annotated = self._annotate(batch, logits[i])
+                        if len(annotated):
+                            self.score_sink(annotated)
+            finally:
+                for _ in batches:
+                    self.window_queue.task_done()
+
+        # carry: a popped window whose bucket broke a micro-batch group;
+        # it owes a task_done until scored or the worker dies
+        carry: Optional[GraphBatch] = None
         try:
             while not self._stop.is_set():
-                item = self.window_queue.get(timeout=0.05)
-                if item is None:
-                    if staged is not None:  # idle: don't hold a window
-                        prev, staged = staged, None
-                        score_one(*prev)
-                    continue
-                (batch,) = item
+                if carry is not None:
+                    batch, carry = carry, None
+                else:
+                    item = self.window_queue.get(timeout=0.05)
+                    if item is None:
+                        if staged is not None:  # idle: don't hold a window
+                            prev, staged = staged, None
+                            score_one(*prev)
+                        continue
+                    (batch,) = item
                 if self._score_fn is None or self.model_state is None:
                     self.window_queue.task_done()
+                    continue
+                # backlog micro-batching (config.score_batch_windows):
+                # drain ALREADY-QUEUED same-bucket windows — a current
+                # scorer finds none (group of 1) and keeps the serial
+                # path's double-buffered staging; a backlog collapses
+                # into one vmapped dispatch
+                group = [batch]
+                if self._score_many_fn is not None:
+                    key = (batch.n_pad, batch.e_pad)
+                    while len(group) < self._batch_windows:
+                        nxt = self.window_queue.get(timeout=0)
+                        if nxt is None:
+                            break
+                        (b2,) = nxt
+                        if (b2.n_pad, b2.e_pad) != key:
+                            carry = b2  # scored next iteration
+                            break
+                        group.append(b2)
+                if len(group) > 1:
+                    # FIFO: the staged (older) window scores first
+                    if staged is not None:
+                        prev, staged = staged, None
+                        score_one(*prev)
+                    score_group(group)
                     continue
                 try:
                     t0 = time_module.perf_counter()
@@ -416,9 +499,12 @@ class Service:
                 prev, staged = staged, None
                 score_one(*prev)
         finally:
-            # worker dying (or stopping) with a window still staged:
-            # settle its accounting so drain() doesn't burn its timeout
+            # worker dying (or stopping) with a window still staged or
+            # carried: settle its accounting so drain() doesn't burn its
+            # timeout
             if staged is not None:
+                self.window_queue.task_done()
+            if carry is not None:
                 self.window_queue.task_done()
 
     def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> ScoreBatch:

@@ -184,6 +184,28 @@ def bench_model(args) -> dict:
     }
 
 
+def make_e2e_rows(n_rows: int, pods: int, svcs: int, windows: int = 4, seed: int = 0):
+    """The e2e bench's synthetic REQUEST workload — ONE definition shared
+    with tools/e2e_breakdown.py, whose host-stage numbers are subtracted
+    from this bench's TPU numbers (ARCHITECTURE §3e): the comparison is
+    only valid if both drive the identical row stream."""
+    import numpy as np
+
+    from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+
+    rng = np.random.default_rng(seed)
+    rows = make_requests(n_rows)
+    rows["from_uid"] = rng.integers(1, pods, n_rows)
+    rows["to_uid"] = rng.integers(pods, pods + svcs, n_rows)
+    rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
+    rows["protocol"] = rng.integers(1, 9, n_rows)
+    rows["latency_ns"] = rng.integers(1000, 100000, n_rows)
+    rows["status_code"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
+    rows["completed"] = True
+    rows["start_time_ms"] = 1000 + (np.arange(n_rows) * windows // n_rows) * 1000
+    return rows
+
+
 def bench_e2e(args) -> dict:
     """Full-system throughput: REQUEST rows → native windowed ingest →
     graph assembly → jit'd scoring, wall-clocked end to end (the
@@ -194,7 +216,6 @@ def bench_e2e(args) -> dict:
     import jax.numpy as jnp
 
     from alaz_tpu.config import ModelConfig
-    from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
     from alaz_tpu.graph import native
     from alaz_tpu.models.registry import get_model
 
@@ -215,18 +236,9 @@ def bench_e2e(args) -> dict:
     score_many = jax.jit(jax.vmap(lambda p, g: apply(p, g, cfg)["edge_logits"],
                                   in_axes=(None, 0)))
 
-    rng = np.random.default_rng(0)
     n_rows = args.edges  # one row per edge-event
     windows = 4
-    rows = make_requests(n_rows)
-    rows["from_uid"] = rng.integers(1, args.pods, n_rows)
-    rows["to_uid"] = rng.integers(args.pods, args.pods + args.svcs, n_rows)
-    rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
-    rows["protocol"] = rng.integers(1, 9, n_rows)
-    rows["latency_ns"] = rng.integers(1000, 100000, n_rows)
-    rows["status_code"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
-    rows["completed"] = True
-    rows["start_time_ms"] = 1000 + (np.arange(n_rows) * windows // n_rows) * 1000
+    rows = make_e2e_rows(n_rows, args.pods, args.svcs, windows)
 
     batch_w = max(1, args.e2e_batch)
 
@@ -523,11 +535,16 @@ def staged_main(args) -> int:
             probed = True
             break
         note(f"probe attempt {probe_attempts} failed: {diag}")
-        # a fast failure (refused transport) burns no real time — pace
-        # the loop so a dead tunnel is re-tested every ~60s, not hammered
+        # a fast failure (refused transport / spawn error) burns no real
+        # time — pace the loop so a dead tunnel is re-tested every ~60s,
+        # not hot-spun (which would also flood stages_log). Sleep or
+        # stop: a zero-cost iteration must never repeat unpaced.
         elapsed = time.perf_counter() - t_probe
-        if elapsed < 60.0 and remaining() - _probe_reserve >= 90.0:
-            time.sleep(min(60.0 - elapsed, remaining() - _probe_reserve - 30.0))
+        if elapsed < 60.0:
+            pause = min(60.0 - elapsed, remaining() - _probe_reserve - 1.0)
+            if pause <= 0.0:
+                break
+            time.sleep(pause)
     if not probed:
         note(
             ("accelerator never answered the probe; " if probe_attempts
@@ -586,7 +603,8 @@ def staged_main(args) -> int:
                 "value": 0,
                 "unit": unit,
                 "vs_baseline": 0.0,
-                "error": "no stage completed: " + "; ".join(stages_log),
+                # bounded: a long probe loop logs one entry per attempt
+                "error": "no stage completed: " + "; ".join(stages_log[-12:]),
             }
         ),
         flush=True,

@@ -1,0 +1,177 @@
+"""The staged bench orchestrator (bench.py staged_main) — the driver's
+only window into this project's performance. Its contract (docstring +
+main_benchmark_test.go:140-147 analog): ALWAYS print exactly one JSON
+line; probe across the whole budget; never escalate past a failing
+bucket; salvage late tunnel recoveries.
+
+Children are faked by monkeypatching bench._run_child — no jax, no
+subprocesses, and a fake clock removes the real sleeps, so the whole
+file runs in milliseconds."""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench
+
+
+class FakeClock:
+    """Replaces time.perf_counter + time.sleep inside bench: every probe
+    or stage 'costs' whatever the fake child charged, sleeps advance the
+    clock instantly."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def perf_counter(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += max(0.0, s)
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(bench.time, "perf_counter", c.perf_counter)
+    monkeypatch.setattr(bench.time, "sleep", c.sleep)
+    # transport diag does real TCP dials (1s timeout x 5 ports when
+    # nothing listens) — irrelevant here
+    monkeypatch.setattr(bench, "_transport_diag", lambda: "faked")
+    return c
+
+
+def make_args(**over):
+    defaults = dict(
+        model="graphsage", structure="uniform", layout="random",
+        src_gather="xla", hidden=128, pods=100_000, svcs=10_000,
+        iters=20, repeats=3, edges=1_048_576, e2e=False,
+        budget_s=840.0,
+    )
+    defaults.update(over)
+    return type("Args", (), defaults)()
+
+
+def run_staged(monkeypatch, capsys, child, **args_over):
+    """Run staged_main with ``child(extra, timeout_s, clock_t) ->
+    (cost_s, result, diag)`` faking _run_child; returns (rc, last JSON
+    line, stderr)."""
+    clock_ref = []
+
+    def fake_run_child(extra, timeout_s):
+        cost, res, diag = child(extra, timeout_s, bench.time.perf_counter())
+        bench.time.sleep(cost)  # advance the fake clock
+        return res, diag
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    rc = bench.staged_main(make_args(**args_over))
+    cap = capsys.readouterr()
+    line = [l for l in cap.out.strip().splitlines() if l.startswith("{")][-1]
+    return rc, json.loads(line), cap.err
+
+
+PROBE_OK = ({"probe": "ok", "backend": "tpu", "device": "v5e", "secs": 3.0}, "rc=0")
+
+
+class TestStagedMain:
+    def test_happy_path_upgrades_to_largest_bucket(self, clock, monkeypatch, capsys):
+        def child(extra, timeout_s, t):
+            if "--probe-only" in extra:
+                return 5.0, *PROBE_OK
+            edges = int(extra[extra.index("--edges") + 1])
+            return 30.0, {"metric": "m", "value": edges * 10, "unit": "edges/s"}, "rc=0"
+
+        rc, line, _ = run_staged(monkeypatch, capsys, child)
+        assert rc == 0
+        # the 1M bucket's number wins (stages upgrade the line)
+        assert line["value"] == 1_048_576 * 10
+
+    def test_dead_tunnel_probes_across_whole_budget_then_reports_zero(
+        self, clock, monkeypatch, capsys
+    ):
+        attempts = []
+
+        def child(extra, timeout_s, t):
+            if "--probe-only" in extra:
+                attempts.append(t)
+                return timeout_s, None, f"timeout after {timeout_s:.0f}s"
+            return timeout_s, None, f"timeout after {timeout_s:.0f}s"
+
+        rc, line, err = run_staged(monkeypatch, capsys, child)
+        assert rc == 3 and line["value"] == 0
+        # probing did NOT stop after two early attempts (the r4 failure
+        # mode): with a 840s budget and 150s probes it keeps going while
+        # reserve remains
+        assert len(attempts) >= 3
+        # the last probe started late in the budget, not in the first
+        # few minutes
+        assert attempts[-1] > 200.0
+        assert "error" in line and "probe attempt" in line["error"]
+
+    def test_late_recovery_still_lands_a_measurement(self, clock, monkeypatch, capsys):
+        """Tunnel answers only after t=400s: the probe loop must still be
+        alive, and the reserved budget must fit a real stage."""
+
+        def child(extra, timeout_s, t):
+            if "--probe-only" in extra:
+                if t < 400.0:
+                    return timeout_s, None, f"timeout after {timeout_s:.0f}s"
+                return 5.0, *PROBE_OK
+            edges = int(extra[extra.index("--edges") + 1])
+            return 100.0, {"metric": "m", "value": edges, "unit": "edges/s"}, "rc=0"
+
+        rc, line, _ = run_staged(monkeypatch, capsys, child)
+        assert rc == 0
+        assert line["value"] >= 131_072
+
+    def test_never_escalates_past_a_failing_bucket(self, clock, monkeypatch, capsys):
+        calls = []
+
+        def child(extra, timeout_s, t):
+            if "--probe-only" in extra:
+                return 5.0, *PROBE_OK
+            edges = int(extra[extra.index("--edges") + 1])
+            calls.append(edges)
+            if edges > 131_072:
+                return 50.0, None, "timeout"
+            return 20.0, {"metric": "m", "value": 7, "unit": "edges/s"}, "rc=0"
+
+        rc, line, _ = run_staged(monkeypatch, capsys, child)
+        # the 131k result is kept even though 1M failed (incl. one retry)
+        assert rc == 0 and line["value"] == 7
+        assert calls.count(131_072) == 1
+        assert 1 <= calls.count(1_048_576) <= 2
+        # docstring invariant: a failure never leads to a LARGER bucket
+        failed_at = calls.index(1_048_576)
+        assert all(e <= 1_048_576 for e in calls[failed_at:])
+
+    def test_small_budget_still_attempts_a_stage(self, clock, monkeypatch, capsys):
+        """Smoke-sized budgets (scaled reserve) must not starve stage 1 —
+        the regression caught when the reserve was a fixed 360s."""
+
+        def child(extra, timeout_s, t):
+            if "--probe-only" in extra:
+                return 2.0, *PROBE_OK
+            edges = int(extra[extra.index("--edges") + 1])
+            return 10.0, {"metric": "m", "value": edges, "unit": "edges/s"}, "rc=0"
+
+        rc, line, _ = run_staged(monkeypatch, capsys, child, budget_s=180.0, edges=8192)
+        assert rc == 0 and line["value"] == 8192
+
+    def test_always_exactly_one_json_line(self, clock, monkeypatch, capsys):
+        def child(extra, timeout_s, t):
+            return timeout_s, None, "spawn failed: boom"
+
+        monkeypatch.setattr(bench, "_run_child",
+                            lambda extra, t: (None, "spawn failed: boom"))
+        rc = bench.staged_main(make_args())
+        out = capsys.readouterr().out
+        json_lines = [l for l in out.strip().splitlines() if l.startswith("{")]
+        assert len(json_lines) == 1
+        doc = json.loads(json_lines[0])
+        assert doc["value"] == 0 and doc["unit"] == "edges/s"

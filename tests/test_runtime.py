@@ -77,13 +77,15 @@ class TestService:
         renumber=False,
         seed=None,
         duration_s=3.0,
+        score_batch_windows=1,
     ):
         interner = Interner()
         cfg = RuntimeConfig(
             model=ModelConfig(
                 model="graphsage", hidden_dim=32, use_pallas=False,
                 src_gather=src_gather,
-            )
+            ),
+            score_batch_windows=score_batch_windows,
         )
         cfg.renumber_nodes = renumber
         params = None
@@ -142,6 +144,35 @@ class TestService:
         assert set(plain) == set(banded)
         for k, v in plain.items():
             assert abs(v - banded[k]) < 1e-4, (k, v, banded[k])
+
+    def test_backlog_microbatching_scores_match_serial_path(self):
+        """SCORE_BATCH_WINDOWS=4: stacked vmapped dispatch over a queue
+        backlog must be invisible in the exported scores — identical
+        per-uid score map to the serial path on the same traffic. (The
+        backlog forms naturally here: submit outruns the cpu scorer.)"""
+        _, s_serial = self._run_service(seed=11, duration_s=2.0)
+        svc_b, s_batched = self._run_service(
+            seed=11, duration_s=2.0, score_batch_windows=4
+        )
+        assert svc_b._score_many_fn is not None
+        serial, batched = self._score_map(s_serial), self._score_map(s_batched)
+        assert serial, "serial path produced no scores"
+        assert set(serial) == set(batched)
+        for k, v in serial.items():
+            assert abs(v - batched[k]) < 1e-4, (k, v, batched[k])
+
+    def test_tgn_refuses_microbatching(self):
+        # window order is the temporal model's semantics; the vmapped
+        # path must never engage for it
+        cfg = RuntimeConfig(
+            model=ModelConfig(model="tgn", hidden_dim=32, use_pallas=False,
+                              tgn_max_nodes=256),
+            score_batch_windows=4,
+        )
+        init, _ = get_model("tgn")
+        params = init(jax.random.PRNGKey(0), cfg.model)
+        svc = Service(config=cfg, interner=Interner(), model_state=params)
+        assert svc._score_many_fn is None
 
     def test_end_to_end_scoring(self):
         svc, scores = self._run_service(score=True)

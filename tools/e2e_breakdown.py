@@ -51,8 +51,8 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from bench import make_e2e_rows
     from alaz_tpu.config import ModelConfig
-    from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
     from alaz_tpu.graph import native
     from alaz_tpu.models.registry import get_model
 
@@ -65,17 +65,8 @@ def main() -> None:
     params = init(jax.random.PRNGKey(0), cfg)
     score = jax.jit(lambda p, g: apply(p, g, cfg)["edge_logits"])
 
-    rng = np.random.default_rng(0)
     n_rows = args.rows
-    rows = make_requests(n_rows)
-    rows["from_uid"] = rng.integers(1, args.pods, n_rows)
-    rows["to_uid"] = rng.integers(args.pods, args.pods + args.svcs, n_rows)
-    rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
-    rows["protocol"] = rng.integers(1, 9, n_rows)
-    rows["latency_ns"] = rng.integers(1000, 100000, n_rows)
-    rows["status_code"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
-    rows["completed"] = True
-    rows["start_time_ms"] = 1000 + (np.arange(n_rows) * args.windows // n_rows) * 1000
+    rows = make_e2e_rows(n_rows, args.pods, args.svcs, args.windows)
 
     def run_once() -> dict:
         t = dict(push=0.0, poll=0.0, h2d=0.0, dispatch=0.0, drain=0.0)
