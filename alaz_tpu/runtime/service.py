@@ -381,6 +381,18 @@ class Service:
         # the temporal model's memory threading depends on it.
         staged: Optional[tuple] = None  # (batch, device arrays)
 
+        def record_window(batch, logits) -> None:
+            """Per-window accounting + export — the ONE definition both
+            the serial and batched paths share (their score parity is a
+            tested invariant; two copies of this block could drift)."""
+            self.scored_batches += 1
+            self.scored_edges += batch.n_edges
+            self.metrics.counter("scored.edges").inc(batch.n_edges)
+            if self.score_sink is not None:
+                annotated = self._annotate(batch, logits)
+                if len(annotated):
+                    self.score_sink(annotated)
+
         def score_one(batch, graph) -> None:
             """Score one window; always settles its task_done."""
             try:
@@ -396,13 +408,7 @@ class Service:
                         float(out["attn_clamp_saturation"])
                     )
                 self._scorer_busy_s += time_module.perf_counter() - t0
-                self.scored_batches += 1
-                self.scored_edges += batch.n_edges
-                self.metrics.counter("scored.edges").inc(batch.n_edges)
-                if self.score_sink is not None:
-                    annotated = self._annotate(batch, logits)
-                    if len(annotated):
-                        self.score_sink(annotated)
+                record_window(batch, logits)
             finally:
                 self.window_queue.task_done()
 
@@ -413,10 +419,16 @@ class Service:
             serial path's try/except gives a single window). Only ever
             called with an already-queued backlog, so it adds no latency
             over scoring them serially — it removes per-dispatch
-            overhead (ARCHITECTURE §3e)."""
+            overhead (ARCHITECTURE §3e). Partial groups are PADDED to
+            batch_windows by repeating the last window (its duplicate
+            logits discarded): one compiled (bucket, W) shape, never a
+            serving-time recompile per backlog size — the same
+            recompile-avoidance policy as the TGN memory pre-sizing."""
             try:
                 t0 = time_module.perf_counter()
                 cols = [b.device_arrays() for b in batches]
+                if len(cols) < self._batch_windows:
+                    cols = cols + [cols[-1]] * (self._batch_windows - len(cols))
                 stacked = {
                     k: jnp.asarray(np.stack([c[k] for c in cols]))
                     for k in cols[0]
@@ -429,13 +441,7 @@ class Service:
                     )
                 self._scorer_busy_s += time_module.perf_counter() - t0
                 for i, batch in enumerate(batches):
-                    self.scored_batches += 1
-                    self.scored_edges += batch.n_edges
-                    self.metrics.counter("scored.edges").inc(batch.n_edges)
-                    if self.score_sink is not None:
-                        annotated = self._annotate(batch, logits[i])
-                        if len(annotated):
-                            self.score_sink(annotated)
+                    record_window(batch, logits[i])
             finally:
                 for _ in batches:
                     self.window_queue.task_done()
@@ -476,10 +482,19 @@ class Service:
                             break
                         group.append(b2)
                 if len(group) > 1:
-                    # FIFO: the staged (older) window scores first
+                    # FIFO: the staged (older) window scores first. If
+                    # its scoring raises, the held group members must
+                    # still settle their accounting (drain() polls
+                    # unfinished) — score_group's own finally only runs
+                    # if reached.
                     if staged is not None:
                         prev, staged = staged, None
-                        score_one(*prev)
+                        try:
+                            score_one(*prev)
+                        except Exception:
+                            for _ in group:
+                                self.window_queue.task_done()
+                            raise
                     score_group(group)
                     continue
                 try:

@@ -61,7 +61,6 @@ def run_staged(monkeypatch, capsys, child, **args_over):
     """Run staged_main with ``child(extra, timeout_s, clock_t) ->
     (cost_s, result, diag)`` faking _run_child; returns (rc, last JSON
     line, stderr)."""
-    clock_ref = []
 
     def fake_run_child(extra, timeout_s):
         cost, res, diag = child(extra, timeout_s, bench.time.perf_counter())
@@ -164,9 +163,8 @@ class TestStagedMain:
         assert rc == 0 and line["value"] == 8192
 
     def test_always_exactly_one_json_line(self, clock, monkeypatch, capsys):
-        def child(extra, timeout_s, t):
-            return timeout_s, None, "spawn failed: boom"
-
+        # zero-cost failures (spawn errors): the loop must pace itself
+        # on the fake clock and still emit exactly one JSON line
         monkeypatch.setattr(bench, "_run_child",
                             lambda extra, t: (None, "spawn failed: boom"))
         rc = bench.staged_main(make_args())

@@ -55,9 +55,10 @@ run e2e-batch4 --e2e --e2e-batch 4
 run layout-community        --structure community --layout random
 run layout-clustered        --structure community --layout clustered
 run layout-clustered-banded --structure community --layout clustered --src-gather banded
-# fresh trace for §3d confirmation
+# fresh traces: §3d confirmation + the GAT byte-gap apportionment (§3c)
 mkdir -p traces
-run profile  --profile traces/r05_graphsage --iters 5 --repeats 1
+run profile     --profile traces/r05_graphsage --iters 5 --repeats 1
+run profile-gat --model gat --profile traces/r05_gat --iters 5 --repeats 1
 
 echo "--- $OUT ---"
 cat "$OUT"
