@@ -420,15 +420,21 @@ class Service:
             called with an already-queued backlog, so it adds no latency
             over scoring them serially — it removes per-dispatch
             overhead (ARCHITECTURE §3e). Partial groups are PADDED to
-            batch_windows by repeating the last window (its duplicate
-            logits discarded): one compiled (bucket, W) shape, never a
-            serving-time recompile per backlog size — the same
-            recompile-avoidance policy as the TGN memory pre-sizing."""
+            the next power of two (duplicating the last window, its
+            logits discarded): compiled shapes stay bounded at
+            log2(batch_windows) variants per bucket — never a
+            serving-time recompile per backlog size (the TGN memory
+            pre-sizing policy) — while padding waste stays under 2×
+            (padding straight to batch_windows would make a group of 2
+            under W=8 pay 4× its transfer and compute)."""
             try:
                 t0 = time_module.perf_counter()
                 cols = [b.device_arrays() for b in batches]
-                if len(cols) < self._batch_windows:
-                    cols = cols + [cols[-1]] * (self._batch_windows - len(cols))
+                target = 1
+                while target < len(cols):
+                    target *= 2
+                if len(cols) < target:
+                    cols = cols + [cols[-1]] * (target - len(cols))
                 stacked = {
                     k: jnp.asarray(np.stack([c[k] for c in cols]))
                     for k in cols[0]

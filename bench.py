@@ -625,7 +625,13 @@ def main() -> None:
     p.add_argument("--pods", type=int, default=100_000)
     p.add_argument("--svcs", type=int, default=10_000)
     p.add_argument("--hidden", type=int, default=128)
-    p.add_argument("--iters", type=int, default=20)
+    # 50 iterations per dispatch: §3d conclusion 3 measured ~190 ms of
+    # per-dispatch overhead through the relay tunnel against ~16 ms of
+    # device time per iteration — K=20 left ~37% of the wall clock in
+    # dispatch overhead. The fori_loop methodology is unchanged (one
+    # compiled program, steady-state device throughput); the r05 sweep
+    # rows (tools/bench_r05.sh iters50/iters100) quantify the effect.
+    p.add_argument("--iters", type=int, default=50)
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--profile", default="")
     p.add_argument("--e2e", action="store_true")

@@ -35,8 +35,8 @@ run() { # run <label> <args...>
 # headline first — bank the flagship number before anything exploratory
 run graphsage
 # §3d conclusion 3: is the 9.3ms/step gap per-dispatch overhead (rises
-# with K) or device idle (flat)?
-run iters50   --iters 50
+# with K) or device idle (flat)? Default is now K=50; bracket it.
+run iters20   --iters 20
 run iters100  --iters 100
 # §3d conclusion 2: pallas sorted-expand vs in-graph XLA gather at F=128
 # (subshell: `VAR=x fn` would leak the var into later runs in bash)
