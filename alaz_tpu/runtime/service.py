@@ -433,6 +433,10 @@ class Service:
                 target = 1
                 while target < len(cols):
                     target *= 2
+                # never exceed the operator's cap: batch_windows may be
+                # sized to device memory at the largest bucket, and a
+                # non-power-of-two cap must not round up past itself
+                target = min(target, self._batch_windows)
                 if len(cols) < target:
                     cols = cols + [cols[-1]] * (target - len(cols))
                 stacked = {

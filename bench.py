@@ -630,7 +630,8 @@ def main() -> None:
     # device time per iteration — K=20 left ~37% of the wall clock in
     # dispatch overhead. The fori_loop methodology is unchanged (one
     # compiled program, steady-state device throughput); the r05 sweep
-    # rows (tools/bench_r05.sh iters50/iters100) quantify the effect.
+    # rows (tools/bench_r05.sh iters20/iters100) bracket the K=50
+    # default to quantify the effect.
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--profile", default="")
