@@ -420,9 +420,10 @@ class Service:
             called with an already-queued backlog, so it adds no latency
             over scoring them serially — it removes per-dispatch
             overhead (ARCHITECTURE §3e). Partial groups are PADDED to
-            the next power of two (duplicating the last window, its
-            logits discarded): compiled shapes stay bounded at
-            log2(batch_windows) variants per bucket — never a
+            the next power of two, CLAMPED to batch_windows (duplicating
+            the last window, its logits discarded): compiled shapes per
+            bucket are the powers of two up to the cap plus the cap
+            itself when it isn't one (W=6 → {2,4,6}) — never a
             serving-time recompile per backlog size (the TGN memory
             pre-sizing policy) — while padding waste stays under 2×
             (padding straight to batch_windows would make a group of 2
