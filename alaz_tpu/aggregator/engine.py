@@ -387,16 +387,21 @@ class Aggregator:
         if n == 0:
             return np.zeros(0, dtype=REQUEST_DTYPE)
 
-        saddr = events["saddr"].copy()
-        sport = events["sport"].copy()
-        daddr = events["daddr"].copy()
-        dport = events["dport"].copy()
+        saddr = events["saddr"]
+        sport = events["sport"]
+        daddr = events["daddr"]
+        dport = events["dport"]
 
         # V1 fallback: rows without embedded addresses join via socket lines
         # keyed (pid, fd) at the write timestamp (findRelatedSocket).
         need_join = daddr == 0
         matched = ~need_join
         if need_join.any():
+            # the join writes resolved addresses in place — detach from
+            # the events array first. The all-V2 hot path (every row
+            # carries addresses) skips these four copies entirely.
+            saddr, sport = saddr.copy(), sport.copy()
+            daddr, dport = daddr.copy(), dport.copy()
             j_idx = np.flatnonzero(need_join)
             sub = events[j_idx]
             _, starts, inverse = np.unique(

@@ -204,7 +204,16 @@ class Simulator:
             # payloads: group rows by edge protocol, one memcpy per protocol
             for name, (proto, method, payload) in _PROTO_PAYLOADS.items():
                 mask = ev["protocol"] == proto
-                if mask.any():
+                if not mask.any():
+                    continue
+                if mask.all():
+                    # single-protocol batch (config1 is HTTP-only): write
+                    # payloads in place — fancy-indexed structured-array
+                    # round-trips copy the whole batch twice — and stop
+                    # scanning: no other protocol can match
+                    set_payloads(ev, payload)
+                    break
+                else:
                     sub = ev[mask]
                     set_payloads(sub, payload)
                     ev[mask] = sub
