@@ -222,7 +222,18 @@ struct Ingest {
   // close_window_feats scratch (consumer-side; persistent so a steady
   // stream of windows allocates nothing)
   std::vector<uint32_t> dst_off;                       // node_count + 1
-  std::vector<double> nacc[8];                         // per-node stats
+  // per-node stats interleaved: one 64-byte struct == one cache line
+  // per node, so the histogram pass touches 2 lines per edge (src+dst)
+  // instead of ~10 across 8 separate arrays. A/B at 110k nodes measured
+  // NO difference (the 7 MB accumulator set is L3-resident either way);
+  // the interleave is kept for the fleet-scale case where per-node
+  // state outgrows L3 and the 8-line pattern would miss on every edge.
+  struct alignas(64) NodeAcc {
+    double out_cnt, in_cnt, out_err, in_err, out_lat, in_lat, out_deg,
+        in_deg;
+  };
+  static_assert(sizeof(NodeAcc) == 64, "one cache line per node");
+  std::vector<NodeAcc> nacc;                           // per-node stats
 
   Ingest(int64_t wms, uint32_t ring_cap, uint32_t edge_cap, uint32_t node_cap)
       : ring(ring_cap), ring_mask(ring_cap - 1), window_ms(wms),
@@ -481,28 +492,23 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
   *window_start_ms = acc->window_id() * ig->window_ms;
 
   ig->dst_off.assign(n_nodes + 1, 0);
-  for (int i = 0; i < 8; ++i) ig->nacc[i].assign(n_nodes, 0.0);
-  double* out_cnt = ig->nacc[0].data();
-  double* in_cnt = ig->nacc[1].data();
-  double* out_err = ig->nacc[2].data();
-  double* in_err = ig->nacc[3].data();
-  double* out_lat = ig->nacc[4].data();
-  double* in_lat = ig->nacc[5].data();
-  double* out_deg = ig->nacc[6].data();
-  double* in_deg = ig->nacc[7].data();
+  ig->nacc.assign(n_nodes, Ingest::NodeAcc{});
+  Ingest::NodeAcc* nacc = ig->nacc.data();
 
-  // pass 1: dst histogram + per-node accumulators
+  // pass 1: dst histogram + per-node accumulators (2 cache lines/edge)
   for (const EdgeSlot& e : edges) {
     ig->dst_off[e.dst_slot + 1] += 1;
     const double c = static_cast<double>(e.count);
-    out_cnt[e.src_slot] += c;
-    in_cnt[e.dst_slot] += c;
-    out_err[e.src_slot] += e.err5;
-    in_err[e.dst_slot] += e.err5;
-    out_lat[e.src_slot] += static_cast<double>(e.lat_sum);
-    in_lat[e.dst_slot] += static_cast<double>(e.lat_sum);
-    out_deg[e.src_slot] += 1.0;
-    in_deg[e.dst_slot] += 1.0;
+    Ingest::NodeAcc& s = nacc[e.src_slot];
+    Ingest::NodeAcc& d = nacc[e.dst_slot];
+    s.out_cnt += c;
+    d.in_cnt += c;
+    s.out_err += e.err5;
+    d.in_err += e.err5;
+    s.out_lat += static_cast<double>(e.lat_sum);
+    d.in_lat += static_cast<double>(e.lat_sum);
+    s.out_deg += 1.0;
+    d.in_deg += 1.0;
   }
   for (uint32_t i = 0; i < n_nodes; ++i) ig->dst_off[i + 1] += ig->dst_off[i];
 
@@ -533,16 +539,17 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
     float* f = nf + static_cast<size_t>(i) * kNodeFeatDim;
     const uint8_t t = ig->node_types[i];
     if (t < 4) f[t] = 1.0f;
-    const double oc = out_cnt[i] > 1.0 ? out_cnt[i] : 1.0;
-    const double ic = in_cnt[i] > 1.0 ? in_cnt[i] : 1.0;
-    f[4] = static_cast<float>(std::log1p(out_cnt[i]));
-    f[5] = static_cast<float>(std::log1p(in_cnt[i]));
-    f[6] = static_cast<float>(out_err[i] / oc);
-    f[7] = static_cast<float>(in_err[i] / ic);
-    f[8] = static_cast<float>(std::log1p(out_lat[i] / oc) / 20.0);
-    f[9] = static_cast<float>(std::log1p(in_lat[i] / ic) / 20.0);
-    f[10] = static_cast<float>(std::log1p(out_deg[i]));
-    f[11] = static_cast<float>(std::log1p(in_deg[i]));
+    const Ingest::NodeAcc& a = nacc[i];
+    const double oc = a.out_cnt > 1.0 ? a.out_cnt : 1.0;
+    const double ic = a.in_cnt > 1.0 ? a.in_cnt : 1.0;
+    f[4] = static_cast<float>(std::log1p(a.out_cnt));
+    f[5] = static_cast<float>(std::log1p(a.in_cnt));
+    f[6] = static_cast<float>(a.out_err / oc);
+    f[7] = static_cast<float>(a.in_err / ic);
+    f[8] = static_cast<float>(std::log1p(a.out_lat / oc) / 20.0);
+    f[9] = static_cast<float>(std::log1p(a.in_lat / ic) / 20.0);
+    f[10] = static_cast<float>(std::log1p(a.out_deg));
+    f[11] = static_cast<float>(std::log1p(a.in_deg));
   }
 
   if (acc->window_id() > ig->closed_upto) ig->closed_upto = acc->window_id();

@@ -4,6 +4,8 @@ the reference's own ≥90%-processed invariant, plus a throughput floor that
 the reference imposes implicitly by running in real time (20 edges ×
 10k req/s sustained)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -34,7 +36,13 @@ def test_config1_full_acceptance_and_throughput():
     res = run_replay(cfg)
     assert res.generated == 3_000_000
     assert res.processed_ratio >= 0.9
+    # the acceptance CONTRACT stays the reference's 200k/s; the tighter
+    # 500k regression alarm (this build measures ~1.17M/s, ARCHITECTURE
+    # §1) only arms on capable machines — a contended CI runner must not
+    # turn an environment difference into a red build
     assert res.events_per_s >= 200_000, f"too slow: {res.events_per_s:.0f}/s"
+    if os.environ.get("ALAZ_PERF_ASSERTS", "") == "1":
+        assert res.events_per_s >= 500_000, f"regressed: {res.events_per_s:.0f}/s"
 
 
 def test_mixed_protocol_replay():
