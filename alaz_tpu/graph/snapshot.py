@@ -60,6 +60,13 @@ class GraphBatch:
     window_end_ms: int = 0
     # node slot -> interned uid (host-side bookkeeping, not shipped to device)
     node_uids: Optional[np.ndarray] = field(default=None, repr=False)
+    # [N_pad] f32 masked in-degree — a WINDOW INVARIANT, so it is
+    # computed once on the host (one bincount) instead of per dispatch
+    # on the device: the in-graph segment_sum XLA lowers it to on TPU
+    # costs a [E]-pair sort + reduce (~10 ms at the 1M-edge bucket,
+    # r03 trace — hoisted out of the bench loop by LICM but paid by
+    # EVERY serve-side window). Lazily filled by device_arrays.
+    node_deg: Optional[np.ndarray] = field(default=None, repr=False)
 
     @property
     def n_pad(self) -> int:
@@ -71,10 +78,18 @@ class GraphBatch:
 
     def device_arrays(self) -> dict:
         """The pytree the jit'd model consumes (static shapes only)."""
+        if self.node_deg is None:
+            # pad edges sit masked on the last node slot and are excluded
+            # by the [:n_edges] slice, so this equals the in-model
+            # masked_degree exactly (models/common.py)
+            self.node_deg = np.bincount(
+                self.edge_dst[: self.n_edges], minlength=self.n_pad
+            ).astype(np.float32)
         return {
             "node_feats": self.node_feats,
             "node_type": self.node_type,
             "node_mask": self.node_mask,
+            "node_deg": self.node_deg,
             "edge_src": self.edge_src,
             "edge_dst": self.edge_dst,
             "edge_type": self.edge_type,

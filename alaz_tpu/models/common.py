@@ -149,6 +149,19 @@ def masked_degree(edge_mask, edge_dst, num_nodes: int, dtype) -> jnp.ndarray:
     )
 
 
+def graph_degree(graph: dict, dtype, num_nodes: int) -> jnp.ndarray:
+    """The per-forward in-degree: the host-shipped window invariant when
+    the batch carries it (GraphBatch.device_arrays ``node_deg`` — one
+    bincount at close time), else the in-graph segment_sum. The device
+    fallback is what XLA lowers to a [E]-pair sort + reduce on TPU
+    (~10 ms/window at the 1M-edge bucket, r03 trace) — every dispatch
+    path that can ship the invariant should."""
+    deg = graph.get("node_deg")
+    if deg is not None:
+        return deg.astype(dtype)
+    return masked_degree(graph["edge_mask"], graph["edge_dst"], num_nodes, dtype)
+
+
 def edge_head_init(key, hidden: int, edge_feat_dim: int) -> list[dict]:
     return mlp_init(key, [2 * hidden + edge_feat_dim, hidden, 1])
 

@@ -37,7 +37,7 @@ from alaz_tpu.models.common import (
     layernorm_init,
     maybe_znorm_graph,
     mlp,
-    masked_degree,
+    graph_degree,
     mlp_init,
     scatter_messages,
 )
@@ -125,8 +125,10 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
 
     h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
     ef = graph["edge_feats"].astype(dtype)
-    # degree is layer-invariant: one [E] scatter per forward, not per layer
-    deg = masked_degree(edge_mask, graph["edge_dst"], n, dtype)
+    # degree is layer-invariant AND a window invariant: shipped with
+    # the batch (host bincount) — the in-graph fallback covers
+    # hand-built graph dicts (models/common.py graph_degree)
+    deg = graph_degree(graph, dtype, n)
 
     if cfg.expert_dispatch not in ("table", "masked"):
         # a typo (EXPERT_DISPATCH=tabel) silently running the slow form

@@ -28,7 +28,7 @@ from alaz_tpu.models.common import (
     layernorm_init,
     maybe_znorm_graph,
     mlp,
-    masked_degree,
+    graph_degree,
     mlp_init,
     scatter_messages,
 )
@@ -80,8 +80,10 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     # so no per-edge [E]-row embedding gather is needed (row-op bound at
     # ~9ns/row on TPU — it would cost as much as the whole scatter).
     ef = graph["edge_feats"].astype(dtype)
-    # degree is layer-invariant: one [E] scatter per forward, not per layer
-    deg = masked_degree(edge_mask, graph["edge_dst"], n, dtype)
+    # degree is layer-invariant AND a window invariant: shipped with
+    # the batch (host bincount) — the in-graph fallback covers
+    # hand-built graph dicts (models/common.py graph_degree)
+    deg = graph_degree(graph, dtype, n)
 
     def layer_fn(layer, h):
         # dense-before-gather: (h @ W)[src] == (h[src]) @ W, but the

@@ -65,6 +65,7 @@ def graph_pspec(stacked: bool = True) -> dict:
         "node_feats": spec(2),
         "node_type": spec(1),
         "node_mask": spec(1),
+        "node_deg": spec(1),
         "edge_src": spec(1),
         "edge_dst": spec(1),
         "edge_type": spec(1),

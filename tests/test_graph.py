@@ -28,6 +28,35 @@ class TestBuckets:
 
 
 class TestGraphBatch:
+    def test_node_deg_ships_the_window_invariant(self):
+        """device_arrays carries the host-computed masked in-degree —
+        exactly the in-model masked_degree (pad edges sit masked on the
+        last slot and are excluded), so the serve path never pays the
+        in-graph [E]-pair sort the segment_sum lowering costs on TPU."""
+        import jax.numpy as jnp
+
+        from alaz_tpu.models.common import masked_degree
+
+        rng = np.random.default_rng(3)
+        n, e = 50, 400
+        b = GraphBatch.build(
+            node_feats=rng.normal(size=(n, 4)).astype(np.float32),
+            node_type=np.ones(n, np.int32),
+            edge_src=rng.integers(0, n, e).astype(np.int32),
+            edge_dst=rng.integers(0, n, e).astype(np.int32),
+            edge_type=np.zeros(e, np.int32),
+            edge_feats=np.zeros((e, 2), np.float32),
+        )
+        arrs = b.device_arrays()
+        want = np.asarray(
+            masked_degree(
+                jnp.asarray(arrs["edge_mask"]), jnp.asarray(arrs["edge_dst"]),
+                b.n_pad, jnp.float32,
+            )
+        )
+        np.testing.assert_array_equal(arrs["node_deg"], want)
+        assert arrs["node_deg"].sum() == e  # every real edge counted once
+
     def test_build_pads_and_sorts(self):
         nf = np.random.default_rng(0).normal(size=(10, 4)).astype(np.float32)
         src = np.array([1, 5, 2, 0], dtype=np.int32)
