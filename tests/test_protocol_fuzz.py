@@ -192,3 +192,66 @@ class TestDecompressorFuzz:
                 zlib.decompress(buf, wbits=47)
             except zlib.error:
                 pass
+
+
+def _sweep_all_surfaces(buf: bytes) -> None:
+    """Every parse surface, with the same boundedness assertions the
+    fast tier enforces — ONE definition so the tiers cannot drift."""
+    classify_request(buf)
+    http.parse_status(buf)
+    postgres.parse_response(buf)
+    redis.parse_response(buf)
+    mysql.parse_response(buf, 1)
+    mongo.is_reply(buf)
+    mongo.parse_summary(buf)
+    frames = list(http2.iter_frames(buf))
+    assert len(frames) <= len(buf) // 9 + 1
+    kafka.parse_request_header(buf)
+    for ver in (0, 3, 9):
+        kafka.decode_produce_request(buf, ver)
+    for ver in (0, 3, 13):
+        kafka.decode_fetch_response(buf, ver)
+    try:
+        hpack.Decoder().decode(buf)
+    except hpack.HpackError:
+        pass
+    try:
+        out = hpack.huffman_decode(buf)
+        assert len(out) <= 2 * len(buf) + 8
+    except hpack.HpackError:
+        pass
+    for fn in (
+        compression.snappy_decompress_raw,
+        compression.snappy_decompress,
+        compression.lz4_block_decompress,
+        compression.lz4_frame_decompress,
+    ):
+        try:
+            out = fn(buf)
+            assert len(out) < (1 << 24)
+        except compression.CorruptData:
+            pass
+    try:
+        compression.zstd_decompress(buf)
+    except (compression.CorruptData, OSError):
+        pass
+
+
+@pytest.mark.slow
+class TestFuzzSoak:
+    """10× corpora across every parse surface — the long-tail pass the
+    fast tier samples. Failures name the seed and buffer so they
+    reproduce in isolation."""
+
+    def test_big_sweep(self):
+        for seed_off in range(10):
+            seed = 0xD00D + seed_off
+            for i, buf in enumerate(_random_bufs(400, max_len=768, seed=seed)):
+                try:
+                    _sweep_all_surfaces(buf)
+                except Exception as exc:  # noqa: BLE001 - reproduction context
+                    pytest.fail(
+                        f"seed={seed:#x} buf#{i} len={len(buf)} "
+                        f"head={buf[:24]!r}: {type(exc).__name__}: {exc}"
+                    )
+
