@@ -174,7 +174,22 @@ class ClusterInfo:
 
     def attribute(self, ips: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """For a batch of IPs → (ep_type, uid_id): pod first, then service,
-        else outbound — the reference's resolution order."""
+        else outbound — the reference's resolution order. Large batches
+        compress to unique IPs first: a 64k-row chunk usually carries a
+        few hundred distinct addresses, so the interval lookups and masks
+        run over those and rows resolve by one take each."""
+        if ips.shape[0] > 2048:
+            uniq, inverse = np.unique(ips, return_inverse=True)
+            if uniq.shape[0] < ips.shape[0]:
+                # the sort is paid either way — resolve over the uniques
+                # whenever they compress the batch at all (straight to
+                # the lookup body: uniq is already unique, re-running
+                # this compression on it could only waste a second sort)
+                ep_type, uid = self._attribute_direct(uniq)
+                return ep_type[inverse], uid[inverse]
+        return self._attribute_direct(ips)
+
+    def _attribute_direct(self, ips: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         pod_found, pod_uid = self.pod_ips.lookup(ips)
         svc_found, svc_uid = self.svc_ips.lookup(ips)
         ep_type = np.full(ips.shape[0], EP_OUTBOUND, dtype=np.uint8)

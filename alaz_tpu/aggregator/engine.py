@@ -83,6 +83,95 @@ def _conn_keys(pid: np.ndarray, fd: np.ndarray) -> np.ndarray:
         )
 
 
+class ConnStmtCache(dict):
+    """Prepared-statement cache keyed ``(pid, fd, stmt-id)`` with a
+    per-connection index, so teardown on TCP CLOSED / proc EXIT costs
+    O(statements on that connection), not O(whole cache): the previous
+    scan walked every cached statement per closed-pair batch, which at a
+    65k-entry cache made every connection churn a full-cache sweep.
+
+    Only the mutation surface the engine and protocol parsers actually
+    use is indexed (``[]=``, ``pop``, ``del``, the drop_* teardowns) —
+    other dict mutators are unsupported."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._by_conn: dict[tuple[int, int], set] = {}
+        self._fds_of_pid: dict[int, set] = {}
+
+    def __setitem__(self, key, value) -> None:
+        if key not in self:
+            conn = (key[0], key[1])
+            self._by_conn.setdefault(conn, set()).add(key)
+            self._fds_of_pid.setdefault(key[0], set()).add(key[1])
+        super().__setitem__(key, value)
+
+    def _unindex(self, key) -> None:
+        conn = (key[0], key[1])
+        keys = self._by_conn.get(conn)
+        if keys is None:
+            return
+        keys.discard(key)
+        if not keys:
+            del self._by_conn[conn]
+            fds = self._fds_of_pid.get(key[0])
+            if fds is not None:
+                fds.discard(key[1])
+                if not fds:
+                    del self._fds_of_pid[key[0]]
+
+    def __delitem__(self, key) -> None:
+        super().__delitem__(key)
+        self._unindex(key)
+
+    _MISSING = object()
+
+    def pop(self, key, default=_MISSING):
+        if default is self._MISSING:
+            value = super().pop(key)
+        else:
+            if key not in self:
+                return default
+            value = super().pop(key)
+        self._unindex(key)
+        return value
+
+    def clear(self) -> None:
+        super().clear()
+        self._by_conn.clear()
+        self._fds_of_pid.clear()
+
+    def _unsupported(self, *_a, **_k):
+        raise NotImplementedError(
+            "ConnStmtCache indexes only []=, del, pop and the drop_* "
+            "teardowns; this mutator would silently desync the "
+            "connection index"
+        )
+
+    update = setdefault = popitem = __ior__ = _unsupported
+
+    def drop_conn(self, pid: int, fd: int) -> int:
+        """Delete every statement cached for one (pid, fd)."""
+        keys = self._by_conn.pop((pid, fd), None)
+        if not keys:
+            return 0
+        for k in keys:
+            super().__delitem__(k)
+        fds = self._fds_of_pid.get(pid)
+        if fds is not None:
+            fds.discard(fd)
+            if not fds:
+                del self._fds_of_pid[pid]
+        return len(keys)
+
+    def drop_pid(self, pid: int) -> int:
+        """Delete every statement cached for any fd of one pid."""
+        n = 0
+        for fd in list(self._fds_of_pid.get(pid, ())):
+            n += self.drop_conn(pid, fd)
+        return n
+
+
 class AggregatorStats:
     def __init__(self) -> None:
         self.l7_in = 0
@@ -126,9 +215,10 @@ class Aggregator:
         self.h2 = Http2Assembler()
         self.stats = AggregatorStats()
         self.live_pids: set[int] = set()
-        # prepared-statement caches (pgStmts / mySqlStmts analogs)
-        self.pg_stmts: dict[tuple[int, int, str], str] = {}
-        self.mysql_stmts: dict[tuple[int, int, int], str] = {}
+        # prepared-statement caches (pgStmts / mySqlStmts analogs),
+        # conn-indexed so teardown never scans the whole cache
+        self.pg_stmts: ConnStmtCache = ConnStmtCache()
+        self.mysql_stmts: ConnStmtCache = ConnStmtCache()
         # retry queue of (l7 rows, attempts, not_before_ns)
         self._retries: deque[tuple[np.ndarray, int, int]] = deque()
         # L7 processing is single-logical-threaded, but the housekeeping
@@ -221,10 +311,9 @@ class Aggregator:
         for pid, fd in closed_pairs:
             self.h2.remove_conn(pid, fd)
         with self._l7_lock:
-            for cache in (self.pg_stmts, self.mysql_stmts):
-                doomed = [k for k in cache if (k[0], k[1]) in closed_pairs]
-                for k in doomed:
-                    del cache[k]
+            for pid, fd in closed_pairs:
+                self.pg_stmts.drop_conn(pid, fd)
+                self.mysql_stmts.drop_conn(pid, fd)
 
     def _persist_alive(self, rows: np.ndarray) -> None:
         out = np.zeros(rows.shape[0], dtype=ALIVE_CONNECTION_DTYPE)
@@ -297,10 +386,8 @@ class Aggregator:
                 self.socket_lines.remove_pid(pid)
                 self.h2.remove_pid(pid)
                 with self._l7_lock:  # stmt caches belong to the L7 worker
-                    for cache in (self.pg_stmts, self.mysql_stmts):
-                        doomed = [k for k in cache if k[0] == pid]
-                        for k in doomed:
-                            del cache[k]
+                    self.pg_stmts.drop_pid(pid)
+                    self.mysql_stmts.drop_pid(pid)
                 # a reused pid must start with a fresh burst allowance
                 self._pid_buckets.pop(pid, None)
             elif r["type"] == ProcEventType.EXEC:
@@ -453,6 +540,16 @@ class Aggregator:
             from_type, from_uid = from_type[is_pod], from_uid[is_pod]
         to_type, to_uid = self.cluster.attribute(daddr)
 
+        # one contiguous copy of the protocol column: it is scanned many
+        # times below (enrichment masks, direction flips, h2/kafka
+        # routing), and every scan of the strided 320-byte-record view
+        # costs ~70× the contiguous compare. The presence bincount then
+        # gates every protocol-specific pass to protocols actually in
+        # the batch — an all-HTTP chunk computes no AMQP/Redis/h2/kafka
+        # masks at all.
+        protocol = np.ascontiguousarray(events["protocol"])
+        proto_present = np.bincount(protocol, minlength=256)
+
         out = np.zeros(events.shape[0], dtype=REQUEST_DTYPE)
         out["start_time_ms"] = (events["write_time_ns"] // 1_000_000).astype(np.int64)
         out["latency_ns"] = events["duration_ns"]
@@ -464,7 +561,7 @@ class Aggregator:
         out["to_type"] = to_type
         out["to_uid"] = to_uid
         out["to_port"] = dport
-        out["protocol"] = events["protocol"]
+        out["protocol"] = protocol
         out["tls"] = events["tls"]
         out["completed"] = True
         out["status_code"] = events["status"]
@@ -472,56 +569,100 @@ class Aggregator:
 
         # outbound destinations: reverse-DNS name when the gated cache has
         # one, else the IP string (setFromToV2 fallback chain,
-        # data.go:852-866)
+        # data.go:852-866). Vectorized per UNIQUE address: name_for takes
+        # the cache lock and intern hashes a string — per-row they were
+        # the single hottest Python loop in the V2 ingest path.
         outbound = to_type == np.uint8(EP_OUTBOUND)
         if outbound.any():
-            for i in np.flatnonzero(outbound):
-                out["to_uid"][i] = self.interner.intern(
-                    self.reverse_dns.name_for(int(daddr[i]))
-                )
+            out["to_uid"][outbound] = self._outbound_uids(daddr[outbound])
 
         # per-protocol payload enrichment
-        self._enrich_paths(events, out)
+        self._enrich_paths(events, out, protocol, proto_present)
 
         # consume-side direction flips (AMQP DELIVER / Redis PUSHED_EVENT)
-        flip = (
-            (events["protocol"] == L7Protocol.AMQP)
-            & (events["method"] == AmqpMethod.DELIVER)
-        ) | (
-            (events["protocol"] == L7Protocol.REDIS)
-            & (events["method"] == RedisMethod.PUSHED_EVENT)
-        )
-        if flip.any():
-            reverse_direction(out, flip)
+        if proto_present[int(L7Protocol.AMQP)] or proto_present[int(L7Protocol.REDIS)]:
+            method = np.ascontiguousarray(events["method"])
+            flip = (
+                (protocol == L7Protocol.AMQP) & (method == AmqpMethod.DELIVER)
+            ) | (
+                (protocol == L7Protocol.REDIS) & (method == RedisMethod.PUSHED_EVENT)
+            )
+            if flip.any():
+                reverse_direction(out, flip)
 
-        # HTTP2 frames & Kafka payloads detour through their assemblers
-        h2_mask = events["protocol"] == L7Protocol.HTTP2
-        kafka_mask = events["protocol"] == L7Protocol.KAFKA
-        plain = ~h2_mask & ~kafka_mask
-        if h2_mask.any():
-            h2_out = self._process_h2(events[h2_mask], out[h2_mask])
-            if h2_out is not None and h2_out.shape[0]:
-                self.ds.persist_requests(h2_out)
-                self.stats.edges_out += h2_out.shape[0]
-        if kafka_mask.any():
-            self._process_kafka(events[kafka_mask], out[kafka_mask])
-
-        result = out[plain]
+        # HTTP2 frames & Kafka payloads detour through their assemblers;
+        # the common all-plain batch skips the masks AND the row copy
+        has_h2 = bool(proto_present[int(L7Protocol.HTTP2)])
+        has_kafka = bool(proto_present[int(L7Protocol.KAFKA)])
+        if has_h2 or has_kafka:
+            h2_mask = protocol == L7Protocol.HTTP2
+            kafka_mask = protocol == L7Protocol.KAFKA
+            if has_h2:
+                h2_out = self._process_h2(events[h2_mask], out[h2_mask])
+                if h2_out is not None and h2_out.shape[0]:
+                    self.ds.persist_requests(h2_out)
+                    self.stats.edges_out += h2_out.shape[0]
+            if has_kafka:
+                self._process_kafka(events[kafka_mask], out[kafka_mask])
+            result = out[~h2_mask & ~kafka_mask]
+        else:
+            result = out
         if result.shape[0]:
             self.ds.persist_requests(result)
             self.stats.edges_out += result.shape[0]
             self.stats.l7_joined += result.shape[0]
         return result
 
+    # -- outbound naming ----------------------------------------------------
+
+    def _outbound_uids(self, daddrs: np.ndarray) -> np.ndarray:
+        """Interned name ids for a column of outbound destination
+        addresses: one reverse-DNS probe + one intern per UNIQUE address
+        (in first-occurrence order, so id assignment matches the scalar
+        reference exactly); rows resolve by vectorized take."""
+        uniq, first_idx, inverse = np.unique(
+            daddrs, return_index=True, return_inverse=True
+        )
+        # first-occurrence order (np.unique sorts by value)
+        order = np.argsort(first_idx, kind="stable")
+        name_for = self.reverse_dns.name_for
+        names = [name_for(a) for a in uniq[order].tolist()]
+        ids = np.empty(uniq.shape[0], dtype=np.int32)
+        ids[order] = self.interner.intern_many(names)
+        return ids[inverse]
+
+    def _scalar_outbound_uids(self, daddrs: np.ndarray) -> np.ndarray:
+        """Pre-vectorization reference (one name_for + intern per ROW) —
+        kept for the equivalence property tests."""
+        return np.fromiter(
+            (
+                self.interner.intern(self.reverse_dns.name_for(int(a)))
+                for a in daddrs
+            ),
+            dtype=np.int32,
+            count=daddrs.shape[0],
+        )
+
     # -- payload enrichment -------------------------------------------------
 
-    def _enrich_paths(self, events: np.ndarray, out: np.ndarray) -> None:
+    def _enrich_paths(
+        self,
+        events: np.ndarray,
+        out: np.ndarray,
+        protocol: np.ndarray | None = None,
+        proto_present: np.ndarray | None = None,
+    ) -> None:
         """Fill ``out['path']`` per protocol. Amortized by payload hashing:
-        identical payload prefixes parse once *ever* (cross-batch cache)."""
-        protocol = events["protocol"]
-        http_mask = protocol == L7Protocol.HTTP
-        if http_mask.any():
-            idx = np.flatnonzero(http_mask)
+        identical payload prefixes parse once *ever* (cross-batch cache).
+        ``protocol``/``proto_present`` are the caller's contiguous column
+        + presence bincount when it already has them — absent protocols
+        then cost nothing, not even a mask compare."""
+        if protocol is None:
+            protocol = np.ascontiguousarray(events["protocol"])
+        if proto_present is None:
+            proto_present = np.bincount(protocol, minlength=256)
+        if proto_present[int(L7Protocol.HTTP)]:
+            idx = np.flatnonzero(protocol == L7Protocol.HTTP)
             self._hashed_parse(events, out, idx, int(L7Protocol.HTTP), self._parse_http_row)
         for proto, parser in (
             (L7Protocol.POSTGRES, self._parse_pg_row),
@@ -529,9 +670,8 @@ class Aggregator:
             (L7Protocol.MONGO, self._parse_mongo_row),
             (L7Protocol.REDIS, self._parse_redis_row),
         ):
-            mask = protocol == proto
-            if mask.any():
-                idx = np.flatnonzero(mask)
+            if proto_present[int(proto)]:
+                idx = np.flatnonzero(protocol == proto)
                 if proto in (L7Protocol.POSTGRES, L7Protocol.MYSQL):
                     # stateful (stmt caches) — parse per row
                     for i in idx:
@@ -562,15 +702,30 @@ class Aggregator:
 
     def _hashed_parse(self, events, out, idx, proto_key: int, row_parser) -> None:
         cache = self._path_cache.setdefault(proto_key, {})
-        # hash the FULL captured window plus payload_size: two payloads
-        # identical in a prefix but differing beyond (long paths/SQL) must
-        # not share the first-seen interned path
-        window = np.ascontiguousarray(events["payload"][idx])
+        # hash every captured byte any row's parser can read, plus
+        # payload_size: two payloads identical in a prefix but differing
+        # beyond (long paths/SQL) must not share the first-seen interned
+        # path. The hashed span is the batch's max payload_size rounded
+        # up to a power-of-two lane count (few distinct spans → stable
+        # cross-batch cache keys): lanes past a row's own size are zeros
+        # by the capture contract, parsers never read past size, so
+        # dropping all-zero tail lanes cannot merge distinct payloads —
+        # typical sub-128-byte HTTP batches hash 8 lanes, not 32.
+        sizes = events["payload_size"][idx]
+        span = min(int(sizes.max()) if idx.shape[0] else 0, events["payload"].shape[1])
+        lanes = 1
+        while lanes * 8 < span:
+            lanes *= 2
+        nbytes = min(lanes * 8, events["payload"].shape[1])
+        # single-protocol batches (the common case) take the strided-copy
+        # path, not a gather
+        if idx.shape[0] == events.shape[0]:
+            window = np.ascontiguousarray(events["payload"][:, :nbytes])
+        else:
+            window = np.ascontiguousarray(events["payload"][idx, :nbytes])
         hashes = self._payload_hashes(window)
         with np.errstate(over="ignore"):
-            hashes ^= events["payload_size"][idx].astype(np.uint64) * np.uint64(
-                0xD6E8FEB86659FD93
-            )
+            hashes ^= sizes.astype(np.uint64) * np.uint64(0xD6E8FEB86659FD93)
         uniq, starts, inverse = np.unique(hashes, return_index=True, return_inverse=True)
         path_ids = np.zeros(uniq.shape[0], dtype=np.int32)
         for u in range(uniq.shape[0]):

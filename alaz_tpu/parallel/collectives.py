@@ -29,7 +29,7 @@ def reduce_scatter_sum(x: jnp.ndarray, axis: str) -> jnp.ndarray:
 def ring_shift(x: jnp.ndarray, axis: str, shift: int = 1) -> jnp.ndarray:
     """Rotate shards around the ring: device i's block goes to i+shift.
     The halo-exchange primitive (ppermute rides ICI neighbor links)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm=perm)
 
@@ -59,4 +59,8 @@ def axis_index(axis: str) -> jnp.ndarray:
 
 
 def axis_size(axis: str) -> int:
-    return jax.lax.axis_size(axis)
+    # lax.axis_size is newer than some supported jax releases; psum(1, axis)
+    # is the long-standing equivalent (resolved to a concrete int at trace)
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)

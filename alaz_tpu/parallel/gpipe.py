@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from alaz_tpu.parallel.mesh import shard_map
+
 
 def make_pipeline(
     fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
@@ -48,7 +50,7 @@ def make_pipeline(
     s_axis = mesh.shape[axis]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
         out_specs=P(axis),

@@ -26,7 +26,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from alaz_tpu.ops.segment import ATTENTION_LOGIT_CLAMP
-from alaz_tpu.parallel.collectives import ring_shift
+from alaz_tpu.parallel.collectives import axis_size, ring_shift
+from alaz_tpu.parallel.mesh import shard_map
 
 
 def ring_gather_scatter(
@@ -43,7 +44,7 @@ def ring_gather_scatter(
     edges whose src falls in it.
     """
     n_loc = h_local.shape[0]
-    d = jax.lax.axis_size(axis)
+    d = axis_size(axis)
     my_idx = jax.lax.axis_index(axis)
 
     src_owner = edge_src // n_loc
@@ -76,7 +77,7 @@ def ring_gather_edges(
     by the node-sharded edge head, where every edge needs its (possibly
     remote) source state, not an aggregate."""
     n_loc = h_local.shape[0]
-    d = jax.lax.axis_size(axis)
+    d = axis_size(axis)
     my_idx = jax.lax.axis_index(axis)
 
     src_owner = edge_src // n_loc
@@ -133,7 +134,7 @@ def ring_attention_aggregate(
     n_loc = kv_local.shape[0]
     nh, hd = a_k.shape
     out_dtype = kv_local.dtype
-    d = jax.lax.axis_size(axis)
+    d = axis_size(axis)
     my_idx = jax.lax.axis_index(axis)
 
     src_owner = edge_src // n_loc
@@ -246,7 +247,7 @@ def make_halo_aggregate(mesh: Mesh, axis: str = "sp"):
     per-shard sums out. The shard axis maps onto the mesh's ``axis``."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis),

@@ -17,6 +17,20 @@ from jax.sharding import Mesh
 
 from alaz_tpu.config import MeshConfig
 
+# jax.shard_map graduated out of jax.experimental between jax releases
+# (and renamed its check_rep knob to check_vma on the way); resolve
+# whichever this jax exposes so the whole parallel layer (and the tests)
+# works on both sides of the move.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, **kwargs)
+
 AXES = ("dp", "tp", "ep", "sp")
 
 

@@ -37,6 +37,7 @@ from alaz_tpu.models.common import (
     scatter_messages,
     znorm_edge_feats,
 )
+from alaz_tpu.parallel.mesh import shard_map
 from alaz_tpu.parallel.halo import (
     partition_edges_by_dst,
     ring_attention_aggregate,
@@ -131,7 +132,7 @@ def make_node_sharded_graphsage(
     leading S axis."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), {k: P(axis) for k in (
             "node_feats", "node_type", "node_mask", "edge_src",
@@ -195,7 +196,7 @@ def make_node_sharded_gat(
     hd = cfg.hidden_dim // nh
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), {k: P(axis) for k in (
             "node_feats", "node_type", "node_mask", "edge_src",

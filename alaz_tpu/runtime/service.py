@@ -83,6 +83,49 @@ class ScoreBatch:
             )
 
 
+class StagingArenas:
+    """Reusable host staging buffers for the vmapped group-score path.
+
+    One ``[W, ...]`` arena per (bucket-shape, W) key, so steady-state
+    group scoring allocates nothing on the host — the per-group
+    ``np.stack`` (a fresh multi-MB allocation per dispatch at the large
+    buckets) becomes ``np.copyto`` into a warm buffer. Arenas are
+    **double-buffered** per key: the scorer stages group k+1 into the
+    other buffer while group k's transfer/compute may still be reading
+    the first (jax may alias host memory on CPU backends, and device
+    transfers are async), and it always blocks on group k's result
+    before a buffer comes around again — two buffers are exactly enough.
+    """
+
+    def __init__(self) -> None:
+        self._pool: dict[tuple, list] = {}
+        self._next: dict[tuple, int] = {}
+        self.fills = 0
+        self.reuses = 0  # perf smoke: steady state must be allocation-free
+
+    def fill(self, key: tuple, cols: List[dict]) -> dict:
+        """Copy ``cols`` (one device_arrays dict per window) into the
+        next arena for ``key`` and return it."""
+        k = (key, len(cols))
+        arenas = self._pool.setdefault(k, [None, None])
+        i = self._next.get(k, 0)
+        self._next[k] = 1 - i
+        arena = arenas[i]
+        if arena is None:
+            arena = {
+                name: np.empty((len(cols),) + a.shape, a.dtype)
+                for name, a in cols[0].items()
+            }
+            arenas[i] = arena
+        else:
+            self.reuses += 1
+        self.fills += 1
+        for w, c in enumerate(cols):
+            for name, a in c.items():
+                np.copyto(arena[name][w], a)
+        return arena
+
+
 class FanoutDataStore(BaseDataStore):
     """Tee persisted data to several sinks (graph store + export backend)."""
 
@@ -203,6 +246,7 @@ class Service:
         # twin of the score fn for window-independent models. TGN is
         # excluded — its memory threads sequentially through windows.
         self._score_many_fn = None
+        self._stage_arenas = StagingArenas()
         self._batch_windows = max(1, int(self.config.score_batch_windows))
         if (
             self._score_fn is not None
@@ -378,8 +422,19 @@ class Service:
         # double buffering (SURVEY §2.3 P3): window N+1's host→device
         # transfer is staged (JAX transfers are async) before window N is
         # scored, so the feed overlaps the compute. FIFO order is kept —
-        # the temporal model's memory threading depends on it.
-        staged: Optional[tuple] = None  # (batch, device arrays)
+        # the temporal model's memory threading depends on it. The same
+        # discipline covers the vmapped GROUP path: a group is staged
+        # (arena stack + transfer + async dispatch) and only finished
+        # (blocked on) after the next work is staged, so host stacking of
+        # group k+1 overlaps device compute of group k.
+        # staged: ("one", batch, device arrays) | ("group", batches, out)
+        staged: Optional[tuple] = None
+
+        def owed(entry: Optional[tuple]) -> int:
+            """Windows a staged entry still owes task_done for."""
+            if entry is None:
+                return 0
+            return 1 if entry[0] == "one" else len(entry[1])
 
         def record_window(batch, logits) -> None:
             """Per-window accounting + export — the ONE definition both
@@ -412,22 +467,25 @@ class Service:
             finally:
                 self.window_queue.task_done()
 
-        def score_group(batches) -> None:
-            """Score same-bucket windows through ONE vmapped dispatch;
-            settles every window's task_done (even when the host→device
-            transfer itself raises — the same accounting guarantee the
-            serial path's try/except gives a single window). Only ever
-            called with an already-queued backlog, so it adds no latency
-            over scoring them serially — it removes per-dispatch
-            overhead (ARCHITECTURE §3e). Partial groups are PADDED to
-            the next power of two, CLAMPED to batch_windows (duplicating
-            the last window, its logits discarded): compiled shapes per
-            bucket are the powers of two up to the cap plus the cap
-            itself when it isn't one (W=6 → {2,4,6}) — never a
-            serving-time recompile per backlog size (the TGN memory
-            pre-sizing policy) — while padding waste stays under 2×
-            (padding straight to batch_windows would make a group of 2
-            under W=8 pay 4× its transfer and compute)."""
+        def stage_group(batches) -> tuple:
+            """Stage same-bucket windows for ONE vmapped dispatch: stack
+            into a reused host arena (StagingArenas — no per-group
+            allocation), start the host→device transfer and dispatch the
+            vmapped score fn WITHOUT blocking on its result — the caller
+            holds the returned staged entry and finishes it after the
+            next work is staged, so the device computes this group while
+            the host stacks the next one. Only ever fed an
+            already-queued backlog, so it adds no latency over scoring
+            serially — it removes per-dispatch overhead (ARCHITECTURE
+            §3e). Partial groups are PADDED to the next power of two,
+            CLAMPED to batch_windows (duplicating the last window, its
+            logits discarded): compiled shapes per bucket are the powers
+            of two up to the cap plus the cap itself when it isn't one
+            (W=6 → {2,4,6}) — never a serving-time recompile per backlog
+            size (the TGN memory pre-sizing policy) — while padding
+            waste stays under 2×. On failure it settles every window's
+            task_done itself (the accounting guarantee the serial path's
+            try/except gives a single window)."""
             try:
                 t0 = time_module.perf_counter()
                 cols = [b.device_arrays() for b in batches]
@@ -440,11 +498,23 @@ class Service:
                 target = min(target, self._batch_windows)
                 if len(cols) < target:
                     cols = cols + [cols[-1]] * (target - len(cols))
-                stacked = {
-                    k: jnp.asarray(np.stack([c[k] for c in cols]))
-                    for k in cols[0]
-                }
+                arena = self._stage_arenas.fill(
+                    (batches[0].n_pad, batches[0].e_pad), cols
+                )
+                stacked = {k: jnp.asarray(v) for k, v in arena.items()}
                 out = self._score_many_fn(self.model_state, stacked)
+                self._scorer_busy_s += time_module.perf_counter() - t0
+                return ("group", batches, out)
+            except BaseException:
+                for _ in batches:
+                    self.window_queue.task_done()
+                raise
+
+        def finish_group(batches, out) -> None:
+            """Block on a staged group's logits, record every window;
+            always settles the group's task_dones."""
+            try:
+                t0 = time_module.perf_counter()
                 logits = np.asarray(out["edge_logits"])
                 if "attn_clamp_saturation" in out:
                     self.metrics.gauge("model.attn_clamp_saturation").set(
@@ -457,6 +527,14 @@ class Service:
                 for _ in batches:
                     self.window_queue.task_done()
 
+        def finish(entry: tuple) -> None:
+            """Finish any staged entry (serial window or vmapped group).
+            Settles the entry's own accounting in all cases."""
+            if entry[0] == "one":
+                score_one(entry[1], entry[2])
+            else:
+                finish_group(entry[1], entry[2])
+
         # carry: a popped window whose bucket broke a micro-batch group;
         # it owes a task_done until scored or the worker dies
         carry: Optional[GraphBatch] = None
@@ -467,9 +545,9 @@ class Service:
                 else:
                     item = self.window_queue.get(timeout=0.05)
                     if item is None:
-                        if staged is not None:  # idle: don't hold a window
+                        if staged is not None:  # idle: don't hold work
                             prev, staged = staged, None
-                            score_one(*prev)
+                            finish(prev)
                         continue
                     (batch,) = item
                 if self._score_fn is None or self.model_state is None:
@@ -493,20 +571,17 @@ class Service:
                             break
                         group.append(b2)
                 if len(group) > 1:
-                    # FIFO: the staged (older) window scores first. If
-                    # its scoring raises, the held group members must
-                    # still settle their accounting (drain() polls
-                    # unfinished) — score_group's own finally only runs
-                    # if reached.
-                    if staged is not None:
-                        prev, staged = staged, None
-                        try:
-                            score_one(*prev)
-                        except Exception:
-                            for _ in group:
-                                self.window_queue.task_done()
-                            raise
-                    score_group(group)
+                    # stage the group (its dispatch runs on device while
+                    # we drain the older staged work), THEN finish the
+                    # older entry — sink/record order stays FIFO because
+                    # finishing happens in stage order. stage_group
+                    # settles the group's accounting itself on failure;
+                    # if finishing the older entry raises instead, the
+                    # worker's finally settles the newly staged group.
+                    new = stage_group(group)
+                    prev, staged = staged, new
+                    if prev is not None:
+                        finish(prev)
                     continue
                 try:
                     t0 = time_module.perf_counter()
@@ -518,17 +593,17 @@ class Service:
                     # the popped window still owes its accounting
                     self.window_queue.task_done()
                     raise
-                prev, staged = staged, (batch, graph)
+                prev, staged = staged, ("one", batch, graph)
                 if prev is not None:
-                    score_one(*prev)  # scores N; N+1's transfer in flight
+                    finish(prev)  # finishes N; N+1's transfer in flight
             if staged is not None:
                 prev, staged = staged, None
-                score_one(*prev)
+                finish(prev)
         finally:
-            # worker dying (or stopping) with a window still staged or
+            # worker dying (or stopping) with work still staged or
             # carried: settle its accounting so drain() doesn't burn its
             # timeout
-            if staged is not None:
+            for _ in range(owed(staged)):
                 self.window_queue.task_done()
             if carry is not None:
                 self.window_queue.task_done()

@@ -69,10 +69,15 @@ def save(
 ) -> None:
     import orbax.checkpoint as ocp
 
-    state = {"params": params, "schema_version": np.int64(SCHEMA_VERSION)}
+    # 0-d arrays, not numpy scalars: orbax's StandardSave type-checks the
+    # tree and rejects bare np.int64 scalars on current releases
+    state = {
+        "params": params,
+        "schema_version": np.asarray(SCHEMA_VERSION, dtype=np.int64),
+    }
     if contract:
         state["contract"] = {
-            k: np.int64(v) for k, v in sorted(contract.items())
+            k: np.asarray(v, dtype=np.int64) for k, v in sorted(contract.items())
         }
     if opt_state is not None:
         state["opt_state"] = opt_state
@@ -102,7 +107,9 @@ def restore(
         target = step if step is not None else mgr.latest_step()
         if target is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        state = mgr.restore(target)
+        # explicit StandardRestore: current orbax managers refuse a bare
+        # restore() for items they did not just save (no handler registry)
+        state = mgr.restore(target, args=ocp.args.StandardRestore())
         state = jax.tree.map(np.asarray, state)
         found = int(state.pop("schema_version", 1))
         if found != SCHEMA_VERSION:

@@ -206,6 +206,155 @@ def make_e2e_rows(n_rows: int, pods: int, svcs: int, windows: int = 4, seed: int
     return rows
 
 
+def make_ingest_trace(
+    n_rows: int,
+    pods: int = 500,
+    svcs: int = 50,
+    outbound_ips: int = 200,
+    paths: int = 64,
+    windows: int = 8,
+    seed: int = 0,
+):
+    """Synthetic L7 trace for the host-ingest microbench: V2 events with
+    embedded addresses (pod sources; half service, half outbound
+    destinations) and a bounded set of unique HTTP payloads. ONE
+    definition shared by bench.py --ingest, tools/profile_ingest.py and
+    the perf smoke test, so all three drive the identical row stream.
+
+    Returns (events, cluster_msgs): feed the msgs into a ClusterInfo and
+    the events through Aggregator.process_l7.
+    """
+    import numpy as np
+
+    from alaz_tpu.events.k8s import EventType, K8sResourceMessage, Pod, ResourceType, Service
+    from alaz_tpu.events.net import ip_to_u32
+    from alaz_tpu.events.schema import HttpMethod, L7Protocol, make_l7_events
+
+    rng = np.random.default_rng(seed)
+    msgs = []
+    pod_ips = np.empty(pods, dtype=np.uint32)
+    for p in range(pods):
+        ip = f"10.{(p >> 16) & 0xFF}.{(p >> 8) & 0xFF}.{p & 0xFF}"
+        pod_ips[p] = ip_to_u32(ip)
+        msgs.append(
+            K8sResourceMessage(
+                ResourceType.POD, EventType.ADD, Pod(uid=f"pod-{p}", name=f"p{p}", ip=ip)
+            )
+        )
+    svc_ips = np.empty(svcs, dtype=np.uint32)
+    for s in range(svcs):
+        ip = f"10.96.{(s >> 8) & 0xFF}.{s & 0xFF}"
+        svc_ips[s] = ip_to_u32(ip)
+        msgs.append(
+            K8sResourceMessage(
+                ResourceType.SERVICE, EventType.ADD,
+                Service(uid=f"svc-{s}", name=f"s{s}", cluster_ip=ip),
+            )
+        )
+    # outbound destinations: third-party IPs the cluster tables don't know
+    out_ips = (
+        np.uint32(ip_to_u32("52.0.0.1")) + rng.permutation(1 << 16)[:outbound_ips].astype(np.uint32)
+    )
+
+    ev = make_l7_events(n_rows)
+    ev["pid"] = rng.integers(1000, 1000 + pods, n_rows)
+    ev["fd"] = rng.integers(3, 500, n_rows)
+    # event time advances through `windows` one-second windows so window
+    # closes interleave with ingest (the watermark path, not just flush)
+    ev["write_time_ns"] = 1_000_000_000 + (
+        np.arange(n_rows, dtype=np.uint64) * np.uint64(windows) * np.uint64(1_000_000_000)
+    ) // np.uint64(max(n_rows, 1))
+    ev["duration_ns"] = rng.integers(10_000, 5_000_000, n_rows)
+    ev["protocol"] = L7Protocol.HTTP
+    ev["method"] = HttpMethod.GET
+    ev["status"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
+    ev["saddr"] = pod_ips[rng.integers(0, pods, n_rows)]
+    ev["sport"] = rng.integers(1024, 65535, n_rows)
+    # destination mix: ~half in-cluster services, ~half outbound (the
+    # outbound half is what exercises the reverse-DNS intern path)
+    is_out = rng.random(n_rows) < 0.5
+    daddr = svc_ips[rng.integers(0, svcs, n_rows)]
+    daddr[is_out] = out_ips[rng.integers(0, outbound_ips, int(is_out.sum()))]
+    ev["daddr"] = daddr
+    ev["dport"] = np.where(is_out, 443, 80)
+    # bounded unique-payload set: the hashed-parse cache amortizes parsing,
+    # so path enrichment is per-unique, as in production
+    path_idx = rng.integers(0, paths, n_rows)
+    for p in range(paths):
+        payload = f"GET /api/v1/resource{p} HTTP/1.1\r\nHost: bench\r\n\r\n".encode()
+        rows_p = np.flatnonzero(path_idx == p)
+        buf = np.frombuffer(payload, dtype=np.uint8)
+        ev["payload"][rows_p[:, None], np.arange(buf.shape[0])[None, :]] = buf
+        ev["payload_size"][rows_p] = len(payload)
+    return ev, msgs
+
+
+def bench_ingest(args) -> dict:
+    """CPU-only host-ingest microbench: synthetic L7 trace → process_l7
+    (join, attribution, reverse-DNS naming, payload enrichment) →
+    windowed graph build. No accelerator anywhere in the loop, so every
+    round has a host-path perf number even when the tunnel is down."""
+    import numpy as np
+
+    from alaz_tpu.aggregator.cluster import ClusterInfo
+    from alaz_tpu.aggregator.engine import Aggregator
+    from alaz_tpu.events.intern import Interner
+    from alaz_tpu.graph.builder import WindowedGraphStore
+
+    if args.ingest_scalar:
+        # pre-PR reference paths: route the vectorized call sites back
+        # through their _scalar_* twins, so one binary A/Bs the batch
+        # APIs against the per-row implementations they replaced
+        from alaz_tpu.events.intern import Interner as _I
+        from alaz_tpu.graph.builder import NodeTable as _NT
+        from alaz_tpu.aggregator.engine import Aggregator as _A
+
+        _I.intern_many = _I._scalar_intern_many
+        _NT.bulk_map = _NT._scalar_bulk_map
+        _A._outbound_uids = _A._scalar_outbound_uids
+
+    n_rows = args.edges  # one L7 event per row
+    windows = 8
+    ev, msgs = make_ingest_trace(n_rows, windows=windows)
+    chunk = 1 << 16
+
+    def run_once() -> tuple[float, int, int]:
+        interner = Interner()
+        closed = []
+        store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        t0 = time.perf_counter()
+        for i in range(0, n_rows, chunk):
+            agg.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        store.flush()
+        dt = time.perf_counter() - t0
+        edges = sum(b.n_edges for b in closed)
+        return dt, len(closed), edges
+
+    # no warm-up run: every run_once builds fresh state, and best-of-N
+    # already absorbs cold-start effects
+    best = min((run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0])
+    dt, n_windows, n_edges = best
+    rows_per_s = n_rows / dt
+    print(
+        f"# ingest rows={n_rows} windows_closed={n_windows} agg_edges={n_edges} "
+        f"wall={dt*1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    metric, unit = _metric_for(args)
+    return {
+        "metric": metric,
+        "value": round(rows_per_s),
+        "unit": unit,
+        "vs_baseline": round(rows_per_s / 200_000, 3),  # reference: 200k req/s bar
+        "rows": n_rows,
+        "windows_closed": n_windows,
+    }
+
+
 def bench_e2e(args) -> dict:
     """Full-system throughput: REQUEST rows → native windowed ingest →
     graph assembly → jit'd scoring, wall-clocked end to end (the
@@ -334,6 +483,11 @@ def bench_probe(args) -> dict:
 def _metric_for(args) -> tuple[str, str]:
     """The single source of the (metric, unit) names the run will print —
     shared by the result payloads and the watchdog's error line."""
+    if getattr(args, "ingest", False):
+        name = "ingest_rows_per_sec"
+        if getattr(args, "ingest_scalar", False):
+            name += "[scalar]"
+        return name, "rows/s"
     if args.e2e:
         name = "e2e_ingest_to_score_rows_per_sec"
         if getattr(args, "e2e_batch", 1) > 1:
@@ -636,6 +790,12 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--profile", default="")
     p.add_argument("--e2e", action="store_true")
+    p.add_argument("--ingest", action="store_true",
+                   help="CPU-only host-ingest microbench (L7 trace → "
+                        "process_l7 → window close); no accelerator needed")
+    p.add_argument("--ingest-scalar", action="store_true",
+                   help="with --ingest: drive the pre-vectorization "
+                        "_scalar_* reference paths (the A/B baseline)")
     p.add_argument("--e2e-batch", type=int, default=1,
                    help="micro-batch W same-bucket windows per dispatch "
                         "(vmap; per-window semantics preserved). Trades "
@@ -655,7 +815,7 @@ def main() -> None:
 
     # modes the staged parent cannot represent run direct (old behavior);
     # the bare invocation — what the driver makes — is staged
-    if not (args.direct or args.e2e or args.profile or args.probe_only):
+    if not (args.direct or args.e2e or args.ingest or args.profile or args.probe_only):
         # an explicit --watchdog-s tighter than the stage budget bounds
         # the whole staged run (the pre-rework meaning of the flag);
         # 0 still means "no watchdog", not "no budget"
@@ -675,6 +835,8 @@ def main() -> None:
 
     if args.probe_only:
         out = bench_probe(args)
+    elif args.ingest:
+        out = bench_ingest(args)
     elif args.e2e:
         out = bench_e2e(args)
     else:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from alaz_tpu.config import ModelConfig, SimulationConfig
 from alaz_tpu.datastore.dto import make_requests
+from alaz_tpu.parallel.mesh import shard_map
 from alaz_tpu.models.common import EDGE_STAT_COLS, znorm_edge_feats
 from alaz_tpu.replay import faults
 from alaz_tpu.replay.scenario import run_forecast_scenario
@@ -123,7 +124,7 @@ class TestZnormEdgeFeats:
         want = np.asarray(znorm_edge_feats(jnp.asarray(ef), jnp.asarray(mask)))
 
         shard_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda a, m: znorm_edge_feats(a, m, axis="x"),
                 mesh=mesh,
                 in_specs=(P("x"), P("x")),

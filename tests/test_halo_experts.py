@@ -10,7 +10,7 @@ from __graft_entry__ import _example_batch
 from alaz_tpu.config import ModelConfig
 from alaz_tpu.models.registry import get_model
 from alaz_tpu.parallel.halo import make_halo_aggregate, ring_gather_scatter, shard_graph
-from alaz_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from alaz_tpu.parallel.mesh import make_mesh, mesh_shape_for, shard_map
 from alaz_tpu.parallel.sharding import make_sharded_train_step, param_pspec, stack_graphs
 
 pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
@@ -113,7 +113,7 @@ class TestRingAttention:
         mesh = make_mesh(mesh_shape_for(8, sp=sp))
         with mesh:
             @partial(
-                jax.shard_map,
+                shard_map,
                 mesh=mesh,
                 in_specs=(P("sp"),) * 7,
                 out_specs=P("sp"),

@@ -10,7 +10,7 @@ from jax.sharding import Mesh
 from __graft_entry__ import _example_batch, dryrun_multichip, entry
 from alaz_tpu.config import ModelConfig
 from alaz_tpu.models.registry import get_model
-from alaz_tpu.parallel.mesh import AXES, make_mesh, mesh_shape_for
+from alaz_tpu.parallel.mesh import AXES, make_mesh, mesh_shape_for, shard_map
 from alaz_tpu.parallel.sharding import (
     make_sharded_score_step,
     make_sharded_train_step,
@@ -153,7 +153,7 @@ class TestBandedGatherUnderSharding:
         # check_vma off: pallas_call's out_shape carries no vma
         # annotation for the varying-across-dp output
         @partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(P(), P("dp")), out_specs=P("dp"),
             check_vma=False,
         )
@@ -386,11 +386,11 @@ class TestAllToAllReshard:
         mesh = Mesh(np.asarray(jax.devices()[:d]), ("sp",))
         h = np.arange(n * f, dtype=np.float32).reshape(n, f)
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
+        @partial(shard_map, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
         def to_features(hl):
             return nodes_to_features(hl, "sp")
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
+        @partial(shard_map, mesh=mesh, in_specs=P("sp"), out_specs=P("sp"))
         def to_nodes(hl):
             return features_to_nodes(hl, "sp")
 

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""cProfile harness over the bench.py --ingest workload.
+
+One command to diagnose host-path regressions: runs the exact synthetic
+L7 trace the --ingest bench drives (bench.make_ingest_trace → process_l7
+→ window close) under cProfile and prints the top-N functions by
+cumulative time. No accelerator anywhere in the loop.
+
+Usage: JAX_PLATFORMS=cpu python tools/profile_ingest.py [--rows N] [--top K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import os
+import pstats
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=1 << 18)
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--sort", default="cumulative",
+                   choices=["cumulative", "tottime", "ncalls"])
+    args = p.parse_args()
+
+    from bench import make_ingest_trace
+    from alaz_tpu.aggregator.cluster import ClusterInfo
+    from alaz_tpu.aggregator.engine import Aggregator
+    from alaz_tpu.events.intern import Interner
+    from alaz_tpu.graph.builder import WindowedGraphStore
+
+    n_rows = args.rows
+    ev, msgs = make_ingest_trace(n_rows, windows=8)
+    interner = Interner()
+    closed = []
+    store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+    cluster = ClusterInfo(interner)
+    for m in msgs:
+        cluster.handle_msg(m)
+    agg = Aggregator(store, interner=interner, cluster=cluster)
+    chunk = 1 << 16
+
+    def run() -> None:
+        for i in range(0, n_rows, chunk):
+            agg.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        store.flush()
+
+    prof = cProfile.Profile()
+    prof.enable()
+    run()
+    prof.disable()
+    print(
+        f"# rows={n_rows} windows_closed={len(closed)} "
+        f"agg_edges={sum(b.n_edges for b in closed)}"
+    )
+    pstats.Stats(prof).sort_stats(args.sort).print_stats(args.top)
+
+
+if __name__ == "__main__":
+    main()
