@@ -7,13 +7,21 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test image image-multiarch bench
+.PHONY: native test lint image image-multiarch bench
 
 native:  ## libalaz_ingest.so + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
 
-test:
+test: lint
 	python -m pytest tests/ -x -q
+
+lint:  ## alazlint AST gate (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
+	python -m tools.alazlint alaz_tpu/ tools/alazlint --json
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check alaz_tpu tools; \
+	else \
+		echo "ruff not installed; skipped (config in pyproject.toml)"; \
+	fi
 
 bench:
 	python bench.py

@@ -47,7 +47,6 @@ from alaz_tpu.datastore.dto import (
 from alaz_tpu.datastore.interface import DataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import K8sResourceMessage
-from alaz_tpu.events.net import u32_to_ip
 from alaz_tpu.events.schema import (
     PROC_EVENT_DTYPE,
     AmqpMethod,

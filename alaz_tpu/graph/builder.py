@@ -20,7 +20,6 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
-from alaz_tpu.datastore.dto import EP_POD
 from alaz_tpu.datastore.interface import BaseDataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import EventType, ResourceType

@@ -74,17 +74,23 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     n = graph["node_feats"].shape[0]
     nh = cfg.num_heads
     hd = cfg.hidden_dim // nh
-    node_mask = graph["node_mask"].astype(dtype)
+    node_mask = graph["node_mask"].astype(jnp.float32)
     edge_mask = graph["edge_mask"]
     src, dst = graph["edge_src"], graph["edge_dst"]
 
-    h = dense(params["embed"], graph["node_feats"].astype(dtype)) * node_mask[:, None]
+    # f32 residual stream (matmuls stay in the compute dtype): a bf16
+    # carry makes the remat'd backward recompute round differently from
+    # the saved activations; see models/graphsage.py for the full note
+    h = dense(params["embed"], graph["node_feats"].astype(dtype)).astype(
+        jnp.float32
+    ) * node_mask[:, None]
     # edge-type conditioning rides the protocol one-hot in edge_feats
     # slots 7..15 (builder.py), learned through edge_proj — no per-edge
     # embedding gather (row-op bound on TPU)
     ef = graph["edge_feats"].astype(dtype)
 
-    def layer_fn(layer, h):
+    def layer_fn(layer, h32):
+        h = h32.astype(dtype)
         # attention logit = a·[q_dst, kv_src, e_feat] re-associated into
         # per-node/per-edge partial dot products: the dst-side partial
         # rides the sorted expand, only the src side stays a row gather
@@ -148,7 +154,9 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
             0.0,
         ).reshape(n, nh * hd)
         h_new = dense(layer["out"], agg.astype(dtype))
-        h_out = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+        h_out = (
+            h32 + jax.nn.gelu(layernorm(layer["ln"], h_new.astype(jnp.float32)))
+        ) * node_mask[:, None]
         return h_out, sat
 
     if cfg.remat:
@@ -157,6 +165,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     for layer in params["layers"]:
         h, sat = layer_fn(layer, h)
         sats.append(sat)
+    h = h.astype(dtype)
 
     edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]

@@ -67,13 +67,18 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     dtype = compute_dtype(cfg)
     graph = maybe_znorm_graph(graph, cfg)
     n = graph["node_feats"].shape[0]
-    node_mask = graph["node_mask"].astype(dtype)
+    node_mask = graph["node_mask"].astype(jnp.float32)
     edge_mask = graph["edge_mask"]
 
     h = dense(params["embed"], graph["node_feats"].astype(dtype))
     if h_bias is not None:
         h = h + h_bias.astype(dtype)
-    h = h * node_mask[:, None]
+    # the residual stream rides in f32 (matmuls stay in the compute
+    # dtype): a bf16 carry makes the remat'd backward recompute round
+    # differently from the saved activations (grad drift up to ~5%
+    # relative under jax.checkpoint); f32 elementwise accumulation is
+    # VPU-cheap next to the MXU matmuls and keeps remat grad-exact
+    h = h.astype(jnp.float32) * node_mask[:, None]
 
     # edge-type conditioning rides the protocol one-hot in edge_feats
     # slots 7..15 (builder.py): the edge_proj matmul learns type offsets,
@@ -83,9 +88,10 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     # degree is layer-invariant AND a window invariant: shipped with
     # the batch (host bincount) — the in-graph fallback covers
     # hand-built graph dicts (models/common.py graph_degree)
-    deg = graph_degree(graph, dtype, n)
+    deg = graph_degree(graph, jnp.float32, n)
 
-    def layer_fn(layer, h):
+    def layer_fn(layer, h32):
+        h = h32.astype(dtype)
         # dense-before-gather: (h @ W)[src] == (h[src]) @ W, but the
         # matmul runs over N node rows instead of E edge rows (8× fewer
         # FLOPs at config-5 fan-in) and the gather moves the same bytes
@@ -97,8 +103,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
         )
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
         h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
-        h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
-        return (h + h_new) * node_mask[:, None]
+        h_new = jax.nn.gelu(layernorm(layer["ln"], h_new.astype(jnp.float32)))
+        return (h32 + h_new) * node_mask[:, None]
 
     if cfg.remat:
         # rematerialize per layer: trade recompute for activation memory
@@ -106,6 +112,7 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
         layer_fn = jax.checkpoint(layer_fn)
     for layer in params["layers"]:
         h = layer_fn(layer, h)
+    h = h.astype(dtype)
 
     edge_logits = edge_head(params["edge_head"], h, graph, dtype, cfg.use_pallas, cfg.src_gather)
     node_logits = mlp(params["node_head"], h)[:, 0]

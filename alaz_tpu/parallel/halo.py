@@ -23,7 +23,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from alaz_tpu.ops.segment import ATTENTION_LOGIT_CLAMP
 from alaz_tpu.parallel.collectives import axis_size, ring_shift

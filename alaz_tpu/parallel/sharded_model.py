@@ -139,17 +139,26 @@ def make_node_sharded_graphsage(
             "edge_dst_local", "edge_type", "edge_feats", "edge_mask",
         )}),
         out_specs=(P(axis), P(axis)),
+        # jax 0.4.37's shard_map replication checker rejects the ring
+        # fori_loop's carry under reverse-mode AD ("Scan carry input and
+        # output got mismatched replication types") — the documented
+        # workaround until the fixed checker (check_vma) lands; layout
+        # correctness is still covered edge-for-edge by the parity tests
+        check_vma=False,
     )
     def run(params, g):
         dtype = compute_dtype(cfg)
-        node_mask = g["node_mask"][0].astype(dtype)
+        node_mask = g["node_mask"][0].astype(jnp.float32)
         edge_mask = g["edge_mask"][0]
         src, dst_local = g["edge_src"][0], g["edge_dst_local"][0]
         ef = _maybe_znorm_sharded(g["edge_feats"][0], edge_mask, cfg, axis, dtype)
         n_loc = g["node_feats"].shape[1]
 
+        # f32 residual stream, mirroring the single-device forward
+        # (models/graphsage.py): matmuls in the compute dtype, carry and
+        # LN/GELU in f32 so bf16 sharded serving stays parity-exact
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))
-        h = h * node_mask[:, None]
+        h = h.astype(jnp.float32) * node_mask[:, None]
 
         # degree is layer-invariant: one [E] scatter per forward (the
         # same hoist the single-device models carry)
@@ -157,7 +166,7 @@ def make_node_sharded_graphsage(
 
         for layer in params["layers"]:
             # remote part: Σ_{dst local} (h W_msg)[src] via the ring
-            hw = dense(layer["msg"], h)
+            hw = dense(layer["msg"], h.astype(dtype))
             ring_agg = ring_gather_scatter(
                 hw.astype(jnp.float32), src, dst_local, edge_mask, axis=axis
             )
@@ -172,11 +181,15 @@ def make_node_sharded_graphsage(
                 deg=deg,
             )
             agg = (ring_agg + ef_agg) / jnp.maximum(deg, 1.0)[:, None]
-            h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))
-            h_new = jax.nn.gelu(layernorm(layer["ln"], h_new))
+            h_new = dense(layer["self"], h.astype(dtype)) + dense(
+                layer["neigh"], agg.astype(dtype)
+            )
+            h_new = jax.nn.gelu(layernorm(layer["ln"], h_new.astype(jnp.float32)))
             h = (h + h_new) * node_mask[:, None]
 
-        return _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis)
+        return _sharded_heads(
+            params, h.astype(dtype), ef, src, dst_local, edge_mask, dtype, axis
+        )
 
     return jax.jit(run)
 
@@ -203,23 +216,28 @@ def make_node_sharded_gat(
             "edge_dst_local", "edge_type", "edge_feats", "edge_mask",
         )}),
         out_specs=(P(axis), P(axis)),
+        # same jax-0.4.37 replication-checker workaround as the
+        # graphsage maker above (ring fori_loop carry under grad)
+        check_vma=False,
     )
     def run(params, g):
         dtype = compute_dtype(cfg)
-        node_mask = g["node_mask"][0].astype(dtype)
+        node_mask = g["node_mask"][0].astype(jnp.float32)
         edge_mask = g["edge_mask"][0]
         src, dst_local = g["edge_src"][0], g["edge_dst_local"][0]
         ef = _maybe_znorm_sharded(g["edge_feats"][0], edge_mask, cfg, axis, dtype)
         n_loc = g["node_feats"].shape[1]
 
+        # f32 residual stream, mirroring models/gat.py
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))
-        h = h * node_mask[:, None]
+        h = h.astype(jnp.float32) * node_mask[:, None]
 
         for layer in params["layers"]:
             attn = layer["attn"].astype(dtype)  # [nh, 3hd]
             a_q, a_k, a_e = attn[:, :hd], attn[:, hd : 2 * hd], attn[:, 2 * hd :]
-            q = dense(layer["q"], h).reshape(n_loc, nh, hd)
-            kv = dense(layer["kv"], h)  # [n_loc, nh*hd] — the ring block
+            hc = h.astype(dtype)
+            q = dense(layer["q"], hc).reshape(n_loc, nh, hd)
+            kv = dense(layer["kv"], hc)  # [n_loc, nh*hd] — the ring block
             e_feat = dense(layer["edge_proj"], ef).reshape(-1, nh, hd)
             q_part = jnp.einsum("nhd,hd->nh", q, a_q)  # [n_loc, nh]
             e_part = jnp.einsum("ehd,hd->eh", e_feat, a_e)  # [e_loc, nh]
@@ -228,9 +246,13 @@ def make_node_sharded_gat(
                 src, dst_local, edge_mask, axis=axis,
             )
             h_new = dense(layer["out"], agg.astype(dtype))
-            h = (h + jax.nn.gelu(layernorm(layer["ln"], h_new))) * node_mask[:, None]
+            h = (
+                h + jax.nn.gelu(layernorm(layer["ln"], h_new.astype(jnp.float32)))
+            ) * node_mask[:, None]
 
-        return _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis)
+        return _sharded_heads(
+            params, h.astype(dtype), ef, src, dst_local, edge_mask, dtype, axis
+        )
 
     return jax.jit(run)
 

@@ -23,7 +23,6 @@ import struct
 from dataclasses import dataclass
 from typing import List
 
-from alaz_tpu.events.schema import KafkaMethod
 
 API_KEY_PRODUCE = 0
 API_KEY_FETCH = 1

@@ -24,7 +24,6 @@ from alaz_tpu.aggregator.engine import Aggregator
 from alaz_tpu.config import RuntimeConfig
 from alaz_tpu.datastore.interface import BaseDataStore, DataStore
 from alaz_tpu.events.intern import Interner
-from alaz_tpu.events.schema import L7Protocol
 from alaz_tpu.graph.builder import WindowedGraphStore, src_locality_gauges
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
@@ -98,28 +97,38 @@ class StagingArenas:
     """
 
     def __init__(self) -> None:
-        self._pool: dict[tuple, list] = {}
-        self._next: dict[tuple, int] = {}
-        self.fills = 0
-        self.reuses = 0  # perf smoke: steady state must be allocation-free
+        # today a single scorer thread owns the arenas, but the swap is a
+        # read-modify-write: two concurrent fills for one key would hand
+        # out the SAME buffer (silent window corruption, the exact class
+        # of bug alazlint's guarded-by rule exists for) — so the swap is
+        # locked; once per group dispatch, noise next to the copies
+        self._lock = threading.Lock()
+        self._pool: dict[tuple, list] = {}  # guarded-by: self._lock
+        self._next: dict[tuple, int] = {}  # guarded-by: self._lock
+        self.fills = 0  # guarded-by: self._lock
+        self.reuses = 0  # perf smoke: steady state must be allocation-free  # guarded-by: self._lock
 
     def fill(self, key: tuple, cols: List[dict]) -> dict:
         """Copy ``cols`` (one device_arrays dict per window) into the
         next arena for ``key`` and return it."""
         k = (key, len(cols))
-        arenas = self._pool.setdefault(k, [None, None])
-        i = self._next.get(k, 0)
-        self._next[k] = 1 - i
-        arena = arenas[i]
-        if arena is None:
-            arena = {
-                name: np.empty((len(cols),) + a.shape, a.dtype)
-                for name, a in cols[0].items()
-            }
-            arenas[i] = arena
-        else:
-            self.reuses += 1
-        self.fills += 1
+        with self._lock:
+            arenas = self._pool.setdefault(k, [None, None])
+            i = self._next.get(k, 0)
+            self._next[k] = 1 - i
+            arena = arenas[i]
+            if arena is None:
+                arena = {
+                    name: np.empty((len(cols),) + a.shape, a.dtype)
+                    for name, a in cols[0].items()
+                }
+                arenas[i] = arena
+            else:
+                self.reuses += 1
+            self.fills += 1
+        # the copies run OUTSIDE the lock: the double-buffer discipline
+        # (caller finishes group k before buffer k comes around again)
+        # makes the returned arena exclusively this caller's to fill
         for w, c in enumerate(cols):
             for name, a in c.items():
                 np.copyto(arena[name][w], a)

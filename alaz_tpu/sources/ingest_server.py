@@ -85,13 +85,16 @@ class IngestServer:
         port: int = 0,
     ):
         self.service = service
-        self.frames = 0
-        self.records = 0
-        self.bad_frames = 0
-        self.unsupported_frames = 0
-        self._counter_lock = threading.Lock()
+        self.frames = 0  # guarded-by: self._state_lock
+        self.records = 0  # guarded-by: self._state_lock
+        self.bad_frames = 0  # guarded-by: self._state_lock
+        self.unsupported_frames = 0  # guarded-by: self._state_lock
+        self._state_lock = threading.Lock()
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
+        # the accept loop rebinds/appends while stop() iterates — the
+        # list must live under the state lock (alazlint ALZ010 finding,
+        # fixed in ISSUE 2: a join missed mid-rebind leaked the thread)
+        self._threads: list[threading.Thread] = []  # guarded-by: self._state_lock
         self._unix_path: Optional[Path] = None
         if path is not None:
             self._unix_path = Path(path)
@@ -147,15 +150,16 @@ class IngestServer:
         # self-register observability like every other component
         metrics = getattr(self.service, "metrics", None)
         if metrics is not None:
-            metrics.gauge("ingest_socket.frames", lambda: self.frames)
-            metrics.gauge("ingest_socket.records", lambda: self.records)
-            metrics.gauge("ingest_socket.bad_frames", lambda: self.bad_frames)
+            metrics.gauge("ingest_socket.frames", lambda: self.frames)  # alazlint: disable=ALZ010 -- racy int read is a metrics gauge; GIL-atomic, momentarily stale at worst
+            metrics.gauge("ingest_socket.records", lambda: self.records)  # alazlint: disable=ALZ010 -- racy gauge read, see above
+            metrics.gauge("ingest_socket.bad_frames", lambda: self.bad_frames)  # alazlint: disable=ALZ010 -- racy gauge read, see above
             metrics.gauge(
-                "ingest_socket.unsupported_frames", lambda: self.unsupported_frames
+                "ingest_socket.unsupported_frames", lambda: self.unsupported_frames  # alazlint: disable=ALZ010 -- racy gauge read, see above
             )
         t = threading.Thread(target=self._accept_loop, name="alaz-ingest-accept", daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._state_lock:
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
@@ -168,9 +172,18 @@ class IngestServer:
                 self._unix_path.unlink()
             except OSError:
                 pass
-        for t in self._threads:
-            t.join(timeout=2)
-        self._threads.clear()
+        # drain in rounds: the accept loop may append one last connection
+        # thread between our snapshot and its own _stop check — joining
+        # the accept thread (in the first round) serializes that append,
+        # so the next round's snapshot is guaranteed to see it
+        while True:
+            with self._state_lock:
+                threads = list(self._threads)
+                self._threads.clear()
+            if not threads:
+                break
+            for t in threads:  # join OUTSIDE the lock: the accept loop takes it
+                t.join(timeout=2)
 
     # -- internals -----------------------------------------------------------
 
@@ -194,8 +207,9 @@ class IngestServer:
             t.start()
             # track only live connections (per-batch clients would
             # otherwise grow this list without bound)
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+            with self._state_lock:
+                self._threads = [x for x in self._threads if x.is_alive()]
+                self._threads.append(t)
 
     def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytearray]:
         """Read exactly n bytes into one preallocated buffer (no copies:
@@ -226,7 +240,7 @@ class IngestServer:
                     return
                 magic, kind, count, length = _HEADER.unpack(header)
                 if magic != MAGIC or length > MAX_FRAME_BYTES:
-                    with self._counter_lock:
+                    with self._state_lock:
                         self.bad_frames += 1
                     log.warning("bad frame header; dropping connection")
                     return
@@ -238,15 +252,15 @@ class IngestServer:
                     # well-formed but unsupported here (native frame on a
                     # numpy-store service): config mismatch, not protocol
                     # corruption — keep the connection, drop the frame
-                    with self._counter_lock:
+                    with self._state_lock:
                         self.unsupported_frames += 1
                     continue
                 if not ok:
-                    with self._counter_lock:
+                    with self._state_lock:
                         self.bad_frames += 1
                     log.warning(f"malformed frame kind={kind}; dropping connection")
                     return
-                with self._counter_lock:
+                with self._state_lock:
                     self.frames += 1
                     self.records += count
         finally:

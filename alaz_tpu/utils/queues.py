@@ -27,12 +27,12 @@ class BatchQueue:
     def __init__(self, capacity_events: int, name: str = "queue"):
         self.name = name
         self.capacity = int(capacity_events)
-        self._items: collections.deque = collections.deque()
-        self._events = 0
-        self._dropped = 0
-        self._put_total = 0
-        self._unfinished = 0  # enqueued batches not yet task_done()'d
-        self._closed = False
+        self._items: collections.deque = collections.deque()  # guarded-by: self._lock
+        self._events = 0  # guarded-by: self._lock
+        self._dropped = 0  # guarded-by: self._lock
+        self._put_total = 0  # guarded-by: self._lock
+        self._unfinished = 0  # enqueued batches not yet task_done()'d  # guarded-by: self._lock
+        self._closed = False  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
@@ -43,16 +43,16 @@ class BatchQueue:
 
     @property
     def pending_events(self) -> int:
-        return self._events
+        return self._events  # alazlint: disable=ALZ010 -- racy int read is a metrics gauge; GIL-atomic, momentarily stale at worst
 
     @property
     def dropped(self) -> int:
         """Total events dropped at the mouth of the queue (l7.go:764-770)."""
-        return self._dropped
+        return self._dropped  # alazlint: disable=ALZ010 -- racy gauge read, see pending_events
 
     @property
     def put_total(self) -> int:
-        return self._put_total
+        return self._put_total  # alazlint: disable=ALZ010 -- racy gauge read, see pending_events
 
     def _size_of(self, batch: Any) -> int:
         try:
@@ -117,7 +117,7 @@ class BatchQueue:
     @property
     def unfinished(self) -> int:
         """Batches enqueued but not yet marked done (includes in-flight)."""
-        return self._unfinished
+        return self._unfinished  # alazlint: disable=ALZ010 -- racy gauge read; drain() polls it in a timeout loop, see pending_events
 
     def drain(self) -> list:
         """Grab everything currently queued (for batch-oriented consumers)."""
@@ -137,7 +137,7 @@ class BatchQueue:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        return self._closed  # alazlint: disable=ALZ010 -- monotonic latch: False→True once, a stale False only delays the reader one poll
 
     def stats(self) -> dict:
         """Lag/drop gauges, the data.go:177-186 channel-lag log analog."""

@@ -14,8 +14,8 @@ class TokenBucket:
     def __init__(self, rate_per_s: float, burst: float, now_s: float = 0.0):
         self.rate = float(rate_per_s)
         self.burst = float(burst)
-        self._tokens = float(burst)
-        self._last = float(now_s)
+        self._tokens = float(burst)  # guarded-by: self._lock
+        self._last = float(now_s)  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def admit(self, n: int, now_s: float) -> int:

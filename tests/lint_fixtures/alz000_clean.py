@@ -1,0 +1,11 @@
+"""ALZ000 clean: the disable carries its justification."""
+import threading
+
+
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0  # guarded-by: self._lock
+
+    def read(self):
+        return self._x  # alazlint: disable=ALZ010 -- racy int read is a gauge, GIL-atomic

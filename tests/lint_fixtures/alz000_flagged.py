@@ -1,0 +1,11 @@
+"""ALZ000 flagged: a disable comment with no justification text."""
+import threading
+
+
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0  # guarded-by: self._lock
+
+    def read(self):
+        return self._x  # alazlint: disable=ALZ010 (alz-expect: ALZ000)

@@ -1,0 +1,12 @@
+"""ALZ001 flagged: host-device sync on traced values inside jit."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def scorer(params, graph):
+    logits = params["w"] @ graph["x"]
+    peak = logits.max().item()  # alz-expect: ALZ001
+    scale = float(logits[0])  # alz-expect: ALZ001
+    host = np.asarray(logits)  # alz-expect: ALZ001
+    return logits * peak * scale + host.sum()

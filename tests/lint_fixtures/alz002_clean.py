@@ -1,0 +1,12 @@
+"""ALZ002 clean: branch on a static argument, trace-level select on data."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("use_residual",))
+def step(x, use_residual=True):
+    if use_residual:  # static argument: legal Python branching
+        x = x + 1.0
+    return jnp.where(x > 0, x, 0.0)

@@ -1,0 +1,11 @@
+"""ALZ002 flagged: Python control flow on traced values inside jit."""
+import jax
+
+
+@jax.jit
+def step(x, threshold):
+    if x.sum() > threshold:  # alz-expect: ALZ002
+        x = x * 0.5
+    while x[0] > 1.0:  # alz-expect: ALZ002
+        x = x / 2.0
+    return x

@@ -1,0 +1,13 @@
+"""ALZ003 clean: literal, hashable static specs."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply(x, bucket=128):
+    return x * bucket
+
+
+def make(fn):
+    return jax.jit(fn, static_argnames=("mode",))

@@ -1,0 +1,15 @@
+"""ALZ003 flagged: non-literal / unhashable static specs."""
+import functools
+
+import jax
+
+
+def make(fn, idx):
+    fast = jax.jit(fn, static_argnums=idx)  # alz-expect: ALZ003
+    slow = jax.jit(fn, static_argnames=["mode", "cfg"])  # alz-expect: ALZ003
+    return fast, slow
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def apply(x, cfg=[]):  # alz-expect: ALZ003
+    return x * len(cfg)

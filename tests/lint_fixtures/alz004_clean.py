@@ -1,0 +1,14 @@
+"""ALZ004 clean: explicit dtypes (or no compute-dtype context)."""
+import jax.numpy as jnp
+
+
+def apply(params, x, dtype):
+    h = x.astype(dtype) @ params["w"].astype(dtype)
+    acc = jnp.zeros(h.shape[0], jnp.float32)  # f32 accumulator, explicit
+    bias = jnp.full((h.shape[0],), 0.5, dtype=dtype)
+    carry = jnp.zeros_like(h)  # *_like inherits its input dtype: exempt
+    return h + acc[:, None] + bias[:, None] + carry
+
+
+def host_side(n):
+    return jnp.zeros(n)  # no compute-dtype context in this function: exempt

@@ -1,0 +1,9 @@
+"""ALZ004 flagged: un-dtyped f32 constructors in compute-dtype code."""
+import jax.numpy as jnp
+
+
+def apply(params, x, dtype):
+    h = x.astype(dtype) @ params["w"].astype(dtype)
+    acc = jnp.zeros(h.shape[0])  # alz-expect: ALZ004
+    bias = jnp.full((h.shape[0],), 0.5)  # alz-expect: ALZ004
+    return h + acc[:, None] + bias[:, None]

@@ -1,0 +1,14 @@
+"""ALZ005 clean: staging dispatches async; the finisher blocks."""
+import jax.numpy as jnp
+import numpy as np
+
+
+class Scorer:
+    def stage_group(self, batches):
+        cols = self._stack(batches)
+        stacked = {k: jnp.asarray(v) for k, v in cols.items()}
+        return ("group", batches, self._fn(stacked))
+
+    def finish_group(self, staged):
+        _, batches, out = staged
+        return np.asarray(out["edge_logits"])  # the finisher blocks: fine

@@ -1,0 +1,13 @@
+"""ALZ005 flagged: blocking sync inside a stage_* function."""
+import jax
+import numpy as np
+
+
+class Scorer:
+    def stage_group(self, batches):
+        stacked = self._stack(batches)
+        out = self._fn(stacked)
+        logits = np.asarray(out["edge_logits"])  # alz-expect: ALZ005
+        out["x"].block_until_ready()  # alz-expect: ALZ005
+        got = jax.device_get(out)  # alz-expect: ALZ005
+        return logits, got

@@ -1,0 +1,25 @@
+"""ALZ010 clean: every touch holds the lock (Condition aliases count)."""
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._rows = []  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+
+    def add(self, row):
+        with self._lock:
+            self._rows.append(row)
+            self._count += 1
+            self._not_empty.notify()
+
+    def pop(self):
+        with self._not_empty:  # Condition(self._lock) aliases the lock
+            while not self._rows:
+                self._not_empty.wait()
+            return self._rows.pop()
+
+    def peek(self):
+        return len(self._rows)  # alazlint: disable=ALZ010 -- racy size gauge is advisory only

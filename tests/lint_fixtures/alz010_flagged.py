@@ -1,0 +1,21 @@
+"""ALZ010 flagged: guarded fields touched without their lock."""
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+
+    def add(self, row):
+        self._rows.append(row)  # alz-expect: ALZ010
+        with self._lock:
+            self._count += 1
+
+    def snapshot(self):
+        return list(self._rows)  # alz-expect: ALZ010
+
+    def register(self, metrics):
+        with self._lock:
+            metrics.gauge("rows", lambda: self._count)  # alz-expect: ALZ010

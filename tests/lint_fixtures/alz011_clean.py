@@ -1,0 +1,16 @@
+"""ALZ011 clean: I/O outside the critical section, state update inside."""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = b""
+
+    def poll(self, sock):
+        data = sock.recv(4096)
+        with self._lock:
+            self._last = data
+        time.sleep(0.1)
+        return data

@@ -1,0 +1,15 @@
+"""ALZ011 flagged: blocking I/O inside the critical section."""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = b""
+
+    def poll(self, sock):
+        with self._lock:
+            time.sleep(0.1)  # alz-expect: ALZ011
+            self._last = sock.recv(4096)  # alz-expect: ALZ011
+        return self._last

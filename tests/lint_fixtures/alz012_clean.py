@@ -1,0 +1,12 @@
+"""ALZ012 clean: `with` scopes the critical section."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1

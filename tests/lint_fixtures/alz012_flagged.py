@@ -1,0 +1,13 @@
+"""ALZ012 flagged: bare acquire/release instead of `with`."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        self._lock.acquire()  # alz-expect: ALZ012
+        self.n += 1
+        self._lock.release()

@@ -1,0 +1,23 @@
+"""ALZ013 clean: the wait predicate is re-checked in a while loop
+(Event.wait has no predicate to re-check and is exempt)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self.item = None
+
+    def take(self):
+        with self._ready:
+            while self.item is None:
+                self._ready.wait()
+            item, self.item = self.item, None
+            return item
+
+    def run_until_stopped(self):
+        if not self._stop.wait(timeout=1.0):  # Event.wait: exempt
+            return self.take()
+        return None

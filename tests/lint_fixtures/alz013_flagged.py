@@ -1,0 +1,16 @@
+"""ALZ013 flagged: condition wait guarded by `if`, not re-checked."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.item = None
+
+    def take(self):
+        with self._ready:
+            if self.item is None:
+                self._ready.wait()  # alz-expect: ALZ013
+            item, self.item = self.item, None
+            return item

@@ -1,0 +1,26 @@
+"""alazlint — project-specific static analysis for the alaz_tpu codebase.
+
+Two rule families, both tuned to the failure modes this repo actually
+has (stdlib ``ast`` only, no third-party deps):
+
+**JAX hygiene** (ALZ001-ALZ005) — host-device sync and tracer misuse
+inside jit/vmap/shard_map-traced functions, non-hashable static
+arguments, silent f32 promotion next to a bf16 compute dtype, and
+blocking sync calls inside the async staging path.
+
+**Lock discipline** (ALZ010-ALZ013) — the ``# guarded-by: self._lock``
+annotation contract for the threaded host pipeline, blocking I/O while
+holding a lock, bare ``acquire()`` outside try/finally, and condition
+waits not re-checked in a loop.
+
+Run as ``python -m tools.alazlint <paths> [--json]``; exit code 1 when
+findings exist. Suppress a single finding with an inline comment::
+
+    x = self._items  # alazlint: disable=ALZ010 -- racy gauge read is fine
+
+The justification text after ``--`` is REQUIRED: a bare disable is
+itself reported (ALZ000).
+"""
+
+from tools.alazlint.core import Finding, lint_paths, lint_source  # noqa: F401
+from tools.alazlint.rules import RULES  # noqa: F401

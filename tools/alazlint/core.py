@@ -1,0 +1,237 @@
+"""alazlint core: file model, findings, disable comments, driver.
+
+The engine is deliberately small: rules are plain functions
+``rule(ctx) -> Iterable[Finding]`` registered in ``rules.RULES``; the
+core owns parsing, comment handling (``# guarded-by`` declarations and
+``# alazlint: disable=`` suppressions are both comments, invisible to
+``ast``), suppression filtering, and output.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ``# alazlint: disable=ALZ010 -- why this is safe``
+_DISABLE_RE = re.compile(
+    r"#\s*alazlint:\s*disable=(?P<codes>ALZ\d{3}(?:\s*,\s*ALZ\d{3})*)"
+    r"(?:\s*--\s*(?P<why>\S.*))?"
+)
+# ``# guarded-by: self._lock``
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*self\.(?P<lock>\w+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def as_json(self) -> dict:
+        return {
+            "code": self.code,
+            "message": self.message,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+        }
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one file."""
+
+    path: str
+    source: str
+    tree: ast.AST
+    # line -> set of suppressed codes
+    disables: Dict[int, set] = field(default_factory=dict)
+    # line -> lock name from a ``# guarded-by: self.<lock>`` comment
+    guarded_lines: Dict[int, str] = field(default_factory=dict)
+    # lines of bare disables (missing the required justification)
+    bare_disables: List[Tuple[int, int]] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._alz_parent = node  # type: ignore[attr-defined]
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_alz_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+
+def _scan_comments(ctx: FileContext) -> None:
+    """Populate disables / guarded-by maps from the token stream."""
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(ctx.source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            m = _DISABLE_RE.search(tok.string)
+            if m:
+                codes = {c.strip() for c in m.group("codes").split(",")}
+                ctx.disables.setdefault(line, set()).update(codes)
+                if not m.group("why"):
+                    ctx.bare_disables.append((line, tok.start[1]))
+            g = _GUARDED_RE.search(tok.string)
+            if g:
+                ctx.guarded_lines[line] = g.group("lock")
+    except tokenize.TokenError:
+        pass  # the parse-error finding covers truly broken files
+
+
+def _expand_disables_over_statements(ctx: FileContext) -> None:
+    """A disable comment anywhere on a wrapped (multi-line) SIMPLE
+    statement suppresses findings on every line of that statement — the
+    comment can only physically sit on one line, usually the last, while
+    findings anchor at inner node linenos. Compound statements (``with``,
+    ``if``, ``def`` — anything with a body) are deliberately NOT
+    expanded: their span covers the whole suite and a trailing disable
+    would silently blanket-suppress the block."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.stmt) or hasattr(node, "body"):
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        if end == node.lineno:
+            continue
+        span = range(node.lineno, end + 1)
+        codes: set = set()
+        for ln in span:
+            codes |= ctx.disables.get(ln, set())
+        if codes:
+            for ln in span:
+                ctx.disables.setdefault(ln, set()).update(codes)
+
+
+def lint_source(path: str, source: str) -> List[Finding]:
+    """Lint one file's source; returns surviving findings (suppressions
+    applied, bare suppressions reported as ALZ000)."""
+    from tools.alazlint.rules import RULES
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                "ALZ900",
+                f"file does not parse: {exc.msg}",
+                path,
+                exc.lineno or 1,
+                (exc.offset or 1) - 1,
+            )
+        ]
+    ctx = FileContext(path=path, source=source, tree=tree)
+    _scan_comments(ctx)
+    _expand_disables_over_statements(ctx)
+
+    raw: List[Finding] = []
+    for rule in RULES.values():
+        raw.extend(rule.check(ctx))
+
+    out: List[Finding] = []
+    for f in raw:
+        suppressed = f.code in ctx.disables.get(f.line, set())
+        if not suppressed:
+            out.append(f)
+    for line, col in ctx.bare_disables:
+        out.append(
+            Finding(
+                "ALZ000",
+                "disable comment is missing its justification "
+                "(write `# alazlint: disable=ALZxxx -- <why this is safe>`)",
+                path,
+                line,
+                col,
+            )
+        )
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def callee(call: ast.Call) -> "Tuple[Optional[str], Optional[str]]":
+    """(module-ish prefix, attr/name) for a call: ``np.asarray`` →
+    ("np", "asarray"), ``float`` → (None, "float"), ``x.y.item`` →
+    ("<expr>", "item"). Shared by both rule families."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return None, fn.id
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name):
+            return fn.value.id, fn.attr
+        return "<expr>", fn.attr
+    return None, None
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[Path]:
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        try:
+            source = f.read_text()
+        except (UnicodeDecodeError, OSError) as exc:
+            # one undecodable file must not abort the whole run — report
+            # it through the same channel as a parse failure
+            findings.append(
+                Finding("ALZ900", f"file is not readable: {exc}", str(f), 1, 0)
+            )
+            continue
+        findings.extend(lint_source(str(f), source))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from tools.alazlint.rules import RULES
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--list-rules" in argv:
+        for code, rule in sorted(RULES.items()):
+            print(f"{code}  {rule.summary}")
+        return 0
+    if not argv:
+        print("usage: python -m tools.alazlint <paths...> [--json] [--list-rules]")
+        return 2
+    findings = lint_paths(argv)
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"alazlint: {len(findings)} finding(s)")
+    return 1 if findings else 0

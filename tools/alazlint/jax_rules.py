@@ -1,0 +1,422 @@
+"""JAX-hygiene rules (ALZ001-ALZ005).
+
+Scope: functions that are *directly* traced — decorated with
+``jax.jit`` / ``jax.vmap`` / ``jax.checkpoint`` / ``shard_map`` (bare or
+through ``functools.partial``), or passed by name/lambda into one of
+those transforms in the same module. Helpers reached only through a
+traced caller are out of scope by design: flow-through-call-graph would
+need whole-program analysis, and the hot entry points are exactly the
+directly-transformed functions.
+
+Within a traced function, a light taint pass marks the non-static
+parameters (the values that become tracers) and propagates through
+assignments; the tracer-misuse rules fire on tainted expressions only,
+so branching on closed-over config stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
+
+from tools.alazlint.core import FileContext, Finding, callee as _callee
+
+_TRACING_TRANSFORMS = {"jit", "vmap", "pmap", "checkpoint", "remat", "shard_map"}
+# jnp constructors whose default dtype is strong f32 — the silent
+# promotion hazard next to a bf16 compute dtype (ALZ004). ``*_like`` and
+# ``jnp.asarray`` inherit their input's dtype and are exempt.
+_F32_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange", "linspace", "eye"}
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def _call_transform_name(call: ast.Call) -> Optional[str]:
+    """'jit' for jax.jit(...) / jit(...); handles functools.partial(jax.jit, ...)."""
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if isinstance(inner, ast.Attribute):
+            return inner.attr if inner.attr in _TRACING_TRANSFORMS else None
+        if isinstance(inner, ast.Name):
+            return inner.id if inner.id in _TRACING_TRANSFORMS else None
+        return None
+    return name if name in _TRACING_TRANSFORMS else None
+
+
+def _static_names_from_call(
+    call: ast.Call, fn: ast.FunctionDef | ast.Lambda
+) -> Set[str]:
+    """Parameter names made static by static_argnums/static_argnames."""
+    args = fn.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for idx in _int_literals(kw.value):
+                if 0 <= idx < len(pos):
+                    out.add(pos[idx])
+        elif kw.arg == "static_argnames":
+            out.update(_str_literals(kw.value))
+    return out
+
+
+def _int_literals(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for el in node.elts:
+            out.extend(_int_literals(el))
+        return out
+    return []
+
+
+def _str_literals(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in node.elts:
+            out.extend(_str_literals(el))
+        return out
+    return []
+
+
+def _enclosing_fn(ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def traced_functions(
+    ctx: FileContext,
+) -> Iterator[Tuple[ast.FunctionDef | ast.Lambda, ast.Call | None]]:
+    """Yield (function node, transform call | None for decorators)."""
+    defs_by_name: dict = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(n.name, []).append(n)
+
+    def resolve(name: str, call: ast.Call):
+        """Pick the def a by-name transform call refers to. Same-named
+        defs are common here (every sharded-model maker nests a `run`):
+        prefer the candidate sharing the call's enclosing function, so
+        `jax.jit(run)` inside maker A analyzes A's `run`, not the last
+        `run` in the file. Fall back to ALL candidates rather than miss
+        a traced function (a stray extra analysis only risks an FP that
+        a disable comment can silence; a miss silently drops the gate)."""
+        candidates = defs_by_name.get(name, [])
+        if len(candidates) <= 1:
+            return candidates
+        home = _enclosing_fn(ctx, call)
+        local = [d for d in candidates if _enclosing_fn(ctx, d) is home]
+        return local or candidates
+
+    seen: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                tname = None
+                call = None
+                if isinstance(dec, ast.Call):
+                    tname = _call_transform_name(dec)
+                    call = dec
+                elif isinstance(dec, (ast.Attribute, ast.Name)):
+                    nm = dec.attr if isinstance(dec, ast.Attribute) else dec.id
+                    tname = nm if nm in _TRACING_TRANSFORMS else None
+                if tname and id(node) not in seen:
+                    seen.add(id(node))
+                    yield node, call
+        elif isinstance(node, ast.Call):
+            if _call_transform_name(node) is None:
+                continue
+            # first positional arg (after partial's transform) is the fn
+            args = node.args
+            fn_nodes: list = []
+            if isinstance(node.func, (ast.Attribute, ast.Name)) and args:
+                head = args[0]
+                if (
+                    getattr(node.func, "attr", getattr(node.func, "id", None))
+                    == "partial"
+                ):
+                    head = args[1] if len(args) > 1 else None
+                if isinstance(head, ast.Lambda):
+                    fn_nodes = [head]
+                elif isinstance(head, ast.Name):
+                    fn_nodes = resolve(head.id, node)
+            for fn_node in fn_nodes:
+                if id(fn_node) not in seen:
+                    seen.add(id(fn_node))
+                    yield fn_node, node
+
+
+def _param_names(fn: ast.FunctionDef | ast.Lambda) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _taint(fn: ast.FunctionDef | ast.Lambda, static: Set[str]) -> Set[str]:
+    """Names that (may) hold tracers inside ``fn``: the non-static
+    params, propagated through assignments / loop targets to fixpoint."""
+    tainted = {p for p in _param_names(fn) if p not in static}
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for _ in range(10):  # fixpoint over simple def-use chains
+        before = len(tainted)
+        for stmt in body:
+            for node in ast.walk(stmt) if isinstance(stmt, ast.AST) else []:
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is not None and (_names_in(value) & tainted):
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def _is_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    return bool(_names_in(node) & tainted)
+
+
+def check_alz001(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ001: host-device sync on a traced value inside a traced fn."""
+    for fn, call in traced_functions(ctx):
+        static = _static_names_from_call(call, fn) if call is not None else set()
+        tainted = _taint(fn, static)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                mod, name = _callee(node)
+                hit = None
+                if name == "item" and isinstance(node.func, ast.Attribute):
+                    if _is_tainted(node.func.value, tainted):
+                        hit = ".item()"
+                elif mod is None and name in _HOST_SYNC_BUILTINS and node.args:
+                    if _is_tainted(node.args[0], tainted):
+                        hit = f"{name}()"
+                elif mod in _NUMPY_MODULES and name in ("asarray", "array") and node.args:
+                    if _is_tainted(node.args[0], tainted):
+                        hit = f"{mod}.{name}()"
+                if hit:
+                    yield Finding(
+                        "ALZ001",
+                        f"{hit} on a traced value forces a host-device sync "
+                        "inside a jit/vmap scope (TracerConversionError at "
+                        "best, a silent recompile+readback at worst); keep "
+                        "it in jnp or move the readback outside the "
+                        "transform",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+
+
+def check_alz002(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ002: Python control flow branching on a traced value."""
+    for fn, call in traced_functions(ctx):
+        static = _static_names_from_call(call, fn) if call is not None else set()
+        tainted = _taint(fn, static)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.If, ast.While)) and _is_tainted(
+                    node.test, tainted
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        "ALZ002",
+                        f"Python `{kind}` branches on a traced value inside "
+                        "a jit/vmap scope (ConcretizationTypeError); use "
+                        "jnp.where / lax.cond / lax.while_loop, or mark the "
+                        "argument static",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+
+
+def _is_hashable_static_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str, bool)) or node.value is None
+    if isinstance(node, ast.Tuple):
+        return all(_is_hashable_static_literal(e) for e in node.elts)
+    return False
+
+
+def check_alz003(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ003: static_argnums/static_argnames that are non-literal
+    (per-call-varying) or unhashable containers; static params with
+    mutable defaults."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_transform_name(node) not in ("jit", "pmap"):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            if isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
+                yield Finding(
+                    "ALZ003",
+                    f"{kw.arg} given a mutable container literal; jit "
+                    "hashes static arguments per call — pass a tuple/int "
+                    "so the cache key is stable and hashable",
+                    ctx.path,
+                    kw.value.lineno,
+                    kw.value.col_offset,
+                )
+            elif not _is_hashable_static_literal(kw.value):
+                yield Finding(
+                    "ALZ003",
+                    f"{kw.arg} is not a literal — a per-call-varying "
+                    "static spec retraces on every call (one compile "
+                    "cache entry per distinct value)",
+                    ctx.path,
+                    kw.value.lineno,
+                    kw.value.col_offset,
+                )
+    # static params whose *default value* is an unhashable literal
+    for fn, call in traced_functions(ctx):
+        if call is None or isinstance(fn, ast.Lambda):
+            continue
+        static = _static_names_from_call(call, fn)
+        if not static:
+            continue
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+        for p, d in zip(pos, defaults):
+            if p.arg in static and isinstance(d, (ast.List, ast.Set, ast.Dict)):
+                yield Finding(
+                    "ALZ003",
+                    f"static argument `{p.arg}` defaults to an unhashable "
+                    "container — jit will raise on the default call path",
+                    ctx.path,
+                    d.lineno,
+                    d.col_offset,
+                )
+
+
+def _establishes_compute_dtype(fn: ast.FunctionDef) -> bool:
+    """True when the function works against a polymorphic compute dtype:
+    assigns ``dtype = compute_dtype(...)``, takes a ``dtype`` param, or
+    casts with ``.astype(dtype)``."""
+    if "dtype" in _param_names(fn):
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            _, name = _callee(node.value)
+            if name == "compute_dtype":
+                return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "astype" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Name) and a.id == "dtype":
+                    return True
+    return False
+
+
+def check_alz004(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ004: un-dtyped f32-defaulting jnp constructor next to a bf16
+    compute dtype — the silent bf16→f32 promotion."""
+    funcs = [
+        n
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.FunctionDef) and _establishes_compute_dtype(n)
+    ]
+    seen: set = set()
+    for fn in funcs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            mod, name = _callee(node)
+            if mod != "jnp" or name not in _F32_CONSTRUCTORS:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if name == "arange" and not any(
+                isinstance(a, ast.Constant) and isinstance(a.value, float)
+                for a in node.args
+            ):
+                # integer arange defaults to int32 — an index vector, not
+                # a promotion hazard; only float bounds produce f32
+                continue
+            # dtype passed positionally: zeros/ones/empty(shape, dtype),
+            # full(shape, fill, dtype)
+            if name in ("zeros", "ones", "empty") and len(node.args) >= 2:
+                continue
+            if name == "full" and len(node.args) >= 3:
+                continue
+            seen.add(id(node))
+            yield Finding(
+                "ALZ004",
+                f"jnp.{name}() without an explicit dtype defaults to "
+                "strong f32 and silently promotes bf16 operands — pass "
+                "dtype= (the function handles a polymorphic compute "
+                "dtype elsewhere)",
+                ctx.path,
+                node.lineno,
+                node.col_offset,
+            )
+
+
+def check_alz005(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ005: blocking device sync inside a ``stage_*`` function — the
+    async-dispatch staging contract (runtime/service.py: stage, then
+    finish AFTER the next work is staged)."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.FunctionDef) or not node.name.startswith(
+            "stage_"
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            mod, name = _callee(sub)
+            hit = None
+            if name == "block_until_ready":
+                hit = ".block_until_ready()"
+            elif mod == "jax" and name == "device_get":
+                hit = "jax.device_get()"
+            elif mod in _NUMPY_MODULES and name in ("asarray", "array"):
+                hit = f"{mod}.{name}() (device→host readback)"
+            if hit:
+                yield Finding(
+                    "ALZ005",
+                    f"{hit} blocks inside staging function "
+                    f"`{node.name}` — staging must dispatch async and let "
+                    "the finisher block, or host work stops overlapping "
+                    "device compute",
+                    ctx.path,
+                    sub.lineno,
+                    sub.col_offset,
+                )

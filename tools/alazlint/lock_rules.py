@@ -1,0 +1,252 @@
+"""Lock-discipline rules (ALZ010-ALZ013) for the threaded host pipeline.
+
+The contract is annotation-driven: a field assigned with a trailing
+``# guarded-by: self._lock`` comment may only be touched inside a
+``with self._lock:`` block in methods of the declaring class
+(``__init__`` is exempt — construction happens-before publication).
+``threading.Condition(self._lock)`` aliases are resolved, so holding
+``self._not_full`` counts as holding ``self._lock`` (the queues.py
+pattern). Deferred bodies (nested ``def``/``lambda``) do NOT inherit
+the enclosing ``with`` — a gauge lambda registered under a lock still
+runs later without it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set
+
+from tools.alazlint.core import FileContext, Finding, callee as _callee
+
+_THREADING_CTORS = {
+    "Lock": "lock",
+    "RLock": "lock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Event": "event",
+}
+
+# call shapes that block the calling thread on I/O or time
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"),
+    ("time_module", "sleep"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("requests", "get"),
+    ("requests", "post"),
+    ("requests", "request"),
+}
+_BLOCKING_METHOD_NAMES = {
+    "recv",
+    "recv_into",
+    "recvfrom",
+    "accept",
+    "connect",
+    "sendall",
+    "makefile",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'_lock' for a ``self._lock`` expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Locks, condition aliases and guarded fields of one class."""
+
+    def __init__(self, ctx: FileContext, cls: ast.ClassDef):
+        self.cls = cls
+        self.kinds: Dict[str, str] = {}  # attr -> lock|condition|event
+        self.base_of: Dict[str, str] = {}  # condition attr -> wrapped lock attr
+        self.guarded: Dict[str, str] = {}  # field attr -> canonical lock attr
+        guard_raw: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    _, name = _callee(value)
+                    if name in _THREADING_CTORS:
+                        self.kinds[attr] = _THREADING_CTORS[name]
+                        if name == "Condition" and value.args:
+                            wrapped = _self_attr(value.args[0])
+                            if wrapped is not None:
+                                self.base_of[attr] = wrapped
+                # the guarded-by comment may sit on ANY line of a
+                # wrapped (black-style multi-line) assignment — scan the
+                # statement's whole span, not just its first line
+                end = getattr(node, "end_lineno", None) or node.lineno
+                for ln in range(node.lineno, end + 1):
+                    lock = ctx.guarded_lines.get(ln)
+                    if lock is not None:
+                        guard_raw[attr] = lock
+                        break
+        for field, lock in guard_raw.items():
+            self.guarded[field] = self.canon(lock)
+
+    def canon(self, attr: str) -> str:
+        return self.base_of.get(attr, attr)
+
+    def is_lockish(self, attr: str) -> bool:
+        return self.kinds.get(attr) in ("lock", "condition")
+
+
+def _blocking_hit(call: ast.Call) -> Optional[str]:
+    mod, name = _callee(call)
+    if (mod, name) in _BLOCKING_MODULE_CALLS:
+        return f"{mod}.{name}()"
+    if mod is None and name == "open":
+        return "open()"
+    if mod is None and name == "sleep":
+        return "sleep()"
+    if name in _BLOCKING_METHOD_NAMES and isinstance(call.func, ast.Attribute):
+        return f".{name}()"
+    return None
+
+
+def _iter_classes(ctx: FileContext) -> Iterable[ast.ClassDef]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def _walk_method(
+    ctx: FileContext,
+    model: _ClassModel,
+    node: ast.AST,
+    held: FrozenSet[str],
+    in_while: bool,
+    findings: List[Finding],
+) -> None:
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        newly: Set[str] = set()
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is not None and model.is_lockish(attr):
+                newly.add(model.canon(attr))
+            _walk_method(ctx, model, expr, held, in_while, findings)
+        for stmt in node.body:
+            _walk_method(
+                ctx, model, stmt, held | frozenset(newly), in_while, findings
+            )
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        # deferred body: the enclosing `with` will NOT be held at run time
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            _walk_method(ctx, model, stmt, frozenset(), False, findings)
+        return
+    if isinstance(node, ast.While):
+        _walk_method(ctx, model, node.test, held, True, findings)
+        for stmt in node.body + node.orelse:
+            _walk_method(ctx, model, stmt, held, True, findings)
+        return
+
+    attr = _self_attr(node)
+    if attr is not None and attr in model.guarded:
+        lock = model.guarded[attr]
+        if lock not in held:
+            findings.append(
+                Finding(
+                    "ALZ010",
+                    f"`self.{attr}` is declared `# guarded-by: "
+                    f"self.{lock}` but is touched without holding it — "
+                    f"wrap the access in `with self.{lock}:` (or add a "
+                    "justified disable for an intentionally racy read)",
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+            )
+
+    if isinstance(node, ast.Call):
+        if held:
+            hit = _blocking_hit(node)
+            if hit:
+                findings.append(
+                    Finding(
+                        "ALZ011",
+                        f"blocking call {hit} while holding "
+                        f"{'/'.join(sorted(held))} — I/O under a lock "
+                        "stalls every thread contending for it; move the "
+                        "I/O outside the critical section",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+        if isinstance(node.func, ast.Attribute):
+            obj_attr = _self_attr(node.func.value)
+            if node.func.attr == "acquire" and obj_attr is not None and (
+                model.is_lockish(obj_attr)
+            ):
+                findings.append(
+                    Finding(
+                        "ALZ012",
+                        f"bare `self.{obj_attr}.acquire()` — an exception "
+                        "before release() deadlocks every waiter; use "
+                        f"`with self.{obj_attr}:`",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+            if (
+                node.func.attr == "wait"
+                and obj_attr is not None
+                and model.kinds.get(obj_attr) == "condition"
+                and not in_while
+            ):
+                findings.append(
+                    Finding(
+                        "ALZ013",
+                        f"`self.{obj_attr}.wait()` outside a `while` "
+                        "predicate loop — condition waits can wake "
+                        "spuriously (and the predicate can be re-falsified "
+                        "before the woken thread runs); re-check in a loop",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+
+    for child in ast.iter_child_nodes(node):
+        _walk_method(ctx, model, child, held, in_while, findings)
+
+
+def check_lock_discipline(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ010-ALZ013, one pass per class."""
+    findings: List[Finding] = []
+    for cls in _iter_classes(ctx):
+        model = _ClassModel(ctx, cls)
+        if not model.kinds and not model.guarded:
+            continue
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            for stmt in item.body:
+                _walk_method(ctx, model, stmt, frozenset(), False, findings)
+    return findings

@@ -1,0 +1,85 @@
+"""Rule registry: stable code -> (summary, checker).
+
+Codes are append-only — a retired rule's code is never reused, so
+``# alazlint: disable=`` comments and CI grep lines stay meaningful
+across versions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable
+
+from tools.alazlint import jax_rules, lock_rules
+from tools.alazlint.core import FileContext, Finding
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    summary: str
+    check: Callable[[FileContext], Iterable[Finding]]
+
+
+_ALL = [
+    Rule(
+        "ALZ001",
+        "host-device sync (.item()/float()/np.asarray()) on a traced value "
+        "inside a jit/vmap scope",
+        jax_rules.check_alz001,
+    ),
+    Rule(
+        "ALZ002",
+        "Python if/while branching on a traced value inside a jit/vmap scope",
+        jax_rules.check_alz002,
+    ),
+    Rule(
+        "ALZ003",
+        "non-literal / unhashable static_argnums-static_argnames spec",
+        jax_rules.check_alz003,
+    ),
+    Rule(
+        "ALZ004",
+        "un-dtyped f32-defaulting jnp constructor next to a polymorphic "
+        "compute dtype (silent bf16 promotion)",
+        jax_rules.check_alz004,
+    ),
+    Rule(
+        "ALZ005",
+        "blocking device sync inside a stage_* function (async staging "
+        "contract)",
+        jax_rules.check_alz005,
+    ),
+    Rule(
+        "ALZ010",
+        "# guarded-by field touched outside `with <lock>:`",
+        lock_rules.check_lock_discipline,
+    ),
+    Rule(
+        "ALZ011",
+        "blocking I/O while holding a lock",
+        lambda ctx: (),  # emitted by the ALZ010 walk; registered for --list-rules
+    ),
+    Rule(
+        "ALZ012",
+        "bare lock.acquire() instead of `with`",
+        lambda ctx: (),
+    ),
+    Rule(
+        "ALZ013",
+        "condition .wait() not re-checked in a while loop",
+        lambda ctx: (),
+    ),
+    Rule(
+        "ALZ000",
+        "alazlint disable comment without a justification",
+        lambda ctx: (),  # emitted by the core suppression pass
+    ),
+    Rule(
+        "ALZ900",
+        "file does not parse",
+        lambda ctx: (),  # emitted by the core driver
+    ),
+]
+
+RULES: Dict[str, Rule] = {r.code: r for r in _ALL}
