@@ -7,15 +7,21 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint image image-multiarch bench
+.PHONY: native test lint sanitize image image-multiarch bench
 
 native:  ## libalaz_ingest.so + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
 
-test: lint
-	python -m pytest tests/ -x -q
+# sanitize runs first as its own gate; the main run skips that file so
+# the suite isn't paid twice (tier-1 CI runs plain `pytest tests/` and
+# still covers it)
+test: lint sanitize
+	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py
 
-lint:  ## alazlint AST gate (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
+sanitize:  ## alazsan runtime heads: lock-order stress + retrace budgets + transfer guard (CPU-only, no TPU needed)
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_sanitize.py -q
+
+lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
 	python -m tools.alazlint alaz_tpu/ tools/alazlint --json
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check alaz_tpu tools; \

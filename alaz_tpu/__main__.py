@@ -77,7 +77,7 @@ def _stream_tgn_eval(cfg, params, data, collect_next: bool = False):
     mem = tgn.init_memory(
         cfg, max(cfg.tgn_max_nodes, max(b.n_pad for b in data.all_batches))
     )
-    jstep = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
+    jstep = tgn.make_step_fn(cfg)  # cached per config — no per-run retrace
     eval_ids = {id(b) for b in data.eval}
     out_rows = []
     for b in data.all_batches:

@@ -31,11 +31,13 @@ class ReverseDnsCache:
     def __init__(self, ttl_s: float = DEFAULT_TTL_S, do_lookups: Optional[bool] = None):
         self.ttl_s = ttl_s
         self.do_lookups = enabled() if do_lookups is None else do_lookups
-        self._cache: Dict[int, tuple[str, float]] = {}
-        self._pending: set[int] = set()
-        self._queue: "queue.Queue[int]" = queue.Queue()
+        self._cache: Dict[int, tuple[str, float]] = {}  # guarded-by: self._lock
+        self._pending: set[int] = set()  # guarded-by: self._lock
+        self._queue: "queue.Queue[int]" = queue.Queue()  # internally synchronized
         self._lock = threading.Lock()
-        self._worker: Optional[threading.Thread] = None
+        # worker handle: checked/respawned under the lock in name_for so
+        # two hot-path callers can't both spawn one
+        self._worker: Optional[threading.Thread] = None  # guarded-by: self._lock
 
     def name_for(self, ip_u32: int, now_s: Optional[float] = None) -> str:
         """Best current name: cached hostname, else the dotted IP (a single

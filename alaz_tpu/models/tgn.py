@@ -15,6 +15,7 @@ streaming callers can size it from the first window and let it grow.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict
 
 import jax
@@ -25,6 +26,20 @@ from alaz_tpu.models import graphsage
 from alaz_tpu.models.common import compute_dtype, dense, dense_init
 
 Params = Dict[str, Any]
+
+
+@functools.lru_cache(maxsize=None)
+def make_step_fn(cfg: ModelConfig):
+    """Jitted ``step`` closed over a ModelConfig, cached per config so
+    every streaming caller (the scoring service, the eval CLI) shares ONE
+    trace cache — constructing a fresh ``jax.jit(lambda ...)`` per caller
+    re-traces per (caller, bucket) instead of per bucket (ALZ006, retrace
+    budget). ModelConfig is a frozen dataclass, so equal configs hit."""
+
+    def tgn_step(params, graph, memory):
+        return step(params, graph, memory, cfg)
+
+    return jax.jit(tgn_step)
 
 
 def init(key: jax.Array, cfg: ModelConfig) -> Params:

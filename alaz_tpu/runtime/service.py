@@ -13,6 +13,7 @@ Pause/resume hooks match the health checker's stop/resume protocol.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time as time_module
 from dataclasses import dataclass
@@ -135,6 +136,25 @@ class StagingArenas:
         return arena
 
 
+@functools.lru_cache(maxsize=None)
+def _batched_score_fn(cfg):
+    """Jitted vmapped score fn, cached per ModelConfig: every Service
+    with the same config shares one trace cache, so re-construction never
+    re-traces (ALZ006 / the retrace budget). The inner fn is NAMED so the
+    compile log (sanitize.retrace.CompileWatcher) can attribute compiles
+    to this entry point."""
+    import jax
+
+    from alaz_tpu.models.registry import get_model
+
+    _, apply = get_model(cfg.model)
+
+    def batched_score_apply(params, graph):
+        return apply(params, graph, cfg)
+
+    return jax.jit(jax.vmap(batched_score_apply, in_axes=(None, 0)))
+
+
 class FanoutDataStore(BaseDataStore):
     """Tee persisted data to several sinks (graph store + export backend)."""
 
@@ -228,8 +248,6 @@ class Service:
         self._tgn_memory = None  # temporal model node memory (tgn only)
         if model_state is not None:
             if self.config.model.model == "tgn":
-                import jax
-
                 from alaz_tpu.models import tgn
 
                 # pre-size memory to the largest configured bucket so a
@@ -239,8 +257,9 @@ class Service:
                 self._tgn_memory = tgn.init_memory(
                     self.config.model, max_nodes=self.config.model.tgn_max_nodes
                 )
-                cfg = self.config.model
-                jitted_step = jax.jit(lambda p, g, m: tgn.step(p, g, m, cfg))
+                # cached per ModelConfig: repeated Service construction
+                # shares one jitted step and its compile cache (ALZ006)
+                jitted_step = tgn.make_step_fn(self.config.model)
 
                 def tgn_score(params, graph):
                     out, self._tgn_memory = jitted_step(params, graph, self._tgn_memory)
@@ -262,15 +281,7 @@ class Service:
             and self._batch_windows > 1
             and self.config.model.model != "tgn"
         ):
-            import jax
-
-            from alaz_tpu.models.registry import get_model as _get_model
-
-            _, _apply = _get_model(self.config.model.model)
-            _mcfg = self.config.model
-            self._score_many_fn = jax.jit(
-                jax.vmap(lambda p, g: _apply(p, g, _mcfg), in_axes=(None, 0))
-            )
+            self._score_many_fn = _batched_score_fn(self.config.model)
 
         self.housekeeping_interval_s = 120.0  # reference ticker cadence
         self.scored_batches = 0

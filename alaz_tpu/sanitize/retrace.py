@@ -1,0 +1,168 @@
+"""Retrace sanitizer: compile counting per jit entry point + budgets +
+transfer guard.
+
+XLA compiles are the serving-path cliff: a shape outside the bucket set,
+a fresh ``jax.jit`` wrapper per call, or a Python-type flip in an
+argument each quietly compile a new program (seconds, on the scorer's
+critical path). ``CompileWatcher`` captures jax's ``log_compiles``
+records — each carries the traced function's *name* ("Compiling
+score_apply with global shapes …"), so compiles attribute cleanly to the
+named entry points (``score_apply``, ``batched_score_apply``,
+``tgn_step``, ``train_step``). ``retrace_budget`` turns a count into an
+asserted budget; ``no_implicit_transfers`` bans implicit host↔device
+traffic for steady-state sections (explicit ``jnp.asarray`` staging and
+``np.asarray`` readback stay legal under jax's "disallow" level — it is
+the *implicit* transfers, e.g. a raw numpy array silently shipped per
+call, that the guard rejects).
+
+Implementation note: the log capture rides the public
+``jax_log_compiles`` config + a logging handler on the ``"jax"`` logger
+(records propagate up from ``jax._src.interpreters.pxla``), which is
+stable across the jax 0.4.x line — unlike the private cache-miss
+callback APIs.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from collections import Counter
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class RetraceBudgetExceeded(AssertionError):
+    """A jit entry point compiled more often than its declared budget."""
+
+
+# the declared steady-state budgets, by traced-function name: after
+# warmup, ZERO compiles — every serving-path entry point pre-compiles one
+# program per (model, shape bucket) and never again. Tests warm explicit
+# bucket sets and then assert these.
+STEADY_STATE_BUDGETS: Dict[str, int] = {
+    "score_apply": 0,  # runtime/service serial scorer (trainstep.make_score_fn)
+    "batched_score_apply": 0,  # runtime/service vmapped group scorer
+    "tgn_step": 0,  # models/tgn.make_step_fn streaming step
+    "train_step": 0,  # train/trainstep.make_train_step
+}
+
+_COMPILING_RE = re.compile(r"^Compiling ([^\s]+)")
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, watcher: "CompileWatcher"):
+        super().__init__(level=logging.DEBUG)
+        self._watcher = watcher
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 - a broken record must not kill the app
+            return
+        m = _COMPILING_RE.match(msg)
+        if m:
+            self._watcher._record(m.group(1), msg)
+
+
+class CompileWatcher:
+    """Context manager counting XLA compiles per traced-function name.
+
+    >>> with CompileWatcher() as w:
+    ...     fn(x)
+    ...     assert w.count("score_apply") == 1
+
+    Nesting is safe (each watcher owns its handler; ``jax_log_compiles``
+    is saved/restored). Counts include every shape instantiation — one
+    per (entry point, shape bucket) is the expected steady state.
+    """
+
+    def __init__(self) -> None:
+        self.events: List[Tuple[str, str]] = []  # (traced fn name, full message)
+        self._handler: Optional[_CaptureHandler] = None
+        self._prev_log_compiles: Optional[bool] = None
+
+    def _record(self, name: str, msg: str) -> None:
+        self.events.append((name, msg))
+
+    def __enter__(self) -> "CompileWatcher":
+        import jax
+
+        self._handler = _CaptureHandler(self)
+        logging.getLogger("jax").addHandler(self._handler)
+        self._prev_log_compiles = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        if self._prev_log_compiles is not None:
+            jax.config.update("jax_log_compiles", self._prev_log_compiles)
+        if self._handler is not None:
+            logging.getLogger("jax").removeHandler(self._handler)
+            self._handler = None
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def counts(self) -> Counter:
+        return Counter(name for name, _ in self.events)
+
+    @property
+    def total(self) -> int:
+        return len(self.events)
+
+    def count(self, name: str) -> int:
+        """Compiles of one traced-function name (exact match)."""
+        return self.counts[name]
+
+
+@contextmanager
+def retrace_budget(
+    budgets: Dict[str, int], watcher: Optional[CompileWatcher] = None
+) -> Iterator[CompileWatcher]:
+    """Assert per-entry-point compile budgets over a ``with`` block.
+
+    ``budgets`` maps traced-function names to the maximum number of
+    compiles allowed inside the block (0 = steady state, N = warmup of N
+    buckets). Pass an already-open ``watcher`` to share one capture;
+    counts are measured as a delta either way."""
+    own = watcher is None
+    w = CompileWatcher() if watcher is None else watcher
+    if own:
+        w.__enter__()
+    base = {name: w.count(name) for name in budgets}
+    try:
+        yield w
+    finally:
+        if own:
+            w.__exit__()
+    over = {
+        name: (w.count(name) - base[name], limit)
+        for name, limit in budgets.items()
+        if w.count(name) - base[name] > limit
+    }
+    if over:
+        detail = ", ".join(
+            f"{name}: {got} compile(s) > budget {limit}"
+            for name, (got, limit) in sorted(over.items())
+        )
+        raise RetraceBudgetExceeded(
+            f"retrace budget exceeded — {detail}. A steady-state scorer "
+            "compiles once per (model, shape bucket) during warmup and "
+            "never again; new compiles here mean shape churn outside the "
+            "bucket set, a fresh jit wrapper per call, or a Python-type "
+            "flip in an argument (see tools/alazlint ALZ006)."
+        )
+
+
+@contextmanager
+def no_implicit_transfers() -> Iterator[None]:
+    """Ban implicit host↔device transfers for the enclosed block — the
+    steady-state scorer contract: staging is explicit (``jnp.asarray``
+    into arenas), readback is explicit (``np.asarray`` on results), and
+    anything else silently serializing the pipeline raises."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield

@@ -246,8 +246,13 @@ class K8sWatchSource:
         self.token_file = token_file
         self.ca_file = ca_file
         self._stop = threading.Event()
+        # control-plane lifecycle only: appended in start(), joined in
+        # stop(), both on the owner's thread — unlike ingest_server's
+        # accept-loop-rebound list this is never touched by the workers
         self._threads: List[threading.Thread] = []
-        self._watches: set = set()
+        # live watch streams: kind loops add/discard them concurrently
+        # with stop()'s close sweep
+        self._watches: set = set()  # guarded-by: self._watch_lock
         self._watch_lock = threading.Lock()
         self._client: Optional[K8sRestClient] = None
         self._service = None

@@ -166,27 +166,39 @@ class ConnectionPool:
     def __init__(self, factory: Callable[[], Connection], max_size: int = 4):
         self.factory = factory
         self.max_size = max_size
-        self._pool: List[Connection] = []
+        self._pool: List[Connection] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
-        self.created = 0
-        self.discarded = 0
+        self.created = 0  # guarded-by: self._lock
+        self.discarded = 0  # guarded-by: self._lock
 
     def get(self) -> Connection:
-        with self._lock:
-            while self._pool:
+        """Pop a live pooled conn, else dial. The ``alive()`` probe is a
+        1 ms socket read — real I/O, so it runs OUTSIDE the lock (ALZ011
+        in spirit: a stack of dead conns would otherwise stall every
+        thread contending for the pool behind serial probe timeouts)."""
+        while True:
+            with self._lock:
+                if not self._pool:
+                    break
                 conn = self._pool.pop()
-                if conn.alive():
-                    return conn
+            if conn.alive():
+                return conn
+            with self._lock:
                 self.discarded += 1
-                conn.close()
-        self.created += 1
+            conn.close()
+        with self._lock:
+            self.created += 1
         return self.factory()
 
     def put(self, conn: Connection) -> None:
-        with self._lock:
-            if len(self._pool) < self.max_size and conn.alive():
-                self._pool.append(conn)
-                return
+        # probe before taking the lock (same I/O-outside-lock rule);
+        # worst case a racing put overfills by a probe's width and the
+        # length re-check under the lock closes the extra conn
+        if conn.alive():
+            with self._lock:
+                if len(self._pool) < self.max_size:
+                    self._pool.append(conn)
+                    return
         conn.close()
 
     def close(self) -> None:
@@ -213,11 +225,12 @@ class LogStreamer:
         self.pool = pool
         self.poll_interval_s = poll_interval_s
         self.read_interval_s = read_interval_s
-        self._tails: Dict[str, _Tail] = {}
+        # watch/unwatch race the pump thread's snapshot
+        self._tails: Dict[str, _Tail] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.bytes_sent = 0
+        self.bytes_sent = 0  # guarded-by: self._lock
 
     def watch(self, key: str, path: str | Path, metadata: dict | None = None, from_start: bool = False) -> None:
         """Start tailing a log file; preexisting content is skipped
@@ -271,7 +284,8 @@ class LogStreamer:
             tail.pos = new_pos
             sent += len(data)
             self.pool.put(conn)
-        self.bytes_sent += sent
+        with self._lock:
+            self.bytes_sent += sent
         return sent
 
     def start(self, service=None) -> None:

@@ -6,6 +6,7 @@ reuse the cache. The optimizer is adamw via optax.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, List
 
@@ -185,10 +186,19 @@ def train_tgn_unrolled(
     return TrainState(params=params, opt_state=opt_state, step=len(losses)), losses
 
 
+@functools.lru_cache(maxsize=None)
 def make_score_fn(cfg: ModelConfig) -> Callable:
-    """Jitted inference fn (one compile per shape bucket)."""
+    """Jitted inference fn (one compile per shape bucket). Cached per
+    ModelConfig — frozen dataclass, hashable — so repeated Service
+    construction / repeated CLI scoring shares ONE trace cache instead of
+    re-tracing per caller (ALZ006, the retrace budget). The inner fn is
+    named so the compile log attributes compiles to this entry point."""
     _, apply = get_model(cfg.model)
-    return jax.jit(lambda params, graph: apply(params, graph, cfg))
+
+    def score_apply(params, graph):
+        return apply(params, graph, cfg)
+
+    return jax.jit(score_apply)
 
 
 def score_batch(cfg: ModelConfig, params, batch: GraphBatch, score_fn: Callable | None = None) -> dict:

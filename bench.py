@@ -334,9 +334,28 @@ def bench_ingest(args) -> dict:
         edges = sum(b.n_edges for b in closed)
         return dt, len(closed), edges
 
+    # the host path must never touch XLA: any compile during ingest is a
+    # retrace regression (a jit leaking into the hot loop), so the
+    # sanitizer's compile hook rides along and its count lands in the
+    # JSON line — BENCH_* rounds catch it next to rows/s (expected: 0)
+    import importlib.util
+
+    if importlib.util.find_spec("jax") is not None:
+        from alaz_tpu.sanitize.retrace import CompileWatcher
+
+        compile_watcher = CompileWatcher()
+    else:  # jax-less data-plane image: no compiles possible
+        compile_watcher = None
+
     # no warm-up run: every run_once builds fresh state, and best-of-N
     # already absorbs cold-start effects
-    best = min((run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0])
+    if compile_watcher is not None:
+        with compile_watcher:
+            best = min(
+                (run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0]
+            )
+    else:
+        best = min((run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0])
     dt, n_windows, n_edges = best
     rows_per_s = n_rows / dt
     print(
@@ -352,6 +371,7 @@ def bench_ingest(args) -> dict:
         "vs_baseline": round(rows_per_s / 200_000, 3),  # reference: 200k req/s bar
         "rows": n_rows,
         "windows_closed": n_windows,
+        "jit_compile_count": compile_watcher.total if compile_watcher else 0,
     }
 
 

@@ -21,3 +21,32 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # data-plane-only environments
     pass
+
+# ---------------------------------------------------------------------------
+# alazsan pytest plugin (ISSUE 3): opt-in sanitizer fixtures. A test that
+# takes `lock_sanitizer` runs with threading.Lock/RLock/Condition
+# instrumented for its whole body and FAILS at teardown if the observed
+# lock-order graph has a cycle; `compile_watcher` hands it a live XLA
+# compile counter (per traced-function name) for retrace-budget asserts.
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def lock_sanitizer():
+    """Instrumented-lock window + acyclicity gate at teardown."""
+    from alaz_tpu.sanitize import lockorder
+
+    with lockorder.instrument() as monitor:
+        yield monitor
+    monitor.assert_acyclic()
+
+
+@pytest.fixture
+def compile_watcher():
+    """Live per-entry-point XLA compile counter (sanitize.retrace)."""
+    from alaz_tpu.sanitize.retrace import CompileWatcher
+
+    with CompileWatcher() as watcher:
+        yield watcher

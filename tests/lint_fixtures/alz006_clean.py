@@ -1,0 +1,32 @@
+"""ALZ006 clean fixture: the legal counterparts.
+
+Module-level jit-of-lambda traces once per process; a maker under
+``functools.lru_cache`` builds one jit per distinct config; a jit hoisted
+out of the loop reuses one cache; call sites that keep one Python type
+per positional slot hit one cache entry per shape.
+"""
+
+import functools
+
+import jax
+
+_double = jax.jit(lambda v: v * 2)  # module scope: one trace cache, ever
+
+
+@functools.lru_cache(maxsize=None)
+def cached_maker(cfg):
+    # per-call construction is fine when the maker itself is cached: one
+    # jit per distinct (hashable) cfg, shared by every caller
+    return jax.jit(lambda p: p * cfg)
+
+
+def jit_hoisted_out_of_loop(f, xs):
+    jf = jax.jit(f)
+    return [jf(x) for x in xs]
+
+
+scale = jax.jit(lambda x, s: x * s)
+
+
+def call_sites_keep_one_type(x):
+    return scale(x, 2.0), scale(x, 3.0)

@@ -1,0 +1,35 @@
+"""ALZ006 flagged fixture: every retrace-risk shape the rule catches.
+
+(a) jit constructed inside a loop — a fresh trace cache per iteration.
+(b) jit of a fresh lambda inside a plain function — a fresh trace cache
+    per call (also through a wrapping vmap).
+(c) a jit'd entry point whose call sites flip the Python type of a
+    positional literal — one compile-cache entry per type.
+"""
+
+import jax
+
+
+def fresh_lambda_per_call(cfg):
+    return jax.jit(lambda p: p * cfg.scale)  # alz-expect: ALZ006
+
+
+def fresh_vmapped_lambda_per_call(cfg):
+    return jax.jit(jax.vmap(lambda p: p * cfg.scale))  # alz-expect: ALZ006
+
+
+def jit_in_loop(fns, x):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)  # alz-expect: ALZ006
+        outs.append(jf(x))
+    return outs
+
+
+scale = jax.jit(lambda x, s: x * s)
+
+
+def call_sites_flip_literal_type(x):
+    a = scale(x, 2)
+    b = scale(x, 2.5)  # alz-expect: ALZ006
+    return a, b

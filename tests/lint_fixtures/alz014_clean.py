@@ -1,0 +1,36 @@
+"""ALZ014 clean fixture: the same two-lock pipeline with ONE global
+order — every path that needs both locks takes ``_front`` before
+``_back``. Nesting through calls is fine as long as the order never
+inverts; so is sequential (non-nested) use in opposite textual order.
+"""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._front = threading.Lock()
+        self._back = threading.Lock()
+        self.staged = 0
+        self.done = 0
+
+    def _touch_back(self):
+        with self._back:
+            self.staged += 1
+
+    def forward(self):
+        with self._front:
+            self._touch_back()  # front → back: the global order
+
+    def backward(self):
+        # needs both: takes them in the SAME order as forward
+        with self._front:
+            with self._back:
+                self.done += 1
+
+    def sequential_is_fine(self):
+        # back then front NOT nested: no order edge at all
+        with self._back:
+            self.staged += 1
+        with self._front:
+            self.done += 1

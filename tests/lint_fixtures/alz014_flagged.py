@@ -1,0 +1,33 @@
+"""ALZ014 flagged fixture: a lock-order inversion no single function
+shows. ``forward`` holds ``_front`` and reaches ``_back`` through a
+helper call; ``backward`` holds ``_back`` and reaches ``_front`` through
+another helper — two threads taking the two paths concurrently deadlock.
+Each function's body is individually blameless (the PR 2 intra-function
+rules see nothing); only the call graph reveals the cycle.
+"""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._front = threading.Lock()
+        self._back = threading.Lock()
+        self.staged = 0
+        self.done = 0
+
+    def _touch_back(self):
+        with self._back:
+            self.staged += 1
+
+    def _touch_front(self):
+        with self._front:
+            self.done += 1
+
+    def forward(self):
+        with self._front:
+            self._touch_back()  # alz-expect: ALZ014
+
+    def backward(self):
+        with self._back:
+            self._touch_front()  # alz-expect: ALZ014

@@ -24,6 +24,7 @@ import pytest
 
 from tools.alazlint import RULES, lint_paths, lint_source
 from tools.alazlint.core import main as alazlint_main
+from tools.alazlint.rules import PROGRAM_RULES
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = REPO / "tests" / "lint_fixtures"
@@ -40,10 +41,12 @@ PAIRED_CODES = [
     "ALZ003",
     "ALZ004",
     "ALZ005",
+    "ALZ006",
     "ALZ010",
     "ALZ011",
     "ALZ012",
     "ALZ013",
+    "ALZ014",
 ]
 
 
@@ -74,10 +77,13 @@ class TestFixtureCorpus:
         assert findings == [], [f.render() for f in findings]
 
     def test_rule_catalog_covers_fixture_pairs(self):
+        catalog = {**RULES, **PROGRAM_RULES}
         for code in PAIRED_CODES:
-            assert code in RULES, f"fixture pair exists for unregistered {code}"
+            assert code in catalog, f"fixture pair exists for unregistered {code}"
         # the acceptance floor: at least 8 behavior rules proven by pairs
         assert len([c for c in PAIRED_CODES if c not in ("ALZ000",)]) >= 8
+        # per-file and whole-program registries must not collide
+        assert not set(RULES) & set(PROGRAM_RULES)
 
     def test_parse_error_reported_as_alz900(self):
         findings = lint_source("broken.py", "def f(:\n")
@@ -95,6 +101,107 @@ class TestFixtureCorpus:
         )
         codes = {f.code for f in lint_source("t.py", src)}
         assert "ALZ010" in codes  # a disable for a DIFFERENT code keeps it
+
+
+class TestWholeProgram:
+    """The interprocedural pass (tools/alazlint/program.py) beyond what
+    the single-file fixture pairs can show: lock-order cycles that only
+    exist ACROSS modules, and attribute-type inference connecting
+    ``self.<field>.method()`` calls to classes defined elsewhere."""
+
+    def test_cross_module_lock_cycle_detected(self, tmp_path):
+        (tmp_path / "liba.py").write_text(
+            "import threading\n"
+            "from libb import poke_b\n"
+            "lock_a = threading.Lock()\n"
+            "def grab_a():\n"
+            "    with lock_a:\n"
+            "        pass\n"
+            "def a_then_b():\n"
+            "    with lock_a:\n"
+            "        poke_b()\n"
+        )
+        (tmp_path / "libb.py").write_text(
+            "import threading\n"
+            "from liba import grab_a\n"
+            "lock_b = threading.Lock()\n"
+            "def poke_b():\n"
+            "    with lock_b:\n"
+            "        pass\n"
+            "def b_then_a():\n"
+            "    with lock_b:\n"
+            "        grab_a()\n"
+        )
+        findings = lint_paths([str(tmp_path)])
+        got = {(Path(f.path).name, f.line, f.code) for f in findings}
+        assert got == {("liba.py", 9, "ALZ014"), ("libb.py", 9, "ALZ014")}
+        # but EITHER file alone shows nothing: the cycle needs both
+        for name in ("liba.py", "libb.py"):
+            p = tmp_path / name
+            assert lint_source(str(p), p.read_text()) == []
+
+    def test_attr_type_inference_reaches_through_fields(self, tmp_path):
+        # classes in two modules, connected only by `self.q = Queue()` /
+        # `self.h = holder.Holder()` field assignments: each class holds
+        # its own lock while calling INTO the other through the field —
+        # a cycle that needs attribute-type inference to see at all
+        (tmp_path / "qmod.py").write_text(
+            "import threading\n"
+            "import holder\n"
+            "class Queue:\n"
+            "    def __init__(self):\n"
+            "        self._qlock = threading.Lock()\n"
+            "        self.h = holder.Holder()\n"
+            "        self.items = []\n"
+            "    def put(self, x):\n"
+            "        with self._qlock:\n"
+            "            self.items.append(x)\n"
+            "    def drain(self):\n"
+            "        with self._qlock:\n"
+            "            self.h.on_drained()\n"
+        )
+        (tmp_path / "holder.py").write_text(
+            "import threading\n"
+            "from qmod import Queue\n"
+            "class Holder:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.q = Queue()\n"
+            "        self.drained = 0\n"
+            "    def submit(self, x):\n"
+            "        with self._lock:\n"
+            "            self.q.put(x)\n"
+            "    def on_drained(self):\n"
+            "        with self._lock:\n"
+            "            self.drained += 1\n"
+        )
+        findings = lint_paths([str(tmp_path)])
+        got = {(Path(f.path).name, f.line, f.code) for f in findings}
+        # Holder._lock → Queue._qlock at submit's self.q.put(x), and
+        # Queue._qlock → Holder._lock at drain's self.h.on_drained()
+        assert got == {
+            ("holder.py", 10, "ALZ014"),
+            ("qmod.py", 13, "ALZ014"),
+        }
+
+    def test_jit_entry_point_type_variance_across_modules(self, tmp_path):
+        (tmp_path / "kern.py").write_text(
+            "import jax\n"
+            "scale = jax.jit(lambda x, s: x * s)\n"
+            "def local_use(x):\n"
+            "    return scale(x, 2)\n"
+        )
+        (tmp_path / "caller.py").write_text(
+            "from kern import scale\n"
+            "def remote_use(x):\n"
+            "    return scale(x, 2.0)\n"
+        )
+        findings = lint_paths([str(tmp_path)])
+        # sites are ordered by path: caller.py's float is first-seen, so
+        # kern.py's int literal is the divergent one
+        assert [(Path(f.path).name, f.code) for f in findings] == [
+            ("kern.py", "ALZ006")
+        ]
 
 
 class TestSelfEnforcement:

@@ -1,0 +1,319 @@
+"""alazsan runtime heads (ISSUE 3 tentpole): lock-order graph over the
+instrumented host pipeline, and retrace budgets + transfer guard over
+the jit'd scorer entry points.
+
+These ARE the tier-1 gate for the two dynamic invariants the static
+rules can't prove:
+
+- the host pipeline's lock-order graph stays acyclic under concurrent
+  ingest → queues → intern → staging traffic (ALZ014's runtime twin);
+- after warmup the scorer compiles exactly once per (model, bucket) and
+  runs steady-state with zero implicit host↔device transfers (ALZ006's
+  runtime twin).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from alaz_tpu.sanitize import lockorder
+from alaz_tpu.sanitize.retrace import (
+    CompileWatcher,
+    RetraceBudgetExceeded,
+    no_implicit_transfers,
+    retrace_budget,
+)
+
+
+class TestLockOrderMonitor:
+    def test_opposite_orders_on_two_threads_reported_as_cycle(self):
+        """The satellite contract: two wrapped locks acquired A→B on one
+        thread and B→A on another IS a cycle, even though the threads ran
+        at different times and nothing deadlocked."""
+        with lockorder.instrument() as mon:
+            a = threading.Lock()
+            b = threading.Lock()
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start()
+        t1.join()
+        assert mon.cycles() == []  # one order alone is fine
+        t2 = threading.Thread(target=order_ba)
+        t2.start()
+        t2.join()
+
+        cycles = mon.cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+        assert mon.violations, "eager edge-insert check missed the cycle"
+        with pytest.raises(lockorder.LockOrderViolation):
+            mon.assert_acyclic()
+
+    def test_consistent_order_is_acyclic_and_reentrant_is_no_self_edge(self):
+        with lockorder.instrument() as mon:
+            outer = threading.Lock()
+            inner = threading.Lock()
+            r = threading.RLock()
+
+        def nest():
+            with outer:
+                with inner:
+                    pass
+            with r:
+                with r:  # re-entrant: must not add a self edge
+                    pass
+
+        threads = [threading.Thread(target=nest) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mon.assert_acyclic()
+        assert mon.graph_summary()["edges"] == 1  # outer→inner only
+
+    def test_condition_wait_releases_and_reacquires(self, lock_sanitizer):
+        """queues.py's pattern: Condition(self._lock) aliases onto the
+        lock node; wait() must drop the hold (another thread can take the
+        lock mid-wait without creating edges from the waiter). Uses the
+        conftest plugin fixture: the acyclicity gate runs at teardown."""
+        lock = threading.Lock()
+        cond = threading.Condition(lock)
+        state = {"ready": False}
+
+        def waiter():
+            with cond:
+                while not state["ready"]:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:  # wait() released the lock, so this acquires
+            state["ready"] = True
+            cond.notify()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+
+class TestHostPipelineLockOrder:
+    def test_ingest_to_staging_stress_is_acyclic(self, lock_sanitizer):
+        """Deterministic concurrency stress over the full host pipeline —
+        ingest_server → service queues → aggregator/interner → staging
+        arenas — with every lock instrumented (the conftest fixture keeps
+        the patch active for the whole test and gates acyclicity at
+        teardown). Also asserts the stress actually exercised a
+        multi-lock graph (an empty graph would vacuously pass)."""
+        mon = lock_sanitizer
+        from alaz_tpu.events.schema import make_l7_events
+        from alaz_tpu.runtime.service import Service, StagingArenas
+        from alaz_tpu.sources.ingest_server import (
+            KIND_L7,
+            IngestServer,
+            send_batches,
+        )
+
+        svc = Service()  # no model: pure host pipeline
+        server = IngestServer(svc, port=0)
+        arenas = StagingArenas()
+        svc.start()
+        server.start()
+        try:
+            ev = make_l7_events(64)
+            ev["write_time_ns"] = 1_000_000_000
+            ev["protocol"] = 1
+
+            def agent(n_frames: int) -> None:
+                send_batches(server.address, [(KIND_L7, ev)] * n_frames)
+
+            cols = [{"x": np.zeros((8, 4), np.float32)} for _ in range(2)]
+
+            def stager(key: str) -> None:
+                for _ in range(50):
+                    arenas.fill((key, 8), cols)
+
+            threads = [threading.Thread(target=agent, args=(20,)) for _ in range(4)]
+            threads += [
+                threading.Thread(target=stager, args=(k,)) for k in ("a", "b")
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            svc.drain(timeout_s=15)
+        finally:
+            server.stop()
+            svc.stop()
+
+        mon.assert_acyclic()
+        summary = mon.graph_summary()
+        # the pipeline has well over a dozen instrumented locks (queues,
+        # interner, arenas, server state, ratelimits…) and the stress
+        # must actually have taken them
+        assert summary["locks"] >= 8, summary
+        assert summary["acquisitions"] > 100, summary
+        assert server.records == 4 * 20 * 64
+
+
+def _mk_batch(n_nodes: int, n_edges: int, cfg, seed: int = 0):
+    """Synthetic GraphBatch at an exact (node, edge) bucket."""
+    from alaz_tpu.graph.snapshot import GraphBatch, pad_to_bucket
+
+    rng = np.random.default_rng(seed)
+    n_pad = pad_to_bucket(n_nodes)
+    e_pad = pad_to_bucket(n_edges)
+    node_mask = np.zeros(n_pad, bool)
+    node_mask[:n_nodes] = True
+    edge_mask = np.zeros(e_pad, bool)
+    edge_mask[:n_edges] = True
+    src = rng.integers(0, n_nodes, e_pad).astype(np.int32)
+    dst = rng.integers(0, n_nodes, e_pad).astype(np.int32)
+    src[n_edges:] = src[n_edges - 1]
+    dst[n_edges:] = n_pad - 1
+    return GraphBatch(
+        node_feats=rng.normal(size=(n_pad, cfg.node_feature_dim)).astype(np.float32),
+        node_type=rng.integers(0, 4, n_pad).astype(np.int32),
+        node_mask=node_mask,
+        edge_src=src,
+        edge_dst=dst,
+        edge_type=rng.integers(0, cfg.num_edge_types, e_pad).astype(np.int32),
+        edge_feats=rng.normal(size=(e_pad, cfg.edge_feature_dim)).astype(np.float32),
+        edge_mask=edge_mask,
+        edge_label=np.zeros(e_pad, np.float32),
+        n_nodes=n_nodes,
+        n_edges=n_edges,
+    )
+
+
+# three distinct bucket shapes: 100→128, 200→256, 400→512
+_BUCKET_SIZES = [(100, 100), (200, 200), (400, 400)]
+
+
+class TestRetraceBudget:
+    @pytest.mark.parametrize("model", ["graphsage", "gat"])
+    def test_scorer_compiles_once_per_bucket_then_steady_state(self, model):
+        """The acceptance bar: warmup compiles exactly one program per
+        (model, bucket); after that, N more windows across the same
+        buckets compile NOTHING, and the steady-state pass runs clean
+        under jax.transfer_guard("disallow")."""
+        import jax
+        import jax.numpy as jnp
+
+        from alaz_tpu.config import ModelConfig
+        from alaz_tpu.models.registry import get_model
+        from alaz_tpu.train.trainstep import make_score_fn
+
+        # off-default dims: this test must own its (cfg → jit cache) so
+        # earlier tests can't have pre-warmed the buckets
+        cfg = ModelConfig(
+            model=model, hidden_dim=24, num_heads=2, use_pallas=False
+        )
+        init, _ = get_model(model)
+        params = init(jax.random.PRNGKey(0), cfg)
+        score_fn = make_score_fn(cfg)
+
+        def score(b):
+            graph = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+            return np.asarray(score_fn(params, graph)["edge_logits"])
+
+        with CompileWatcher() as w:
+            for n, e in _BUCKET_SIZES:  # warmup: one compile per bucket
+                score(_mk_batch(n, e, cfg, seed=n))
+            assert w.count("score_apply") == len(_BUCKET_SIZES), w.counts
+
+            with no_implicit_transfers():
+                with retrace_budget({"score_apply": 0}, watcher=w):
+                    for rep in range(3):  # steady state: same buckets, new data
+                        for n, e in _BUCKET_SIZES:
+                            out = score(_mk_batch(n, e, cfg, seed=100 + rep + n))
+                            assert out.shape[0] >= e
+
+    def test_batched_and_tgn_entry_points_hold_their_budgets(self):
+        import jax
+        import jax.numpy as jnp
+
+        from alaz_tpu.config import ModelConfig
+        from alaz_tpu.models import tgn
+        from alaz_tpu.models.registry import get_model
+        from alaz_tpu.runtime.service import _batched_score_fn
+
+        cfg = ModelConfig(model="graphsage", hidden_dim=24, use_pallas=False)
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(1), cfg)
+        batched = _batched_score_fn(cfg)
+
+        tgn_cfg = ModelConfig(
+            model="tgn", hidden_dim=24, use_pallas=False, tgn_max_nodes=512
+        )
+        tgn_init, _ = get_model("tgn")
+        tgn_params = tgn_init(jax.random.PRNGKey(2), tgn_cfg)
+        step = tgn.make_step_fn(tgn_cfg)
+        memory = tgn.init_memory(tgn_cfg, max_nodes=tgn_cfg.tgn_max_nodes)
+
+        def run_all(mem):
+            for n, e in _BUCKET_SIZES:
+                b = _mk_batch(n, e, cfg, seed=n)
+                stacked = {
+                    k: jnp.asarray(np.stack([v, v]))
+                    for k, v in b.device_arrays().items()
+                }
+                np.asarray(batched(params, stacked)["edge_logits"])
+                tb = _mk_batch(n, e, tgn_cfg, seed=n)
+                g = {k: jnp.asarray(v) for k, v in tb.device_arrays().items()}
+                out, mem = step(tgn_params, g, mem)
+                np.asarray(out["edge_logits"])
+            return mem
+
+        with CompileWatcher() as w:
+            memory = run_all(memory)  # warmup
+            assert w.count("batched_score_apply") == len(_BUCKET_SIZES)
+            assert w.count("tgn_step") == len(_BUCKET_SIZES)
+            with no_implicit_transfers():
+                with retrace_budget(
+                    {"batched_score_apply": 0, "tgn_step": 0}, watcher=w
+                ):
+                    run_all(memory)
+
+    def test_budget_violation_raises_with_attribution(self):
+        import jax
+        import jax.numpy as jnp
+
+        def slope(x):
+            return x * 3
+
+        jitted = jax.jit(slope)
+        with pytest.raises(RetraceBudgetExceeded, match="slope"):
+            with retrace_budget({"slope": 1}):
+                jitted(jnp.ones((4,)))
+                jitted(jnp.ones((8,)))  # second shape: second compile
+
+    def test_repeated_service_construction_shares_one_jit(self):
+        """The ALZ006 fix, observable: two Services with equal configs
+        hand out the SAME jitted callables (same trace cache), so fleet
+        restarts / multi-tenant construction can never re-trace."""
+        import jax
+
+        from alaz_tpu.config import ModelConfig, RuntimeConfig
+        from alaz_tpu.models.registry import get_model
+        from alaz_tpu.runtime.service import Service
+
+        cfg = dict(
+            model=ModelConfig(model="graphsage", hidden_dim=24, use_pallas=False),
+            score_batch_windows=4,
+        )
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg["model"])
+        svc1 = Service(config=RuntimeConfig(**cfg), model_state=params)
+        svc2 = Service(config=RuntimeConfig(**cfg), model_state=params)
+        assert svc1._score_fn is svc2._score_fn
+        assert svc1._score_many_fn is svc2._score_many_fn

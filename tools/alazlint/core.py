@@ -122,26 +122,28 @@ def _expand_disables_over_statements(ctx: FileContext) -> None:
                 ctx.disables.setdefault(ln, set()).update(codes)
 
 
-def lint_source(path: str, source: str) -> List[Finding]:
-    """Lint one file's source; returns surviving findings (suppressions
-    applied, bare suppressions reported as ALZ000)."""
-    from tools.alazlint.rules import RULES
-
+def parse_context(path: str, source: str) -> "FileContext | Finding":
+    """Parse one file into a FileContext (comments scanned, disables
+    expanded), or the ALZ900 Finding when it doesn't parse."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
-        return [
-            Finding(
-                "ALZ900",
-                f"file does not parse: {exc.msg}",
-                path,
-                exc.lineno or 1,
-                (exc.offset or 1) - 1,
-            )
-        ]
+        return Finding(
+            "ALZ900",
+            f"file does not parse: {exc.msg}",
+            path,
+            exc.lineno or 1,
+            (exc.offset or 1) - 1,
+        )
     ctx = FileContext(path=path, source=source, tree=tree)
     _scan_comments(ctx)
     _expand_disables_over_statements(ctx)
+    return ctx
+
+
+def _file_findings(ctx: FileContext) -> List[Finding]:
+    """Per-file rules + suppression filtering + ALZ000 for one context."""
+    from tools.alazlint.rules import RULES
 
     raw: List[Finding] = []
     for rule in RULES.values():
@@ -158,11 +160,38 @@ def lint_source(path: str, source: str) -> List[Finding]:
                 "ALZ000",
                 "disable comment is missing its justification "
                 "(write `# alazlint: disable=ALZxxx -- <why this is safe>`)",
-                path,
+                ctx.path,
                 line,
                 col,
             )
         )
+    return out
+
+
+def _program_findings(ctxs: List[FileContext]) -> List[Finding]:
+    """Whole-program rules over every parsed file of the invocation,
+    with each file's disable comments still honored."""
+    from tools.alazlint.rules import PROGRAM_RULES
+
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    out: List[Finding] = []
+    for rule in PROGRAM_RULES.values():
+        for f in rule.check(ctxs):
+            ctx = by_path.get(f.path)
+            if ctx is not None and f.code in ctx.disables.get(f.line, set()):
+                continue
+            out.append(f)
+    return out
+
+
+def lint_source(path: str, source: str) -> List[Finding]:
+    """Lint one file's source; returns surviving findings (suppressions
+    applied, bare suppressions reported as ALZ000). Whole-program rules
+    run too, scoped to this single file."""
+    ctx = parse_context(path, source)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    out = _file_findings(ctx) + _program_findings([ctx])
     out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return out
 
@@ -192,6 +221,7 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[Path]:
 
 def lint_paths(paths: Iterable[str]) -> List[Finding]:
     findings: List[Finding] = []
+    ctxs: List[FileContext] = []
     for f in iter_py_files(paths):
         try:
             source = f.read_text()
@@ -202,18 +232,29 @@ def lint_paths(paths: Iterable[str]) -> List[Finding]:
                 Finding("ALZ900", f"file is not readable: {exc}", str(f), 1, 0)
             )
             continue
-        findings.extend(lint_source(str(f), source))
+        ctx = parse_context(str(f), source)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        ctxs.append(ctx)
+        findings.extend(_file_findings(ctx))
+    # the whole-program pass sees every file of the invocation at once —
+    # this is what lets ALZ014 chase a lock order across modules
+    findings.extend(_program_findings(ctxs))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     from tools.alazlint.rules import RULES
 
+    from tools.alazlint.rules import PROGRAM_RULES
+
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
     if "--list-rules" in argv:
-        for code, rule in sorted(RULES.items()):
+        for code, rule in sorted({**RULES, **PROGRAM_RULES}.items()):
             print(f"{code}  {rule.summary}")
         return 0
     if not argv:

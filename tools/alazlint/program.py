@@ -1,0 +1,622 @@
+"""Whole-program rules (ALZ006, ALZ014) — the interprocedural half of
+alazsan.
+
+Unlike the per-file rules, these run over *every* FileContext of a lint
+invocation at once, on top of a light project model:
+
+- a **function index** keyed by qualified name (``module:func``,
+  ``module:Class.method``, ``module:func.<nested>``),
+- an **import map** per module (``import alaz_tpu.utils.queues as q`` /
+  ``from alaz_tpu.utils.queues import BatchQueue``),
+- **attribute-type inference** from ``self.x = ClassName(...)``
+  assignments, so ``self.window_queue.put(...)`` resolves to
+  ``BatchQueue.put`` across modules.
+
+ALZ014 builds per-function lock summaries (locks acquired directly, and
+calls made while holding locks), closes them over the call graph to a
+fixpoint, and then looks for cycles in the resulting lock-order graph:
+function A taking lock₁ then reaching (through any call chain) an
+acquisition of lock₂, while function B orders them the other way, is a
+deadlock that no single function's body reveals — exactly what PR 2's
+intra-function ALZ010 family cannot see.
+
+ALZ006 is the static half of the retrace budget: ``jax.jit`` applied
+inside a loop or to a fresh lambda per call builds a new trace cache per
+iteration/call, and a jit'd entry point whose call sites pass different
+Python literal *types* at one position compiles once per type. All three
+shapes are invisible at runtime until the compile log fills up.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import PurePath
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext, Finding, callee as _callee
+from tools.alazlint.jax_rules import _call_transform_name
+
+_LOCKISH_CTORS = {"Lock": "lock", "RLock": "lock", "Condition": "condition"}
+# enclosing decorators that make a per-call jit construction legal: the
+# maker runs once per distinct key, not once per call
+_CACHING_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def module_name(path: str) -> str:
+    """Dotted module name for cross-file resolution. Rooted at the
+    project packages when present (``.../alaz_tpu/utils/queues.py`` →
+    ``alaz_tpu.utils.queues``); bare stem otherwise (fixtures)."""
+    parts = list(PurePath(path).parts)
+    stem_parts = parts[:-1] + [PurePath(path).stem]
+    for root in ("alaz_tpu", "tools"):
+        if root in stem_parts[:-1] or stem_parts[-1] == root:
+            idx = stem_parts.index(root)
+            mod = stem_parts[idx:]
+            if mod[-1] == "__init__":
+                mod = mod[:-1]
+            return ".".join(mod)
+    return stem_parts[-1]
+
+
+# ---------------------------------------------------------------------------
+# Project model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # module:Class.method / module:func / module:func.<n>
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    ctx: FileContext
+    cls: Optional[ast.ClassDef] = None
+
+
+@dataclass
+class ClassInfo:
+    qualname: str  # module:Class
+    node: ast.ClassDef
+    ctx: FileContext
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    cond_base: Dict[str, str] = field(default_factory=dict)  # cond attr -> lock attr
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class qualname
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fn qualname
+
+
+class ProgramModel:
+    """Indexes + import maps over one lint invocation's files."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.ctxs = list(ctxs)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # per module: local name -> fully dotted target ("module" or
+        # "module:Class" or "module:func")
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.module_of: Dict[int, str] = {}
+        for ctx in self.ctxs:
+            self._index_file(ctx)
+        # attr types need the class index complete first
+        for info in self.classes.values():
+            self._infer_attr_types(info)
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_file(self, ctx: FileContext) -> None:
+        mod = module_name(ctx.path)
+        self.module_of[id(ctx)] = mod
+        imports: Dict[str, str] = {}
+        self.imports[mod] = imports
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = (
+                        f"{node.module}:{alias.name}"
+                    )
+
+        def walk_scope(body, prefix: str, cls: Optional[ast.ClassDef]):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{stmt.name}"
+                    self.functions[qn] = FunctionInfo(qn, stmt, ctx, cls)
+                    walk_scope(stmt.body, qn + ".", None)
+                elif isinstance(stmt, ast.ClassDef) and cls is None:
+                    cqn = f"{prefix}{stmt.name}"
+                    cinfo = ClassInfo(cqn, stmt, ctx)
+                    self.classes[cqn] = cinfo
+                    for item in stmt.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            mqn = f"{cqn}.{item.name}"
+                            cinfo.methods[item.name] = mqn
+                            self.functions[mqn] = FunctionInfo(mqn, item, ctx, stmt)
+                            walk_scope(item.body, mqn + ".", None)
+                    self._collect_locks(cinfo)
+
+        walk_scope(ctx.tree.body, f"{mod}:", None)
+
+    def _collect_locks(self, cinfo: ClassInfo) -> None:
+        for node in ast.walk(cinfo.node):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if not isinstance(value, ast.Call):
+                continue
+            _, name = _callee(value)
+            if name not in _LOCKISH_CTORS:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                cinfo.lock_attrs[attr] = _LOCKISH_CTORS[name]
+                if name == "Condition" and value.args:
+                    wrapped = _self_attr(value.args[0])
+                    if wrapped is not None:
+                        cinfo.cond_base[attr] = wrapped
+
+    def _infer_attr_types(self, cinfo: ClassInfo) -> None:
+        mod = self.module_of[id(cinfo.ctx)]
+        for node in ast.walk(cinfo.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            target_cls = self.resolve_class(mod, node.value.func)
+            if target_cls is None:
+                continue
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    cinfo.attr_types[attr] = target_cls
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_class(self, mod: str, func: ast.AST) -> Optional[str]:
+        """Class qualname a constructor expression refers to, if it names
+        a project class (directly, via from-import, or module attr)."""
+        if isinstance(func, ast.Name):
+            local = f"{mod}:{func.id}"
+            if local in self.classes:
+                return local
+            target = self.imports.get(mod, {}).get(func.id)
+            if target and target in self.classes:
+                return target
+        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            target_mod = self.imports.get(mod, {}).get(func.value.id)
+            if target_mod and ":" not in target_mod:
+                qn = f"{target_mod}:{func.attr}"
+                if qn in self.classes:
+                    return qn
+        return None
+
+    def resolve_call(
+        self, call: ast.Call, mod: str, cls: Optional[ast.ClassDef], local_prefix: str
+    ) -> Optional[str]:
+        """Function qualname a call resolves to within the project, or
+        None for unresolvable targets (stdlib, dynamic dispatch)."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # innermost nested def first, then module function, then import
+            nested = f"{local_prefix}{fn.id}"
+            if nested in self.functions:
+                return nested
+            direct = f"{mod}:{fn.id}"
+            if direct in self.functions:
+                return direct
+            target = self.imports.get(mod, {}).get(fn.id)
+            if target and target in self.functions:
+                return target
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls is not None:
+            cinfo = self.classes.get(f"{mod}:{cls.name}")
+            if cinfo is not None:
+                return cinfo.methods.get(fn.attr)
+            return None
+        if isinstance(base, ast.Attribute) and _self_attr(base) and cls is not None:
+            # self.<field>.method(): attribute-type inference
+            cinfo = self.classes.get(f"{mod}:{cls.name}")
+            if cinfo is not None:
+                target_cls = cinfo.attr_types.get(base.attr)
+                if target_cls is not None:
+                    tinfo = self.classes.get(target_cls)
+                    if tinfo is not None:
+                        return tinfo.methods.get(fn.attr)
+            return None
+        if isinstance(base, ast.Name):
+            target_mod = self.imports.get(mod, {}).get(base.id)
+            if target_mod and ":" not in target_mod:
+                qn = f"{target_mod}:{fn.attr}"
+                if qn in self.functions:
+                    return qn
+        return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# ALZ014 — interprocedural lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FnSummary:
+    # locks acquired directly in this function (any context)
+    acquires: Set[str] = field(default_factory=set)
+    # (held-lock, acquired-lock, site-ctx, line, col) direct order edges
+    edges: List[Tuple[str, str, FileContext, int, int]] = field(default_factory=list)
+    # (frozenset(held), callee-qualname, site line/col) calls under locks —
+    # plus calls with nothing held (held=∅) which only matter for the
+    # transitive `acquires` closure
+    calls: List[Tuple[frozenset, str, FileContext, int, int]] = field(
+        default_factory=list
+    )
+
+
+def _lock_id_for(
+    model: ProgramModel, mod: str, cls: Optional[ast.ClassDef], expr: ast.AST
+) -> Optional[str]:
+    """Canonical lock node for a ``with`` context expression: a class
+    lock field (``module:Class.attr``, condition aliases collapsed onto
+    their wrapped lock) or a module-global lock."""
+    attr = _self_attr(expr)
+    if attr is not None and cls is not None:
+        cinfo = model.classes.get(f"{mod}:{cls.name}")
+        if cinfo is not None and attr in cinfo.lock_attrs:
+            return f"{mod}:{cls.name}.{cinfo.cond_base.get(attr, attr)}"
+        return None
+    if isinstance(expr, ast.Name):
+        # module-global lock: assigned threading.Lock()/RLock() at module
+        # scope in the same file
+        ctxs = [c for c in model.ctxs if model.module_of[id(c)] == mod]
+        for ctx in ctxs:
+            for stmt in ctx.tree.body:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    _, name = _callee(stmt.value)
+                    if name in _LOCKISH_CTORS:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name) and t.id == expr.id:
+                                return f"{mod}.{expr.id}"
+    return None
+
+
+def _summarize_fn(model: ProgramModel, info: FunctionInfo) -> _FnSummary:
+    out = _FnSummary()
+    mod = model.module_of[id(info.ctx)]
+    local_prefix = info.qualname + "."
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # nested defs run later, without the enclosing `with` held;
+            # they carry their own qualname summary
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly: List[str] = []
+            for item in node.items:
+                lock = _lock_id_for(model, mod, info.cls, item.context_expr)
+                walk(item.context_expr, held)
+                if lock is not None and lock not in held:
+                    out.acquires.add(lock)
+                    for h in held:
+                        out.edges.append(
+                            (h, lock, info.ctx, item.context_expr.lineno,
+                             item.context_expr.col_offset)
+                        )
+                    newly.append(lock)
+            inner = held + tuple(newly)
+            for stmt in node.body:
+                walk(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            target = model.resolve_call(node, mod, info.cls, local_prefix)
+            if target is not None and target != info.qualname:
+                out.calls.append(
+                    (frozenset(held), target, info.ctx, node.lineno, node.col_offset)
+                )
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    body = info.node.body if isinstance(info.node.body, list) else [info.node.body]
+    for stmt in body:
+        walk(stmt, ())
+    return out
+
+
+def check_alz014(ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+    model = ProgramModel(ctxs)
+    summaries = {qn: _summarize_fn(model, info) for qn, info in model.functions.items()}
+
+    # transitive lock footprint per function, to a fixpoint over the call
+    # graph (cycles in the CALL graph just converge — the union is monotone)
+    footprint: Dict[str, Set[str]] = {qn: set(s.acquires) for qn, s in summaries.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qn, s in summaries.items():
+            for _, callee_qn, _, _, _ in s.calls:
+                extra = footprint.get(callee_qn, set()) - footprint[qn]
+                if extra:
+                    footprint[qn] |= extra
+                    changed = True
+
+    # lock-order graph: direct with-nesting edges + held-across-call edges
+    edges: Dict[Tuple[str, str], Tuple[FileContext, int, int]] = {}
+    for s in summaries.values():
+        for a, b, ctx, line, col in s.edges:
+            edges.setdefault((a, b), (ctx, line, col))
+        for held, callee_qn, ctx, line, col in s.calls:
+            if not held:
+                continue
+            for a in held:
+                for b in footprint.get(callee_qn, ()):
+                    if a != b:
+                        edges.setdefault((a, b), (ctx, line, col))
+
+    # strongly connected components of the lock graph; any SCC with an
+    # internal edge is a reachable order inversion
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    scc_of = _tarjan(adj)
+    for (a, b), (ctx, line, col) in sorted(
+        edges.items(), key=lambda kv: (kv[1][0].path, kv[1][1], kv[1][2])
+    ):
+        if scc_of.get(a) is not None and scc_of.get(a) == scc_of.get(b):
+            yield Finding(
+                "ALZ014",
+                f"lock-order cycle: `{_short(a)}` is held while "
+                f"`{_short(b)}` is (transitively) acquired here, but "
+                "another call path orders them the other way — two "
+                "threads taking the two paths concurrently deadlock; "
+                "pick one global order for these locks",
+                ctx.path,
+                line,
+                col,
+            )
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split(":", 1)[-1]
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> Dict[str, int]:
+    """Node -> SCC id, only for nodes in SCCs of size ≥ 2 (or with a
+    self-edge); singletons map to None-ish absence semantics via id -1."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: Dict[str, Optional[int]] = {}
+    counter = [0]
+    scc_id = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan: explicit frame stack, no recursion limit risk
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                keep = len(comp) > 1 or node in adj.get(node, ())
+                for w in comp:
+                    out[w] = scc_id[0] if keep else None
+                scc_id[0] += 1
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return {k: v for k, v in out.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# ALZ006 — retrace risk
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = ("jit", "pmap")
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return _call_transform_name(call) in _JIT_NAMES
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """The function expression a jit/pmap call wraps — through partial
+    AND through nested transforms (``jit(vmap(lambda ...))`` is still a
+    fresh lambda per call)."""
+    fn_name = getattr(call.func, "attr", getattr(call.func, "id", None))
+    args = call.args
+    target = (args[1] if len(args) > 1 else None) if fn_name == "partial" else (
+        args[0] if args else None
+    )
+    while isinstance(target, ast.Call) and _call_transform_name(target) is not None:
+        target = _jit_target(target)
+    return target
+
+
+def _has_caching_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = getattr(node, "attr", getattr(node, "id", None))
+        if name in _CACHING_DECORATORS:
+            return True
+    return False
+
+
+def _literal_type(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return None  # None is a singleton — never a type-variance risk
+        return type(node.value).__name__
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        return _literal_type(node.operand)
+    return None
+
+
+def check_alz006(ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+    model = ProgramModel(ctxs)
+    seen_sites: Set[Tuple[str, int, int]] = set()
+
+    def emit(ctx: FileContext, node: ast.AST, msg: str) -> Optional[Finding]:
+        site = (ctx.path, node.lineno, node.col_offset)
+        if site in seen_sites:
+            return None
+        seen_sites.add(site)
+        return Finding("ALZ006", msg, ctx.path, node.lineno, node.col_offset)
+
+    # (a) jit construction inside a loop, (b) jit of a fresh lambda per
+    # call — both per-file walks with ancestor checks
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                continue
+            in_loop = False
+            enclosing_fns: List[ast.AST] = []
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                    in_loop = True
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing_fns.append(anc)
+            if in_loop:
+                f = emit(
+                    ctx,
+                    node,
+                    "jit constructed inside a loop — every iteration builds "
+                    "a fresh traced callable with an empty compile cache "
+                    "(one retrace per iteration); hoist the jit out of the "
+                    "loop",
+                )
+                if f:
+                    yield f
+                continue
+            target = _jit_target(node)
+            if (
+                isinstance(target, ast.Lambda)
+                and enclosing_fns
+                and not any(_has_caching_decorator(fn) for fn in enclosing_fns)
+            ):
+                f = emit(
+                    ctx,
+                    node,
+                    "jit applied to a fresh lambda inside a function — each "
+                    "call builds a new trace cache, so repeated construction "
+                    "re-traces (and re-compiles) from scratch; hoist the jit "
+                    "to module scope or cache the maker (functools.lru_cache "
+                    "keyed on the config)",
+                )
+                if f:
+                    yield f
+
+    # (c) call sites of a jit'd entry point whose positional literals
+    # change Python type — one compile-cache entry per distinct type
+    jit_entry_points: Dict[str, Tuple[FileContext, int]] = {}
+    for ctx in ctxs:
+        mod = model.module_of[id(ctx)]
+        for stmt in ctx.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and _is_jit_call(stmt.value)
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        jit_entry_points[f"{mod}:{t.id}"] = (ctx, stmt.lineno)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                        jit_entry_points[f"{mod}:{stmt.name}"] = (ctx, stmt.lineno)
+                    node_name = getattr(dec, "attr", getattr(dec, "id", None))
+                    if node_name in _JIT_NAMES:
+                        jit_entry_points[f"{mod}:{stmt.name}"] = (ctx, stmt.lineno)
+    if not jit_entry_points:
+        return
+    # arg-position -> first-seen literal type, then flag divergent sites
+    seen_types: Dict[Tuple[str, int], Tuple[str, str, int]] = {}
+    sites: List[Tuple[str, int, str, FileContext, ast.Call]] = []
+    for ctx in ctxs:
+        mod = model.module_of[id(ctx)]
+        imports = model.imports.get(mod, {})
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Name
+            ):
+                continue
+            qn = None
+            if f"{mod}:{node.func.id}" in jit_entry_points:
+                qn = f"{mod}:{node.func.id}"
+            else:
+                target = imports.get(node.func.id)
+                if target in jit_entry_points:
+                    qn = target
+            if qn is None:
+                continue
+            for i, arg in enumerate(node.args):
+                lt = _literal_type(arg)
+                if lt is not None:
+                    sites.append((qn, i, lt, ctx, node))
+    sites.sort(key=lambda s: (s[3].path, s[4].lineno, s[4].col_offset, s[1]))
+    for qn, i, lt, ctx, node in sites:
+        first = seen_types.get((qn, i))
+        if first is None:
+            seen_types[(qn, i)] = (lt, ctx.path, node.lineno)
+            continue
+        if first[0] != lt:
+            f = emit(
+                ctx,
+                node,
+                f"jit'd `{_short(qn)}` gets a Python {lt} for positional "
+                f"arg {i} here but a {first[0]} at {first[1]}:{first[2]} — "
+                "each distinct Python scalar type is a separate trace-cache "
+                "entry (weak-type retrace); pick one type at every call "
+                "site",
+            )
+            if f:
+                yield f

@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable
 
-from tools.alazlint import jax_rules, lock_rules
+from tools.alazlint import jax_rules, lock_rules, program
 from tools.alazlint.core import FileContext, Finding
 
 
@@ -83,3 +83,23 @@ _ALL = [
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}
+
+# whole-program rules: checked over EVERY file of a lint invocation at
+# once (``check`` takes the full FileContext list) — the interprocedural
+# half of the gate (tools/alazlint/program.py)
+_PROGRAM = [
+    Rule(
+        "ALZ006",
+        "retrace risk: jit built in a loop / on a fresh lambda per call / "
+        "called with type-varying Python literals",
+        program.check_alz006,
+    ),
+    Rule(
+        "ALZ014",
+        "lock-order cycle reachable through the call graph "
+        "(interprocedural deadlock)",
+        program.check_alz014,
+    ),
+]
+
+PROGRAM_RULES: Dict[str, Rule] = {r.code: r for r in _PROGRAM}
