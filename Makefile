@@ -7,22 +7,29 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint sanitize image image-multiarch bench
+.PHONY: native test lint sanitize abi-check specs image image-multiarch bench
 
-native:  ## libalaz_ingest.so + the out-of-process agent example
+native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
 
-# sanitize runs first as its own gate; the main run skips that file so
-# the suite isn't paid twice (tier-1 CI runs plain `pytest tests/` and
-# still covers it)
-test: lint sanitize
-	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py
+# sanitize/abi-check run first as their own gates; the main run skips
+# their test files so the (not-cheap) stress and spec-regen work isn't
+# paid twice per invocation (tier-1 CI runs plain `pytest tests/` and
+# still covers both)
+test: lint sanitize abi-check
+	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py --ignore=tests/test_alazspec.py
 
 sanitize:  ## alazsan runtime heads: lock-order stress + retrace budgets + transfer guard (CPU-only, no TPU needed)
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_sanitize.py -q
 
-lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
-	python -m tools.alazlint alaz_tpu/ tools/alazlint --json
+abi-check:  ## alazspec: C-struct/dtype/enum ABI parity + golden shape/dtype/sharding contract diff (ALZ020-ALZ023)
+	env JAX_PLATFORMS=cpu python -m tools.alazspec --abi --check-specs --json
+
+specs:  ## regenerate golden specfiles + wire layout table (resources/specs) — review and commit the diff
+	env JAX_PLATFORMS=cpu python -m tools.alazspec --write-specs
+
+lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 and spec hygiene ALZ024 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
+	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec --json
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check alaz_tpu tools; \
 	else \

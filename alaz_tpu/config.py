@@ -261,6 +261,15 @@ class MeshConfig:
         )
 
 
+def mesh_axis_names() -> tuple:
+    """The project mesh vocabulary — MeshConfig's axes, in field order.
+    The single source of truth the ALZ024 axis-name rule, the ALZ022
+    parity check, and the golden specfiles are verified against
+    (tools/alazspec). Lives here (not parallel/sharding.py) so the
+    checkers stay importable on jax-less data-plane images."""
+    return tuple(f.name for f in dataclasses.fields(MeshConfig))
+
+
 @dataclass
 class RuntimeConfig:
     """Top-level wiring config — the main.go:28-188 analog."""

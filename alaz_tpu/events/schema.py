@@ -248,6 +248,31 @@ PROC_EVENT_DTYPE = np.dtype(
 )
 
 
+# The wire-visible structured dtypes: everything an out-of-process agent
+# serializes byte-for-byte (sources/ingest_server.py frames). alazspec
+# pins each one's layout in resources/specs/wire_layouts.json and fails
+# tier-1 on drift — the Go-struct-vs-C-struct desync of the reference,
+# caught statically (tools/alazspec, ISSUE 4).
+WIRE_DTYPES = {
+    "L7_EVENT_DTYPE": L7_EVENT_DTYPE,
+    "TCP_EVENT_DTYPE": TCP_EVENT_DTYPE,
+    "PROC_EVENT_DTYPE": PROC_EVENT_DTYPE,
+}
+
+
+def dtype_layout(dtype: np.dtype, name: str) -> str:
+    """Canonical layout string for a structured dtype:
+    ``"Name:<itemsize>;<field>:<offset>:<size>;..."`` — byte-compatible
+    with the C side's ``alz_abi_record_layout()`` (native/ingest.cc), so
+    struct↔dtype parity is one string comparison. Subarray fields (the
+    payload prefix) report their total byte span."""
+    parts = [f"{name}:{dtype.itemsize}"]
+    for field in dtype.names or ():
+        ft, off = dtype.fields[field][:2]
+        parts.append(f"{field}:{off}:{ft.itemsize}")
+    return ";".join(parts)
+
+
 def make_l7_events(n: int) -> np.ndarray:
     return np.zeros(n, dtype=L7_EVENT_DTYPE)
 

@@ -45,6 +45,25 @@ NATIVE_RECORD_DTYPE = np.dtype(
 _lib: Optional[ctypes.CDLL] = None
 
 
+def record_layout_string() -> str:
+    """NATIVE_RECORD_DTYPE rendered in the shared layout-string format
+    (events/schema.py dtype_layout) — the Python half of the AlzRecord
+    ABI contract the loaded .so must byte-match."""
+    from alaz_tpu.events.schema import dtype_layout
+
+    return dtype_layout(NATIVE_RECORD_DTYPE, "AlzRecord")
+
+
+def loaded_source_hash() -> Optional[str]:
+    """``alz_source_hash()`` of the loaded .so ("unstamped" for
+    out-of-band builds), or None when the library is unavailable — the
+    staleness-guard input for tools/alazspec."""
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.alz_source_hash().decode()
+
+
 def build(force: bool = False) -> bool:
     """Compile the shared library if needed; True on success. Always runs
     make (a no-op when up to date) so an edited ingest.cc is never shadowed
@@ -109,6 +128,8 @@ def _register(lib: ctypes.CDLL) -> None:
     ] + [ctypes.c_void_p] * 6
     lib.alz_edge_feat_dim.restype = ctypes.c_uint32
     lib.alz_node_feat_dim.restype = ctypes.c_uint32
+    lib.alz_abi_record_layout.restype = ctypes.c_char_p
+    lib.alz_source_hash.restype = ctypes.c_char_p
     # feature-layout contract: the C++ pass writes ef/nf rows with these
     # strides — a drifted constant would silently misalign every feature.
     # RuntimeError on purpose: _load's except clause swallows
@@ -119,6 +140,17 @@ def _register(lib: ctypes.CDLL) -> None:
     ):
         raise RuntimeError(
             "libalaz_ingest.so feature dims drifted from graph/builder.py; "
+            "rebuild with make -C alaz_tpu/native -B"
+        )
+    # record-layout contract: the binary's own offsetof/sizeof table must
+    # byte-match NATIVE_RECORD_DTYPE — same loud-failure rationale. The
+    # source↔binary↔dtype triangle is closed by tools/alazspec (ALZ020).
+    compiled = lib.alz_abi_record_layout().decode()
+    if compiled != record_layout_string():
+        raise RuntimeError(
+            "libalaz_ingest.so AlzRecord layout drifted from "
+            f"NATIVE_RECORD_DTYPE:\n  .so:   {compiled}\n"
+            f"  dtype: {record_layout_string()}\n"
             "rebuild with make -C alaz_tpu/native -B"
         )
 

@@ -4,6 +4,13 @@ from __future__ import annotations
 
 from alaz_tpu.models import gat, graphsage
 
+# Every registered single-device model (get_model names), and the subset
+# with node-sharded shard_map twins (parallel/sharded_model.py makers).
+# alazspec generates one golden specfile per (name, bucket) for all of
+# these — keep both tuples in sync with get_model / the makers.
+REGISTERED_MODELS = ("graphsage", "gat", "tgn", "experts")
+NODE_SHARDED_TWINS = ("graphsage", "gat")
+
 
 def get_model(name: str):
     if name == "graphsage":
@@ -19,4 +26,4 @@ def get_model(name: str):
         from alaz_tpu.models import experts
 
         return experts.init, experts.apply
-    raise ValueError(f"unknown model {name!r} (graphsage|gat|tgn|experts)")
+    raise ValueError(f"unknown model {name!r} ({'|'.join(REGISTERED_MODELS)})")

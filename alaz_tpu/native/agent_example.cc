@@ -30,7 +30,7 @@ struct AlzRecord {  // mirrors ingest.cc / NATIVE_RECORD_DTYPE (32 bytes)
   uint8_t flags;
 };
 
-struct FrameHeader {  // little-endian; matches ingest_server._HEADER
+struct FrameHeader {  // little-endian; matches ingest_server.FRAME_HEADER
   uint32_t magic;
   uint8_t kind;
   uint8_t pad[3];

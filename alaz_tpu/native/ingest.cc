@@ -21,11 +21,46 @@
 
 #include <atomic>
 #include <cmath>
+#include <cstddef>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <string>
 #include <vector>
 
+// Stamped by the Makefile with the sha256 prefix of this source file so
+// alazspec (tools/alazspec) can flag a .so built from a different
+// ingest.cc than the one checked in (the classic "stale kernel object"
+// failure mode of the reference's bpf2go artifacts).
+#ifndef ALZ_SOURCE_HASH
+#define ALZ_SOURCE_HASH "unstamped"
+#endif
+
 extern "C" {
+
+// Mirror of events/schema.py L7Protocol (the reference's
+// BPF_L7_PROTOCOL_* constants, l7.go:19-28). The `protocol` byte of
+// AlzRecord and the one-hot clamp in alz_close_window_feats are typed
+// against THIS enum; alazspec diffs it value-for-value against the
+// Python enum, so a protocol added on one side only fails tier-1
+// instead of silently folding into a neighbor's one-hot slot.
+enum AlzProtocol {
+  ALZ_PROTO_UNKNOWN = 0,
+  ALZ_PROTO_HTTP = 1,
+  ALZ_PROTO_AMQP = 2,
+  ALZ_PROTO_POSTGRES = 3,
+  ALZ_PROTO_HTTP2 = 4,
+  ALZ_PROTO_REDIS = 5,
+  ALZ_PROTO_KAFKA = 6,
+  ALZ_PROTO_MYSQL = 7,
+  ALZ_PROTO_MONGO = 8,
+};
+
+// One-hot clamp bound for the feature pass below. Kept as a literal
+// (not ALZ_PROTO_MONGO + 1) so a 10th protocol added to both enums but
+// not here still fails tier-1: alazspec checks kProtoCount ==
+// len(L7Protocol), which a named-member clamp could never catch.
+constexpr uint32_t kProtoCount = 9;
 
 // 32-byte wire record; mirrored by NATIVE_RECORD_DTYPE in graph/native.py.
 // flags: bit0 = tls, bit1 = failed (request not completed)
@@ -530,7 +565,8 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
     f[4] = static_cast<float>(e.err4 / cdiv);
     f[5] = static_cast<float>(e.tls_cnt / cdiv);
     f[6] = static_cast<float>(std::log1p(c / ws));
-    const uint32_t proto = e.protocol > 8 ? 8u : e.protocol;
+    const uint32_t proto =
+        e.protocol >= kProtoCount ? kProtoCount - 1 : e.protocol;
     f[7 + proto] = 1.0f;
   }
 
@@ -565,5 +601,42 @@ uint32_t alz_export_nodes(void* p, uint32_t buf_cap, int32_t* uids, uint8_t* typ
   std::memcpy(types, ig->node_types.data(), n * sizeof(uint8_t));
   return n;
 }
+
+// ---------------------------------------------------------------------------
+// ABI self-description (alazspec ALZ020/ALZ022). The loaded .so reports
+// the layout it was COMPILED with — offsetof/sizeof truth, not parser
+// output — so graph/native.py can refuse a drifted binary at load and
+// tools/alazspec can triangulate source ↔ binary ↔ numpy dtype.
+// Format: "AlzRecord:<sizeof>;<field>:<offset>:<size>;..." — mirrored by
+// events/schema.py dtype_layout() on the Python side.
+// ---------------------------------------------------------------------------
+
+const char* alz_abi_record_layout(void) {
+  static const std::string layout = [] {
+    char buf[512];
+    std::snprintf(
+        buf, sizeof(buf),
+        "AlzRecord:%zu;"
+        "start_time_ms:%zu:%zu;latency_ns:%zu:%zu;from_uid:%zu:%zu;"
+        "to_uid:%zu:%zu;status:%zu:%zu;from_type:%zu:%zu;"
+        "to_type:%zu:%zu;protocol:%zu:%zu;flags:%zu:%zu",
+        sizeof(AlzRecord),
+        offsetof(AlzRecord, start_time_ms), sizeof(AlzRecord::start_time_ms),
+        offsetof(AlzRecord, latency_ns), sizeof(AlzRecord::latency_ns),
+        offsetof(AlzRecord, from_uid), sizeof(AlzRecord::from_uid),
+        offsetof(AlzRecord, to_uid), sizeof(AlzRecord::to_uid),
+        offsetof(AlzRecord, status), sizeof(AlzRecord::status),
+        offsetof(AlzRecord, from_type), sizeof(AlzRecord::from_type),
+        offsetof(AlzRecord, to_type), sizeof(AlzRecord::to_type),
+        offsetof(AlzRecord, protocol), sizeof(AlzRecord::protocol),
+        offsetof(AlzRecord, flags), sizeof(AlzRecord::flags));
+    return std::string(buf);
+  }();
+  return layout.c_str();
+}
+
+// sha256 prefix of the ingest.cc this binary was compiled from (the
+// Makefile stamp); "unstamped" for out-of-band builds.
+const char* alz_source_hash(void) { return ALZ_SOURCE_HASH; }
 
 }  // extern "C"

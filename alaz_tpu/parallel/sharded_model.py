@@ -46,6 +46,32 @@ from alaz_tpu.parallel.halo import (
 )
 
 
+# The shard-local array set a node-sharded forward consumes (what
+# shard_graph_batch emits), in wire order.
+SHARDED_GRAPH_KEYS = (
+    "node_feats",
+    "node_type",
+    "node_mask",
+    "edge_src",
+    "edge_dst_local",
+    "edge_type",
+    "edge_feats",
+    "edge_mask",
+)
+
+
+def node_sharded_specs(axis: str = "sp") -> tuple[tuple, tuple]:
+    """The shard_map (in_specs, out_specs) contract BOTH node-sharded
+    makers compile against: params replicated, every graph array sharded
+    on its leading S axis, both logit outputs sharded the same way.
+    Exported as a function so alazspec pins it in the golden specfiles
+    (ALZ023) — an in_spec edited in one maker but not the contract fails
+    tier-1 instead of silently re-sharding the batch."""
+    in_specs = (P(), {k: P(axis) for k in SHARDED_GRAPH_KEYS})
+    out_specs = (P(axis), P(axis))
+    return in_specs, out_specs
+
+
 def shard_graph_batch(batch: GraphBatch, n_shards: int) -> tuple[dict, np.ndarray]:
     """Partition one GraphBatch for the node-sharded forward.
 
@@ -131,14 +157,13 @@ def make_node_sharded_graphsage(
     replicated over ``axis``; node/edge arrays are sharded on their
     leading S axis."""
 
+    in_specs, out_specs = node_sharded_specs(axis)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), {k: P(axis) for k in (
-            "node_feats", "node_type", "node_mask", "edge_src",
-            "edge_dst_local", "edge_type", "edge_feats", "edge_mask",
-        )}),
-        out_specs=(P(axis), P(axis)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         # jax 0.4.37's shard_map replication checker rejects the ring
         # fori_loop's carry under reverse-mode AD ("Scan carry input and
         # output got mismatched replication types") — the documented
@@ -208,14 +233,13 @@ def make_node_sharded_gat(
     nh = cfg.num_heads
     hd = cfg.hidden_dim // nh
 
+    in_specs, out_specs = node_sharded_specs(axis)
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), {k: P(axis) for k in (
-            "node_feats", "node_type", "node_mask", "edge_src",
-            "edge_dst_local", "edge_type", "edge_feats", "edge_mask",
-        )}),
-        out_specs=(P(axis), P(axis)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         # same jax-0.4.37 replication-checker workaround as the
         # graphsage maker above (ring fori_loop carry under grad)
         check_vma=False,

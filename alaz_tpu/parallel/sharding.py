@@ -33,6 +33,14 @@ from alaz_tpu.train.objective import edge_bce_loss
 # ---------------------------------------------------------------------------
 
 
+def mesh_axis_names() -> tuple[str, ...]:
+    """Re-export of config.mesh_axis_names (the single source of truth
+    for the mesh vocabulary) for sharding-side callers."""
+    from alaz_tpu.config import mesh_axis_names as _names
+
+    return _names()
+
+
 def param_pspec(params: Any, tp: int = 1, ep: int = 1) -> Any:
     """TP rule: 2D weights shard the output dim over 'tp' when divisible
     (heads ending in width-1 logits replicate); 1D params replicate.

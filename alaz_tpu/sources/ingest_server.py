@@ -48,7 +48,10 @@ from alaz_tpu.logging import get_logger
 log = get_logger("alaz_tpu.ingest_server")
 
 MAGIC = 0x414C5A31
-_HEADER = struct.Struct("<IB3xII")
+# Public: the 16-byte frame header IS the wire contract out-of-process
+# agents compile against (agent_example.cc FrameHeader). alazspec pins
+# its size/format in resources/specs/wire_layouts.json (ALZ021).
+FRAME_HEADER = struct.Struct("<IB3xII")
 
 KIND_L7 = 1
 KIND_TCP = 2
@@ -67,7 +70,7 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024  # one frame must fit in memory comfortably
 def pack_frame(kind: int, batch: np.ndarray) -> bytes:
     """Client-side helper: one event batch → one wire frame."""
     payload = np.ascontiguousarray(batch).tobytes()
-    return _HEADER.pack(MAGIC, kind, batch.shape[0], len(payload)) + payload
+    return FRAME_HEADER.pack(MAGIC, kind, batch.shape[0], len(payload)) + payload
 
 
 class IngestServer:
@@ -235,10 +238,10 @@ class IngestServer:
         conn.settimeout(0.5)
         try:
             while not self._stop.is_set():
-                header = self._recv_exact(conn, _HEADER.size)
+                header = self._recv_exact(conn, FRAME_HEADER.size)
                 if header is None:
                     return
-                magic, kind, count, length = _HEADER.unpack(header)
+                magic, kind, count, length = FRAME_HEADER.unpack(header)
                 if magic != MAGIC or length > MAX_FRAME_BYTES:
                     with self._state_lock:
                         self.bad_frames += 1

@@ -363,6 +363,16 @@ def bench_ingest(args) -> dict:
         f"wall={dt*1e3:.1f}ms",
         file=sys.stderr,
     )
+    # ABI parity rides along like the compile count: the measured binary
+    # and schemas must be the checked-in contract (expected: 0 findings)
+    # or the rows/s number describes a layout nobody ships
+    try:
+        from tools.alazspec.abirules import check_abi
+
+        abi_findings = len(check_abi())
+    except Exception:  # repo layout unavailable (installed wheel): skip
+        abi_findings = -1
+
     metric, unit = _metric_for(args)
     return {
         "metric": metric,
@@ -372,6 +382,7 @@ def bench_ingest(args) -> dict:
         "rows": n_rows,
         "windows_closed": n_windows,
         "jit_compile_count": compile_watcher.total if compile_watcher else 0,
+        "abi_findings": abi_findings,
     }
 
 

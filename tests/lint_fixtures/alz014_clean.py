@@ -34,3 +34,35 @@ class Pipeline:
             self.staged += 1
         with self._front:
             self.done += 1
+
+
+class SharedSink:
+    """Constructor-injected lock (resolved through the
+    ``SharedSink(threading.Lock())`` construction below), used with ONE
+    global order: ``deposit`` releases ``_lk`` before calling into the
+    peer, so the only cross-class edge is ``_dlock`` → ``_lk``."""
+
+    def __init__(self, lk):
+        self._lk = lk
+        self.peer = Downstream()
+        self.items = 0
+
+    def deposit(self):
+        with self._lk:
+            self.items += 1
+        self.peer.notify()  # lock released first: no order edge
+
+
+class Downstream:
+    def __init__(self):
+        self._dlock = threading.Lock()
+        self.sink = SharedSink(threading.Lock())
+        self.seen = 0
+
+    def notify(self):
+        with self._dlock:
+            self.seen += 1
+
+    def push(self):
+        with self._dlock:
+            self.sink.deposit()

@@ -31,3 +31,35 @@ class Pipeline:
     def backward(self):
         with self._back:
             self._touch_front()  # alz-expect: ALZ014
+
+
+class SharedSink:
+    """Constructor-arg lock resolution (ISSUE 4 satellite): ``_lk`` is
+    only known to be a lock because ``Downstream`` constructs
+    ``SharedSink(threading.Lock())`` below — no ``self.x = Lock()``
+    literal ever appears in THIS class, so the pre-satellite analysis
+    saw no lock at all and missed the inversion entirely."""
+
+    def __init__(self, lk):
+        self._lk = lk
+        self.peer = Downstream()
+        self.items = 0
+
+    def deposit(self):
+        with self._lk:
+            self.peer.notify()  # alz-expect: ALZ014
+
+
+class Downstream:
+    def __init__(self):
+        self._dlock = threading.Lock()
+        self.sink = SharedSink(threading.Lock())
+        self.seen = 0
+
+    def notify(self):
+        with self._dlock:
+            self.seen += 1
+
+    def push(self):
+        with self._dlock:
+            self.sink.deposit()  # alz-expect: ALZ014

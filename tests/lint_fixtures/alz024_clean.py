@@ -1,0 +1,33 @@
+"""ALZ024 clean fixture: project mesh axes in specs/collectives,
+variable axis names (a maker's ``axis`` parameter is the legal way to
+abstract over the axis), f32 accumulation inside traced scopes, and
+host-side numpy float64 OUTSIDE any traced scope (legitimate: host
+stats run in real f64)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+GOOD_SPEC = P("dp", None)
+TP_SPEC = P(None, "tp")
+
+
+def make_reducer(axis: str = "sp"):
+    @jax.jit
+    def run(x):
+        # variable axis name: resolved by the enclosing mesh, not lint
+        return jax.lax.psum(x, axis)
+
+    return run
+
+
+@jax.jit
+def f32_accumulation(x):
+    acc = jnp.zeros(x.shape, dtype=jnp.float32)
+    return acc + x.astype(jnp.float32)
+
+
+def host_stats(rows):
+    # not a traced scope: numpy really does compute in f64 here
+    return np.asarray(rows, dtype=np.float64).mean()

@@ -1,0 +1,25 @@
+"""ALZ024 flagged fixture: axis names outside the project mesh
+vocabulary (dp/tp/ep/sp — config.MeshConfig), and float64 dtype
+requests inside traced scopes (x64 is disabled repo-wide, so the
+written dtype silently truncates to f32)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# a typo'd axis only fails on a mesh that actually shards — CI's
+# single-device run never builds one
+BAD_SPEC = P("dpp", None)  # alz-expect: ALZ024
+NESTED_BAD = P(("dp", "tpp"), None)  # alz-expect: ALZ024
+
+
+@jax.jit
+def reduce_over_unknown_axis(x):
+    return jax.lax.psum(x, "node")  # alz-expect: ALZ024
+
+
+@jax.jit
+def silently_truncated(x):
+    acc = jnp.zeros(x.shape, dtype=jnp.float64)  # alz-expect: ALZ024
+    acc = acc + x.astype(jnp.float64)  # alz-expect: ALZ024
+    return jnp.asarray(acc, jnp.float64)  # alz-expect: ALZ024

@@ -1,0 +1,22 @@
+// ALZ020 clean fixture: a trimmed copy of native/ingest.cc's
+// wire-visible declarations whose layout matches NATIVE_RECORD_DTYPE
+// exactly — the ABI pass must report nothing. (Test-only file; the real
+// contract lives in alaz_tpu/native/ingest.cc.)
+
+#include <cstdint>
+
+extern "C" {
+
+struct AlzRecord {
+  int64_t start_time_ms;
+  uint64_t latency_ns;
+  int32_t from_uid;
+  int32_t to_uid;
+  uint32_t status;
+  uint8_t from_type;
+  uint8_t to_type;
+  uint8_t protocol;
+  uint8_t flags;
+};
+
+}  // extern "C"

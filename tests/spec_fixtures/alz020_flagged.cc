@@ -1,0 +1,23 @@
+// ALZ020 flagged fixture: one-field offset drift. from_uid/to_uid are
+// declared in the opposite order from NATIVE_RECORD_DTYPE, so both land
+// at the other's offset — every agent built against this header writes
+// edges with src and dst silently swapped. The ABI pass must flag the
+// order (struct line) and both drifted fields (their own lines).
+
+#include <cstdint>
+
+extern "C" {
+
+struct AlzRecord {  // alz-expect: ALZ020
+  int64_t start_time_ms;
+  uint64_t latency_ns;
+  int32_t to_uid;  // alz-expect: ALZ020
+  int32_t from_uid;  // alz-expect: ALZ020
+  uint32_t status;
+  uint8_t from_type;
+  uint8_t to_type;
+  uint8_t protocol;
+  uint8_t flags;
+};
+
+}  // extern "C"

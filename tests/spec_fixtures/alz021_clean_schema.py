@@ -1,0 +1,55 @@
+"""ALZ021 clean fixture: the wire dtypes exactly as events/schema.py
+declares them — the layout pass diffs this module against the golden
+wire table and must report nothing. (Test-only mirror; keep in lockstep
+with the real schema, which is the point.)"""
+
+import numpy as np
+
+MAX_PAYLOAD_SIZE = 256
+
+L7_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("fd", np.uint64),
+        ("write_time_ns", np.uint64),
+        ("duration_ns", np.uint64),
+        ("protocol", np.uint8),
+        ("method", np.uint8),
+        ("tls", np.bool_),
+        ("failed", np.bool_),
+        ("status", np.uint32),
+        ("payload_size", np.uint32),
+        ("payload_read_complete", np.bool_),
+        ("tid", np.uint32),
+        ("seq", np.uint32),
+        ("kafka_api_version", np.int16),
+        ("mysql_prep_stmt_id", np.uint32),
+        ("saddr", np.uint32),
+        ("sport", np.uint16),
+        ("daddr", np.uint32),
+        ("dport", np.uint16),
+        ("event_read_time_ns", np.uint64),
+        ("payload", np.uint8, (MAX_PAYLOAD_SIZE,)),
+    ]
+)
+
+TCP_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("fd", np.uint64),
+        ("timestamp_ns", np.uint64),
+        ("type", np.uint8),
+        ("saddr", np.uint32),
+        ("sport", np.uint16),
+        ("daddr", np.uint32),
+        ("dport", np.uint16),
+    ]
+)
+
+PROC_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("type", np.uint8),
+        ("timestamp_ns", np.uint64),
+    ]
+)

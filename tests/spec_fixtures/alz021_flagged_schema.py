@@ -1,0 +1,56 @@
+"""ALZ021 flagged fixture: ``status`` narrowed to uint16 — the silent
+struct drift of the reference agent (a Go-side field edit the C side
+never saw). Every field after ``status`` shifts two bytes, so recorded
+traces and live agents framing the old layout misread the entire tail;
+the layout pass must flag the first drifted field at its line."""
+
+import numpy as np
+
+MAX_PAYLOAD_SIZE = 256
+
+L7_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("fd", np.uint64),
+        ("write_time_ns", np.uint64),
+        ("duration_ns", np.uint64),
+        ("protocol", np.uint8),
+        ("method", np.uint8),
+        ("tls", np.bool_),
+        ("failed", np.bool_),
+        ("status", np.uint16),  # alz-expect: ALZ021
+        ("payload_size", np.uint32),
+        ("payload_read_complete", np.bool_),
+        ("tid", np.uint32),
+        ("seq", np.uint32),
+        ("kafka_api_version", np.int16),
+        ("mysql_prep_stmt_id", np.uint32),
+        ("saddr", np.uint32),
+        ("sport", np.uint16),
+        ("daddr", np.uint32),
+        ("dport", np.uint16),
+        ("event_read_time_ns", np.uint64),
+        ("payload", np.uint8, (MAX_PAYLOAD_SIZE,)),
+    ]
+)
+
+TCP_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("fd", np.uint64),
+        ("timestamp_ns", np.uint64),
+        ("type", np.uint8),
+        ("saddr", np.uint32),
+        ("sport", np.uint16),
+        ("daddr", np.uint32),
+        ("dport", np.uint16),
+    ]
+)
+
+PROC_EVENT_DTYPE = np.dtype(
+    [
+        ("pid", np.uint32),
+        ("type", np.uint8),
+        ("timestamp_ns", np.uint64),
+    ]
+)

@@ -1,0 +1,20 @@
+// ALZ022 clean fixture: AlzProtocol matching events/schema.py
+// L7Protocol value-for-value — the enum parity pass reports nothing.
+
+#include <cstdint>
+
+extern "C" {
+
+enum AlzProtocol {
+  ALZ_PROTO_UNKNOWN = 0,
+  ALZ_PROTO_HTTP = 1,
+  ALZ_PROTO_AMQP = 2,
+  ALZ_PROTO_POSTGRES = 3,
+  ALZ_PROTO_HTTP2 = 4,
+  ALZ_PROTO_REDIS = 5,
+  ALZ_PROTO_KAFKA = 6,
+  ALZ_PROTO_MYSQL = 7,
+  ALZ_PROTO_MONGO = 8,
+};
+
+}  // extern "C"

@@ -1,0 +1,23 @@
+// ALZ022 flagged fixture: REDIS and KAFKA carry each other's values —
+// the renumbering the reference suffered when BPF-side constants and
+// userspace enums were edited independently. Every Redis request would
+// aggregate (and one-hot) as Kafka and vice versa; the parity pass must
+// flag both drifted members at their own lines.
+
+#include <cstdint>
+
+extern "C" {
+
+enum AlzProtocol {
+  ALZ_PROTO_UNKNOWN = 0,
+  ALZ_PROTO_HTTP = 1,
+  ALZ_PROTO_AMQP = 2,
+  ALZ_PROTO_POSTGRES = 3,
+  ALZ_PROTO_HTTP2 = 4,
+  ALZ_PROTO_REDIS = 6,  // alz-expect: ALZ022
+  ALZ_PROTO_KAFKA = 5,  // alz-expect: ALZ022
+  ALZ_PROTO_MYSQL = 7,
+  ALZ_PROTO_MONGO = 8,
+};
+
+}  // extern "C"

@@ -1,0 +1,363 @@
+"""alazspec: the cross-layer ABI/schema drift gate (ISSUE 4 tentpole).
+
+Four layers of enforcement, all tier-1:
+
+1. Fixture corpus — every alazspec rule proven by a flagged+clean pair
+   (``# alz-expect: ALZxxx`` / ``// alz-expect: ALZxxx`` markers,
+   asserted by code AND line), including an injected one-field offset
+   drift in a fixture copy of ingest.cc AND of schema.py, and an
+   injected dtype flip in a specfile copy.
+2. Tree cleanliness — the real repo passes the full ABI pass and the
+   golden-contract diff with zero findings.
+3. Byte-identical regeneration — ``write_specs`` into a fresh directory
+   reproduces every checked-in golden byte-for-byte (the determinism
+   ``make specs`` relies on).
+4. CI wiring — the ``make abi-check`` / ``make specs`` targets run the
+   real CLI and exit clean, so the gate exists outside pytest too.
+
+Plus the enum round-trip fuzz satellite: every protocol/method enum
+value survives wire encode → frame decode → schema dtype → graph
+builder without collision or truncation.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tools.alazlint.rules import RULES
+from tools.alazspec import abirules, specfiles
+from tools.alazspec.cstructs import CSource
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "spec_fixtures"
+SPECS = REPO / "resources" / "specs"
+
+_EXPECT_RE = re.compile(r"alz-expect:\s*(ALZ\d{3})")
+
+
+def _expected(path: Path) -> set:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _EXPECT_RE.finditer(line):
+            out.add((i, m.group(1)))
+    return out
+
+
+def _native_available() -> bool:
+    from alaz_tpu.graph import native
+
+    return native.available()
+
+
+class TestFixturePairs:
+    """Flagged+clean pairs for the alazspec rule family, mirroring the
+    test_lint.py fixture conventions (code AND line asserted)."""
+
+    def test_alz020_struct_offset_drift_flagged(self):
+        path = FIXTURES / "alz020_flagged.cc"
+        expected = _expected(path)
+        assert expected, "fixture carries no alz-expect markers"
+        got = {
+            (f.line, f.code)
+            for f in abirules.check_record_abi(path, check_binary=False)
+        }
+        assert got == expected
+
+    def test_alz020_clean_fixture_is_clean(self):
+        path = FIXTURES / "alz020_clean.cc"
+        findings = abirules.check_record_abi(path, check_binary=False)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_alz021_schema_dtype_drift_flagged(self):
+        path = FIXTURES / "alz021_flagged_schema.py"
+        expected = _expected(path)
+        got = {
+            (f.line, f.code)
+            for f in abirules.check_wire_layouts(schema_path=path)
+        }
+        assert got == expected
+        # and the message names the drifted field, not just the file
+        (finding,) = abirules.check_wire_layouts(schema_path=path)
+        assert "status" in finding.message
+
+    def test_alz021_clean_fixture_is_clean(self):
+        path = FIXTURES / "alz021_clean_schema.py"
+        findings = abirules.check_wire_layouts(schema_path=path)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_alz022_enum_value_drift_flagged(self):
+        path = FIXTURES / "alz022_flagged.cc"
+        expected = _expected(path)
+        got = {(f.line, f.code) for f in abirules.check_enums(path)}
+        assert got == expected
+
+    def test_alz022_clean_fixture_is_clean(self):
+        path = FIXTURES / "alz022_clean.cc"
+        findings = abirules.check_enums(path)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_alz023_specfile_dtype_flip_flagged(self, tmp_path):
+        """The acceptance drill: flip one dtype in a copy of a golden
+        specfile — the diff must land on that file at the flipped line."""
+        work = tmp_path / "specs"
+        shutil.copytree(SPECS, work)
+        target = work / "graphsage_256x1024.json"
+        text = target.read_text()
+        assert '"dtype": "float32"' in text
+        flipped = text.replace('"dtype": "float32"', '"dtype": "bfloat16"', 1)
+        target.write_text(flipped)
+        flip_line = next(
+            i
+            for i, (a, b) in enumerate(
+                zip(text.splitlines(), flipped.splitlines()), start=1
+            )
+            if a != b
+        )
+        findings = specfiles.check_specs(work)
+        assert [(Path(f.path).name, f.line, f.code) for f in findings] == [
+            ("graphsage_256x1024.json", flip_line, "ALZ023")
+        ]
+        assert "float32" in findings[0].message
+
+    def test_alz023_pristine_copy_is_clean(self, tmp_path):
+        work = tmp_path / "specs"
+        shutil.copytree(SPECS, work)
+        findings = specfiles.check_specs(work)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_alz023_missing_and_stray_specfiles_flagged(self, tmp_path):
+        work = tmp_path / "specs"
+        shutil.copytree(SPECS, work)
+        (work / "graphsage_256x1024.json").unlink()
+        (work / "mystery_64x64.json").write_text("{}\n")
+        codes = {
+            (Path(f.path).name, f.code) for f in specfiles.check_specs(work)
+        }
+        assert ("graphsage_256x1024.json", "ALZ023") in codes
+        assert ("mystery_64x64.json", "ALZ023") in codes
+
+    def test_rule_catalog_registers_the_alazspec_family(self):
+        for code in ("ALZ020", "ALZ021", "ALZ022", "ALZ023", "ALZ024"):
+            assert code in RULES, f"{code} missing from the alazlint registry"
+
+
+class TestTreeClean:
+    """The real repo is the ultimate clean fixture."""
+
+    def test_abi_pass_is_clean(self):
+        findings = abirules.check_abi()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_golden_specs_match_the_code(self):
+        findings = specfiles.check_specs()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_parsed_source_layout_matches_loaded_binary(self):
+        """Close the parser half of the triangle: the cstructs layout of
+        the checked-in source equals the .so's compiled-in table AND the
+        numpy dtype string — one equality chain across three layers."""
+        from alaz_tpu.graph import native
+
+        src = CSource(abirules.INGEST_CC.read_text(), str(abirules.INGEST_CC))
+        parsed = src.struct("AlzRecord").layout_string()
+        assert parsed == native.record_layout_string()
+        if not _native_available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        lib = native._load()
+        assert parsed == lib.alz_abi_record_layout().decode()
+
+
+class TestSpecRegeneration:
+    def test_write_specs_is_byte_identical(self, tmp_path):
+        """`make specs` must be a fixpoint on a clean tree — any diff a
+        regen produces IS a contract change that needs review."""
+        out = specfiles.write_specs(tmp_path / "specs")
+        assert len(out) == len(list(SPECS.glob("*.json")))
+        for fresh in out:
+            golden = SPECS / fresh.name
+            assert golden.exists(), f"{fresh.name} not checked in"
+            assert fresh.read_bytes() == golden.read_bytes(), fresh.name
+
+    def test_spec_inventory_covers_all_registered_models(self):
+        from alaz_tpu.models.registry import NODE_SHARDED_TWINS, REGISTERED_MODELS
+
+        names = {p.name for p in SPECS.glob("*.json")}
+        for model in REGISTERED_MODELS:
+            for n_pad, e_pad in specfiles.SPEC_BUCKETS:
+                assert f"{model}_{n_pad}x{e_pad}.json" in names
+        for model in NODE_SHARDED_TWINS:
+            for n_pad, e_pad in specfiles.SPEC_BUCKETS:
+                assert f"{model}_sharded_{n_pad}x{e_pad}.json" in names
+        assert "wire_layouts.json" in names
+
+
+class TestStalenessGuard:
+    def test_checked_in_source_matches_loaded_binary(self):
+        if not _native_available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        findings = abirules.check_staleness()
+        assert findings == [], [f.render() for f in findings]
+
+    def test_doctored_source_is_flagged_stale(self, tmp_path):
+        if not _native_available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        cc = tmp_path / "ingest.cc"
+        cc.write_text(abirules.INGEST_CC.read_text() + "\n// drift\n")
+        findings = abirules.check_staleness(cc)
+        assert [f.code for f in findings] == ["ALZ020"]
+        assert "rebuild" in findings[0].message
+
+
+class TestMakeTargetsAndCLI:
+    """The gate must exist outside pytest: `make abi-check` for CI
+    scripts, `make specs` for the regeneration workflow."""
+
+    def test_make_abi_check_passes(self):
+        proc = subprocess.run(
+            ["make", "-s", "abi-check"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["count"] == 0 and out["findings"] == []
+
+    def test_make_specs_is_in_place_noop_on_clean_tree(self):
+        before = {
+            p.name: p.read_bytes() for p in SPECS.glob("*.json")
+        }
+        proc = subprocess.run(
+            ["make", "-s", "specs"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        after = {p.name: p.read_bytes() for p in SPECS.glob("*.json")}
+        assert before == after
+
+    def test_cli_exit_codes_and_json(self, tmp_path):
+        bad = tmp_path / "specs"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.alazspec", "--bogus"],
+            cwd=REPO,
+            capture_output=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert not bad.exists()
+
+
+class TestEnumRoundTrip:
+    """Satellite: every protocol/method enum value survives wire encode
+    → frame decode → schema dtype → graph builder without collision or
+    truncation — the full path an out-of-process agent's bytes take."""
+
+    def _pairs(self):
+        from alaz_tpu.events import schema
+
+        pairs = [(schema.L7Protocol.UNKNOWN, 0)]
+        for proto, enum_cls in schema._METHOD_ENUMS.items():
+            pairs += [(proto, m) for m in enum_cls]
+        return pairs
+
+    def test_wire_frame_roundtrip_is_exact(self):
+        from alaz_tpu.events.schema import (
+            L7_EVENT_DTYPE,
+            make_l7_events,
+            method_to_string,
+        )
+        from alaz_tpu.sources.ingest_server import (
+            FRAME_HEADER,
+            KIND_L7,
+            MAGIC,
+            pack_frame,
+        )
+
+        pairs = self._pairs()
+        ev = make_l7_events(len(pairs))
+        ev["protocol"] = [int(p) for p, _ in pairs]
+        ev["method"] = [int(m) for _, m in pairs]
+        frame = pack_frame(KIND_L7, ev)
+        magic, kind, count, length = FRAME_HEADER.unpack(
+            frame[: FRAME_HEADER.size]
+        )
+        assert (magic, kind, count) == (MAGIC, KIND_L7, len(pairs))
+        back = np.frombuffer(frame[FRAME_HEADER.size :], dtype=L7_EVENT_DTYPE)
+        decoded = {
+            (int(r["protocol"]), int(r["method"])) for r in back
+        }
+        assert decoded == {(int(p), int(m)) for p, m in pairs}, (
+            "enum values collided or truncated through the uint8 wire "
+            "fields"
+        )
+        for p, m in pairs:
+            if int(m) != 0:
+                assert method_to_string(int(p), int(m)) != "", (p, m)
+
+    def test_protocols_survive_numpy_builder_onehot(self):
+        from alaz_tpu.datastore.dto import REQUEST_DTYPE
+        from alaz_tpu.events.schema import L7Protocol
+        from alaz_tpu.graph.builder import GraphBuilder
+
+        protos = list(L7Protocol)
+        rows = np.zeros(len(protos), dtype=REQUEST_DTYPE)
+        rows["start_time_ms"] = 500
+        rows["from_uid"] = 1
+        rows["to_uid"] = 2
+        rows["from_type"] = 1
+        rows["to_type"] = 2
+        rows["protocol"] = [int(p) for p in protos]
+        rows["completed"] = True
+        batch = GraphBuilder().build(rows)
+        assert batch.n_edges == len(protos), "protocol collision in groupby"
+        got = sorted(int(t) for t in batch.edge_type[: batch.n_edges])
+        assert got == sorted(int(p) for p in protos)
+        onehot_cols = set()
+        for i in range(batch.n_edges):
+            oh = batch.edge_feats[i, 7 : 7 + len(protos)]
+            assert oh.sum() == 1.0
+            onehot_cols.add(int(np.argmax(oh)))
+        assert len(onehot_cols) == len(protos), "one-hot slots collided"
+
+    def test_protocols_survive_native_ring(self):
+        from alaz_tpu.events.schema import L7Protocol
+        from alaz_tpu.graph import native
+
+        if not native.available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        ing = native.NativeIngest(window_s=1.0)
+        try:
+            protos = list(L7Protocol)
+            recs = np.zeros(len(protos), dtype=native.NATIVE_RECORD_DTYPE)
+            recs["start_time_ms"] = 500
+            recs["from_uid"] = 1
+            recs["to_uid"] = 2
+            recs["protocol"] = [int(p) for p in protos]
+            assert ing.push_records(recs) == len(protos)
+            nxt = np.zeros(1, dtype=native.NATIVE_RECORD_DTYPE)
+            nxt["start_time_ms"] = 1500  # watermark past window 0
+            ing.push_records(nxt)
+            batch = ing.poll()
+            assert batch is not None and batch.n_edges == len(protos)
+            got = sorted(int(t) for t in batch.edge_type[: batch.n_edges])
+            assert got == sorted(int(p) for p in protos)
+            for i in range(batch.n_edges):
+                p = int(batch.edge_type[i])
+                oh = batch.edge_feats[i, 7 : 7 + len(protos)]
+                assert oh[p] == 1.0 and float(oh.sum()) == 1.0, (
+                    "C one-hot slot disagrees with the enum value"
+                )
+        finally:
+            ing.close()

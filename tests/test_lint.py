@@ -47,6 +47,7 @@ PAIRED_CODES = [
     "ALZ012",
     "ALZ013",
     "ALZ014",
+    "ALZ024",
 ]
 
 
@@ -184,6 +185,53 @@ class TestWholeProgram:
             ("qmod.py", 13, "ALZ014"),
         }
 
+    def test_ctor_arg_lock_cycle_across_modules(self, tmp_path):
+        """ISSUE 4 satellite: a lock that only becomes known through a
+        constructor call in ANOTHER module. ``store.Store`` receives its
+        lock as ``__init__(self, lk)``; the construction site (and the
+        fresh ``threading.Lock()`` argument) live in ``wiring.py`` —
+        without ctor-arg inference the cycle is invisible."""
+        (tmp_path / "store.py").write_text(
+            "import threading\n"
+            "class Store:\n"
+            "    def __init__(self, lk, journal):\n"
+            "        self._lk = lk\n"
+            "        self.journal = journal\n"
+            "    def put(self):\n"
+            "        with self._lk:\n"
+            "            self.journal.append_entry()\n"
+            "    def size(self):\n"
+            "        with self._lk:\n"
+            "            return 0\n"
+        )
+        (tmp_path / "wiring.py").write_text(
+            "import threading\n"
+            "from store import Store\n"
+            "class Journal:\n"
+            "    def __init__(self):\n"
+            "        self._jlock = threading.Lock()\n"
+            "        self.store = Store(threading.Lock(), self)\n"
+            "    def append_entry(self):\n"
+            "        with self._jlock:\n"
+            "            pass\n"
+            "    def checkpoint(self):\n"
+            "        with self._jlock:\n"
+            "            self.store.size()\n"
+        )
+        findings = lint_paths([str(tmp_path)])
+        got = {(Path(f.path).name, f.line, f.code) for f in findings}
+        # Store._lk → Journal._jlock at put's append_entry() call, and
+        # Journal._jlock → Store._lk at checkpoint's size() call
+        assert got == {
+            ("store.py", 8, "ALZ014"),
+            ("wiring.py", 12, "ALZ014"),
+        }
+        # either file alone shows nothing: the lock identity of `lk`
+        # needs wiring.py's construction site
+        for name in ("store.py", "wiring.py"):
+            p = tmp_path / name
+            assert lint_source(str(p), p.read_text()) == []
+
     def test_jit_entry_point_type_variance_across_modules(self, tmp_path):
         (tmp_path / "kern.py").write_text(
             "import jax\n"
@@ -210,8 +258,10 @@ class TestSelfEnforcement:
         assert findings == [], "\n".join(f.render() for f in findings)
 
     def test_tools_tree_is_lint_clean(self):
-        # the analyzer must hold itself to its own contract
-        findings = lint_paths([str(REPO / "tools" / "alazlint")])
+        # the analyzers must hold themselves to their own contract
+        findings = lint_paths(
+            [str(REPO / "tools" / "alazlint"), str(REPO / "tools" / "alazspec")]
+        )
         assert findings == [], "\n".join(f.render() for f in findings)
 
     def test_cli_json_mode_and_exit_codes(self, capsys):

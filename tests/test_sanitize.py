@@ -164,6 +164,68 @@ class TestHostPipelineLockOrder:
         assert summary["acquisitions"] > 100, summary
         assert server.records == 4 * 20 * 64
 
+    def test_native_ingest_store_stress_is_acyclic(self, lock_sanitizer):
+        """ROADMAP follow-up (ISSUE 4 satellite): point the lock stress
+        at the native-ingest store. NativeWindowedStore serializes the
+        single-consumer C++ core behind one Python lock; concurrent
+        pushers + record pushers + flushers must leave the instrumented
+        order graph acyclic and the store's drop accounting consistent
+        (no rows silently lost OUTSIDE the drop counters)."""
+        from alaz_tpu.graph import native
+
+        if not native.available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        mon = lock_sanitizer
+        store = native.NativeWindowedStore(window_s=0.001)
+        try:
+            recs = np.zeros(256, dtype=native.NATIVE_RECORD_DTYPE)
+            recs["from_uid"] = np.arange(256) % 16
+            recs["to_uid"] = np.arange(256) % 8 + 16
+            recs["protocol"] = np.arange(256) % 9
+
+            def pusher(tid: int) -> None:
+                for i in range(30):
+                    rows = recs.copy()
+                    # advancing windows so closes interleave with pushes
+                    rows["start_time_ms"] = (tid * 30 + i) * 2
+                    store.push_records(rows)
+
+            def flusher() -> None:
+                for _ in range(10):
+                    store.flush()
+
+            threads = [
+                threading.Thread(target=pusher, args=(t,)) for t in range(3)
+            ] + [threading.Thread(target=flusher)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+                # a deadlock must FAIL here, not hang the suite at the
+                # flush below with the same lock held
+                assert not t.is_alive(), "stress thread wedged (deadlock?)"
+            store.flush()
+            total_in = 3 * 30 * 256
+            # row conservation: every pushed row is either aggregated
+            # into some emitted batch (ef[:, 0] is log1p(count)) or in
+            # exactly one drop counter — nothing vanishes untracked
+            emitted_rows = sum(
+                int(np.rint(np.expm1(b.edge_feats[: b.n_edges, 0])).sum())
+                for b in store.batches
+            )
+            dropped = (
+                store.ring_dropped + store.late_dropped + store.acc_dropped
+            )
+            assert store.request_count == total_in
+            assert emitted_rows + dropped == total_in
+            assert emitted_rows > 0, "stress closed no windows"
+        finally:
+            store.close()
+
+        mon.assert_acyclic()
+        summary = mon.graph_summary()
+        assert summary["acquisitions"] >= 3 * 30, summary
+
 
 def _mk_batch(n_nodes: int, n_edges: int, cfg, seed: int = 0):
     """Synthetic GraphBatch at an exact (node, edge) bucket."""

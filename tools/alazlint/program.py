@@ -81,6 +81,11 @@ class ClassInfo:
     cond_base: Dict[str, str] = field(default_factory=dict)  # cond attr -> lock attr
     attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class qualname
     methods: Dict[str, str] = field(default_factory=dict)  # name -> fn qualname
+    # __init__ positional params (after self), and the subset stored
+    # verbatim into self attrs (``self._lk = lk``) — the hooks the
+    # constructor-arg lock inference (ISSUE 4 satellite) resolves through
+    ctor_params: List[str] = field(default_factory=list)
+    ctor_param_attrs: Dict[str, str] = field(default_factory=dict)  # param -> attr
 
 
 class ProgramModel:
@@ -99,6 +104,10 @@ class ProgramModel:
         # attr types need the class index complete first
         for info in self.classes.values():
             self._infer_attr_types(info)
+        # ...and ctor-arg lock inference needs attr types + every call
+        # site, so it runs last (ISSUE 4 satellite: `self._lk = lk` where
+        # the constructor is called with a lock)
+        self._infer_ctor_locks()
 
     # -- indexing -----------------------------------------------------------
 
@@ -140,6 +149,27 @@ class ProgramModel:
         walk_scope(ctx.tree.body, f"{mod}:", None)
 
     def _collect_locks(self, cinfo: ClassInfo) -> None:
+        for item in cinfo.node.body:
+            if (
+                isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and item.name == "__init__"
+            ):
+                args = item.args
+                cinfo.ctor_params = [
+                    a.arg for a in (args.posonlyargs + args.args)[1:]
+                ]
+                for sub in ast.walk(item):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    if not (
+                        isinstance(sub.value, ast.Name)
+                        and sub.value.id in cinfo.ctor_params
+                    ):
+                        continue
+                    for t in sub.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            cinfo.ctor_param_attrs[sub.value.id] = attr
         for node in ast.walk(cinfo.node):
             targets: List[ast.AST] = []
             value: Optional[ast.AST] = None
@@ -176,6 +206,81 @@ class ProgramModel:
                 attr = _self_attr(t)
                 if attr is not None:
                     cinfo.attr_types[attr] = target_cls
+
+    def _infer_ctor_locks(self) -> None:
+        """Resolve ``self.<attr> = <param>`` constructor-stored params
+        through their construction sites (ROADMAP follow-up — before
+        this pass only ``self.x = Cls(...)`` literals resolved, so
+        anything injected through a constructor was invisible to the
+        ALZ014 cycle search):
+
+        - the attr becomes a LOCK when any resolvable site passes a
+          fresh ``threading.Lock()``/``RLock()``/``Condition()``, the
+          calling class's own lock attr, or a module-global lock;
+        - the attr gets a TYPE when a site passes ``self`` (the calling
+          class) or a constructor call of a project class, so method
+          calls through the stored object keep resolving."""
+        for ctx in self.ctxs:
+            mod = self.module_of[id(ctx)]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target_cls = self.resolve_class(mod, node.func)
+                if target_cls is None:
+                    continue
+                cinfo = self.classes[target_cls]
+                if not cinfo.ctor_param_attrs:
+                    continue
+                bound: List[Tuple[str, ast.AST]] = list(
+                    zip(cinfo.ctor_params, node.args)
+                )
+                bound += [
+                    (kw.arg, kw.value) for kw in node.keywords if kw.arg
+                ]
+                for pname, arg in bound:
+                    attr = cinfo.ctor_param_attrs.get(pname)
+                    if attr is None:
+                        continue
+                    if attr not in cinfo.lock_attrs and self._is_lock_expr(
+                        ctx, mod, node, arg
+                    ):
+                        cinfo.lock_attrs[attr] = "lock"
+                    if attr not in cinfo.attr_types:
+                        t = self._ctor_arg_type(ctx, mod, node, arg)
+                        if t is not None:
+                            cinfo.attr_types[attr] = t
+
+    def _ctor_arg_type(
+        self, ctx: FileContext, mod: str, site: ast.AST, arg: ast.AST
+    ) -> Optional[str]:
+        """Class qualname a constructor argument evidently carries."""
+        if isinstance(arg, ast.Name) and arg.id == "self":
+            for anc in ctx.ancestors(site):
+                if isinstance(anc, ast.ClassDef):
+                    qn = f"{mod}:{anc.name}"
+                    return qn if qn in self.classes else None
+            return None
+        if isinstance(arg, ast.Call):
+            return self.resolve_class(mod, arg.func)
+        return None
+
+    def _is_lock_expr(
+        self, ctx: FileContext, mod: str, site: ast.AST, arg: ast.AST
+    ) -> bool:
+        """Does this constructor argument evidently carry a lock?"""
+        if isinstance(arg, ast.Call):
+            _, name = _callee(arg)
+            return name in _LOCKISH_CTORS
+        attr = _self_attr(arg)
+        if attr is not None:
+            for anc in ctx.ancestors(site):
+                if isinstance(anc, ast.ClassDef):
+                    cinfo = self.classes.get(f"{mod}:{anc.name}")
+                    return cinfo is not None and attr in cinfo.lock_attrs
+            return False
+        if isinstance(arg, ast.Name):
+            return _module_global_lock(self, mod, arg.id) is not None
+        return False
 
     # -- resolution ---------------------------------------------------------
 
@@ -284,19 +389,27 @@ def _lock_id_for(
             return f"{mod}:{cls.name}.{cinfo.cond_base.get(attr, attr)}"
         return None
     if isinstance(expr, ast.Name):
-        # module-global lock: assigned threading.Lock()/RLock() at module
-        # scope in the same file
-        ctxs = [c for c in model.ctxs if model.module_of[id(c)] == mod]
-        for ctx in ctxs:
-            for stmt in ctx.tree.body:
-                if isinstance(stmt, ast.Assign) and isinstance(
-                    stmt.value, ast.Call
-                ):
-                    _, name = _callee(stmt.value)
-                    if name in _LOCKISH_CTORS:
-                        for t in stmt.targets:
-                            if isinstance(t, ast.Name) and t.id == expr.id:
-                                return f"{mod}.{expr.id}"
+        return _module_global_lock(model, mod, expr.id)
+    return None
+
+
+def _module_global_lock(
+    model: ProgramModel, mod: str, name: str
+) -> Optional[str]:
+    """Lock node id when ``name`` is assigned threading.Lock()/RLock()
+    at module scope in ``mod``; None otherwise."""
+    for ctx in model.ctxs:
+        if model.module_of[id(ctx)] != mod:
+            continue
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ):
+                _, ctor = _callee(stmt.value)
+                if ctor in _LOCKISH_CTORS:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            return f"{mod}.{name}"
     return None
 
 

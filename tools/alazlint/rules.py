@@ -14,6 +14,16 @@ from tools.alazlint import jax_rules, lock_rules, program
 from tools.alazlint.core import FileContext, Finding
 
 
+def _alz024(ctx: FileContext) -> Iterable[Finding]:
+    # Lazy on purpose: axisrules imports tools.alazlint.core, whose
+    # package __init__ imports THIS module — a module-level reference
+    # would crash any consumer that imports axisrules first (the
+    # still-initializing module has no check_alz024 attribute yet).
+    from tools.alazspec.axisrules import check_alz024
+
+    return check_alz024(ctx)
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -79,6 +89,43 @@ _ALL = [
         "ALZ900",
         "file does not parse",
         lambda ctx: (),  # emitted by the core driver
+    ),
+    # -- alazspec family (tools/alazspec): cross-layer ABI/schema/contract
+    # drift. ALZ020-ALZ023 are emitted by the alazspec driver (`python -m
+    # tools.alazspec`, `make abi-check`) because they read C sources,
+    # golden JSON, and live numpy dtypes — not a single Python AST; they
+    # are registered here so codes stay append-only, `--list-rules` shows
+    # the whole catalog, and disable comments parse uniformly. ALZ024 is
+    # a real per-file AST rule and runs in this driver.
+    Rule(
+        "ALZ020",
+        "AlzRecord C struct drifted from NATIVE_RECORD_DTYPE "
+        "(offsets/sizes/constants) or libalaz_ingest.so is stale",
+        lambda ctx: (),  # emitted by tools.alazspec.abirules
+    ),
+    Rule(
+        "ALZ021",
+        "wire frame/event-schema layout drifted from the golden table "
+        "(resources/specs/wire_layouts.json)",
+        lambda ctx: (),  # emitted by tools.alazspec.abirules
+    ),
+    Rule(
+        "ALZ022",
+        "protocol/method enum parity broken (C enum vs Python enums, "
+        "method strings, uint8 range, model edge-type axis)",
+        lambda ctx: (),  # emitted by tools.alazspec.abirules
+    ),
+    Rule(
+        "ALZ023",
+        "model shape/dtype/sharding contract drifted from its golden "
+        "specfile (resources/specs/, `make specs`)",
+        lambda ctx: (),  # emitted by tools.alazspec.specfiles
+    ),
+    Rule(
+        "ALZ024",
+        "spec hygiene: PartitionSpec/collective axis name outside the "
+        "project mesh, or float64 requested inside a traced scope",
+        _alz024,
     ),
 ]
 

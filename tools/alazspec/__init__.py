@@ -1,0 +1,36 @@
+"""alazspec: cross-layer ABI/schema drift checker + golden contracts.
+
+The third tier-1-enforced analysis head (after alazlint's AST rules and
+alazsan's runtime sanitizer): where alazlint reads one language and
+alazsan reads one process, alazspec reads the *boundaries* — the C
+structs in ``native/ingest.cc`` vs the numpy dtypes, the socket frame
+protocol vs the event schema, the protocol enums vs the model's
+edge-type axis, and the JAX side's shape/dtype/PartitionSpec contracts
+vs checked-in golden specfiles.
+
+Rule codes (registered in tools/alazlint/rules.py; same append-only
+policy):
+
+- ALZ020 — AlzRecord C-struct ↔ NATIVE_RECORD_DTYPE parity (field
+  names/offsets/sizes, feature-dim constants, .so staleness guard)
+- ALZ021 — wire-frame/schema layout drift vs the golden layout table
+  (resources/specs/wire_layouts.json)
+- ALZ022 — protocol/method enum parity (C enum ↔ Python enums, method
+  string tables, uint8 range, model edge-type axis)
+- ALZ023 — golden specfile drift (param/activation shapes, dtypes,
+  PartitionSpecs per (model, bucket))
+- ALZ024 — spec hygiene (per-file AST rule in the alazlint driver):
+  PartitionSpec/collective axis names outside the project mesh, and
+  float64 dtype requests inside traced scopes
+
+Drivers: ``python -m tools.alazspec --abi`` (ALZ020/021/022),
+``--check-specs`` (ALZ023), ``--write-specs`` (regenerate goldens,
+``make specs``). ALZ024 runs wherever alazlint runs.
+"""
+
+# No eager submodule imports: tools.alazlint.rules imports
+# tools.alazspec.axisrules (ALZ024 lives in the lint driver), so an
+# import here would close a cycle through the two package __init__s.
+# Use the submodules directly: tools.alazspec.abirules.check_abi,
+# tools.alazspec.specfiles.{check_specs,write_specs}.
+__all__ = ["abirules", "axisrules", "cstructs", "specfiles"]

@@ -1,0 +1,80 @@
+"""CLI driver: ``python -m tools.alazspec [--abi] [--check-specs]
+[--write-specs] [--json] [--out DIR]``.
+
+No flags = the full tier-1 gate (--abi --check-specs). Exit 1 on
+findings, 2 on usage errors — same contract as tools.alazlint.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    out_dir: Optional[Path] = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        if i + 1 >= len(argv):
+            print("--out requires a directory", file=sys.stderr)
+            return 2
+        out_dir = Path(argv[i + 1])
+        del argv[i : i + 2]
+    flags = set(argv)
+    unknown = flags - {"--abi", "--check-specs", "--write-specs"}
+    if unknown:
+        print(
+            "usage: python -m tools.alazspec [--abi] [--check-specs] "
+            "[--write-specs] [--json] [--out DIR]",
+            file=sys.stderr,
+        )
+        return 2
+    if not flags:
+        flags = {"--abi", "--check-specs"}
+
+    if "--write-specs" in flags:
+        from tools.alazspec.specfiles import write_specs
+
+        written = write_specs(out_dir)
+        if not as_json:
+            for p in written:
+                print(f"wrote {p}")
+        else:
+            print(json.dumps({"written": [str(p) for p in written]}))
+        if flags == {"--write-specs"}:
+            return 0
+
+    findings = []
+    if "--abi" in flags:
+        from tools.alazspec.abirules import check_abi
+
+        findings += check_abi()
+    if "--check-specs" in flags:
+        from tools.alazspec.specfiles import check_specs
+
+        findings += check_specs()
+
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"alazspec: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

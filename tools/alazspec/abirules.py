@@ -1,0 +1,548 @@
+"""ABI parity pass: ALZ020 (C struct ↔ numpy dtype), ALZ021 (wire
+frame/schema layout vs the golden table), ALZ022 (enum/axis parity).
+
+All checks produce alazlint ``Finding`` objects so the output, disable
+policy, and fixture conventions stay uniform across the three analysis
+heads. Findings anchor at the drifted declaration: the C field line for
+struct drift, the dtype field line for schema drift, the enum member
+line for value drift.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.alazlint.core import Finding
+from tools.alazspec.axisrules import MESH_AXES
+from tools.alazspec.cstructs import CSource
+
+REPO = Path(__file__).resolve().parent.parent.parent
+INGEST_CC = REPO / "alaz_tpu" / "native" / "ingest.cc"
+WIRE_LAYOUTS = REPO / "resources" / "specs" / "wire_layouts.json"
+
+
+def _parse_layout(layout: str) -> Tuple[str, int, Dict[str, Tuple[int, int]]]:
+    """"Name:size;f:off:sz;..." → (name, size, {field: (off, sz)})."""
+    head, *rest = layout.split(";")
+    name, size = head.split(":")
+    fields = {}
+    for part in rest:
+        f, off, sz = part.split(":")
+        fields[f] = (int(off), int(sz))
+    return name, int(size), fields
+
+
+def _load_module(path: Path, name: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _py_field_line(path: Path, field: str, dtype_name: str = "") -> int:
+    """Line of a structured-dtype field declaration ``("field", ...`` in
+    a schema-like python file, scoped to the block AFTER the dtype's own
+    assignment when ``dtype_name`` is given (field names like ``status``
+    recur across dtypes); 1 when not found."""
+    lines = path.read_text().splitlines()
+    start = 0
+    if dtype_name:
+        decl = re.compile(r"^\s*" + re.escape(dtype_name) + r"\s*=")
+        for i, line in enumerate(lines):
+            if decl.match(line):
+                start = i
+                break
+    pat = re.compile(r'["\']' + re.escape(field) + r'["\']')
+    for i, line in enumerate(lines[start:], start=start + 1):
+        if pat.search(line):
+            return i
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# ALZ020 — AlzRecord struct ↔ NATIVE_RECORD_DTYPE (+ constants, staleness)
+# ---------------------------------------------------------------------------
+
+
+def check_record_abi(
+    cc_path: Path = INGEST_CC, check_binary: bool = True
+) -> List[Finding]:
+    from alaz_tpu.graph import native as gn
+    from alaz_tpu.graph.builder import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
+
+    out: List[Finding] = []
+    src = CSource(cc_path.read_text(), str(cc_path))
+    st = src.struct("AlzRecord")
+    if st is None:
+        return [Finding("ALZ020", "struct AlzRecord not found", str(cc_path), 1, 0)]
+
+    _, dt_size, dt_fields = _parse_layout(gn.record_layout_string())
+    cc_fields = {f.name: (f.offset, f.size) for f in st.fields}
+
+    if [f.name for f in st.fields] != list(dt_fields):
+        out.append(
+            Finding(
+                "ALZ020",
+                "AlzRecord field set/order "
+                f"{[f.name for f in st.fields]} != NATIVE_RECORD_DTYPE "
+                f"{list(dt_fields)} (graph/native.py)",
+                str(cc_path),
+                st.line,
+                0,
+            )
+        )
+    for f in st.fields:
+        want = dt_fields.get(f.name)
+        if want is not None and want != (f.offset, f.size):
+            out.append(
+                Finding(
+                    "ALZ020",
+                    f"AlzRecord.{f.name} is offset {f.offset} size {f.size} "
+                    f"in C but offset {want[0]} size {want[1]} in "
+                    "NATIVE_RECORD_DTYPE — an agent built against one side "
+                    "ships misaligned records the other silently misreads",
+                    str(cc_path),
+                    f.line,
+                    0,
+                )
+            )
+    if st.size != dt_size:
+        out.append(
+            Finding(
+                "ALZ020",
+                f"sizeof(AlzRecord) == {st.size} but "
+                f"NATIVE_RECORD_DTYPE.itemsize == {dt_size}",
+                str(cc_path),
+                st.line,
+                0,
+            )
+        )
+
+    # feature-dim constants vs graph/builder.py
+    consts = src.constants()
+    for cname, pyval in (
+        ("kEdgeFeatDim", EDGE_FEATURE_DIM),
+        ("kNodeFeatDim", NODE_FEATURE_DIM),
+    ):
+        got = consts.get(cname)
+        if got is not None and got[0] != pyval:
+            out.append(
+                Finding(
+                    "ALZ020",
+                    f"{cname} == {got[0]} in ingest.cc but graph/builder.py "
+                    f"says {pyval} — every exported feature row would "
+                    "misalign",
+                    str(cc_path),
+                    got[1],
+                    0,
+                )
+            )
+
+    if check_binary:
+        out.extend(check_staleness(cc_path))
+    return out
+
+
+def source_hash(cc_path: Path = INGEST_CC) -> str:
+    """The Makefile's stamp recipe: sha256 prefix (16 hex) of ingest.cc."""
+    return hashlib.sha256(cc_path.read_bytes()).hexdigest()[:16]
+
+
+def check_staleness(cc_path: Path = INGEST_CC) -> List[Finding]:
+    """Flag a loadable libalaz_ingest.so built from a different ingest.cc
+    than the one on disk (satellite: the stale-artifact guard). Absent or
+    unloadable library → nothing to check (the numpy fallback serves)."""
+    from alaz_tpu.graph import native as gn
+
+    try:
+        loaded = gn.loaded_source_hash()
+    except RuntimeError as exc:
+        # graph/native.py refused the binary at load (layout/feature-dim
+        # drift) — that IS the drift this pass reports; don't crash the
+        # gate on exactly the condition it exists to catch
+        return [
+            Finding(
+                "ALZ020",
+                f"libalaz_ingest.so refused at load: {exc}",
+                str(cc_path),
+                1,
+                0,
+            )
+        ]
+    if loaded is None:
+        return []
+    want = source_hash(cc_path)
+    if loaded == want:
+        return []
+    detail = (
+        "an out-of-band build (no Makefile stamp)"
+        if loaded in ("unstamped", "unknown")
+        else f"source hash {loaded}"
+    )
+    return [
+        Finding(
+            "ALZ020",
+            f"libalaz_ingest.so was built from {detail}, but the checked-in "
+            f"ingest.cc hashes to {want} — rebuild with `make native` so "
+            "the binary matches the source the checks read",
+            str(cc_path),
+            1,
+            0,
+        )
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ALZ021 — wire frame + event-schema layouts vs the golden table
+# ---------------------------------------------------------------------------
+
+
+def wire_layout_table() -> dict:
+    """The generated half of resources/specs/wire_layouts.json: frame
+    header contract (sources/ingest_server.py) + every wire dtype's
+    layout string (events/schema.py + graph/native.py)."""
+    from alaz_tpu.events import schema
+    from alaz_tpu.graph import native as gn
+    from alaz_tpu.sources import ingest_server as srv
+
+    dtypes = {
+        name: schema.dtype_layout(dt, name)
+        for name, dt in schema.WIRE_DTYPES.items()
+    }
+    dtypes["NATIVE_RECORD_DTYPE"] = gn.record_layout_string()
+    return {
+        "frame": {
+            "header_size": srv.FRAME_HEADER.size,
+            "header_format": srv.FRAME_HEADER.format,
+            "magic": f"0x{srv.MAGIC:08X}",
+            "max_frame_bytes": srv.MAX_FRAME_BYTES,
+            "kinds": {
+                str(srv.KIND_L7): "L7_EVENT_DTYPE",
+                str(srv.KIND_TCP): "TCP_EVENT_DTYPE",
+                str(srv.KIND_PROC): "PROC_EVENT_DTYPE",
+                str(srv.KIND_NATIVE): "NATIVE_RECORD_DTYPE",
+            },
+        },
+        "dtypes": dtypes,
+    }
+
+
+def check_wire_layouts(
+    golden_path: Path = WIRE_LAYOUTS, schema_path: Optional[Path] = None
+) -> List[Finding]:
+    """Diff the live wire layouts against the golden table. With
+    ``schema_path``, that file is loaded as a schema module and ITS
+    dtypes are diffed instead (the fixture-pair hook)."""
+    from alaz_tpu.events import schema as real_schema
+
+    out: List[Finding] = []
+    if not golden_path.exists():
+        return [
+            Finding(
+                "ALZ021",
+                f"golden wire layout table {golden_path} missing — run "
+                "`make specs`",
+                str(golden_path),
+                1,
+                0,
+            )
+        ]
+    golden = json.loads(golden_path.read_text())
+
+    # where each dtype is declared, so drift anchors at the edited file
+    anchors = {
+        "NATIVE_RECORD_DTYPE": REPO / "alaz_tpu" / "graph" / "native.py",
+    }
+    default_anchor = REPO / "alaz_tpu" / "events" / "schema.py"
+    if schema_path is None:
+        live = wire_layout_table()
+        if live["frame"] != golden.get("frame"):
+            out.append(
+                Finding(
+                    "ALZ021",
+                    "ingest frame contract drifted from the golden table "
+                    f"(live {live['frame']} != golden {golden.get('frame')}) "
+                    "— agents framing against the old header desync",
+                    str(REPO / "alaz_tpu" / "sources" / "ingest_server.py"),
+                    1,
+                    0,
+                )
+            )
+        live_dtypes = live["dtypes"]
+    else:
+        mod = _load_module(schema_path, "alazspec_schema_fixture")
+        anchors = {}
+        default_anchor = schema_path
+        live_dtypes = {
+            name: real_schema.dtype_layout(getattr(mod, name), name)
+            for name in golden.get("dtypes", {})
+            if hasattr(mod, name)
+        }
+
+    if schema_path is None:
+        # the dtype SET is part of the contract too: a wire dtype
+        # dropped from WIRE_DTYPES (agents still frame it) or added
+        # without `make specs` is drift, not a skip. Fixture mode
+        # (schema_path set) legitimately defines a subset.
+        for name in sorted(set(golden.get("dtypes", {})) - set(live_dtypes)):
+            out.append(
+                Finding(
+                    "ALZ021",
+                    f"{name} is pinned in the golden wire table but no "
+                    "longer exported (events/schema.py WIRE_DTYPES / "
+                    "graph/native.py) — agents still framing it have no "
+                    "contract; if retiring it, regenerate with `make specs`",
+                    str(default_anchor),
+                    1,
+                    0,
+                )
+            )
+        for name in sorted(set(live_dtypes) - set(golden.get("dtypes", {}))):
+            out.append(
+                Finding(
+                    "ALZ021",
+                    f"wire dtype {name} is exported but missing from the "
+                    "golden table — a new wire surface shipped without "
+                    "`make specs`",
+                    str(anchors.get(name, default_anchor)),
+                    1,
+                    0,
+                )
+            )
+
+    for name, want in golden.get("dtypes", {}).items():
+        got = live_dtypes.get(name)
+        if got is None:
+            continue
+        if got == want:
+            continue
+        anchor = anchors.get(name, default_anchor)
+        _, want_size, want_fields = _parse_layout(want)
+        _, got_size, got_fields = _parse_layout(got)
+        drifted = [
+            f
+            for f in want_fields
+            if got_fields.get(f) != want_fields[f]
+        ] + [f for f in got_fields if f not in want_fields]
+        f0 = drifted[0] if drifted else name
+        out.append(
+            Finding(
+                "ALZ021",
+                f"{name} layout drifted from the golden wire table at "
+                f"field `{f0}` (live {got_fields.get(f0)} vs golden "
+                f"{want_fields.get(f0)}, itemsize {got_size} vs "
+                f"{want_size}) — recorded traces and out-of-process "
+                "agents read the old layout; if intentional, regenerate "
+                "with `make specs`",
+                str(anchor),
+                _py_field_line(anchor, f0, name) if drifted else 1,
+                0,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ALZ022 — protocol/method enum parity (C ↔ Python ↔ model axis)
+# ---------------------------------------------------------------------------
+
+
+def check_enums(cc_path: Path = INGEST_CC) -> List[Finding]:
+    from alaz_tpu.config import ModelConfig
+    from alaz_tpu.events import schema
+    from alaz_tpu.graph.builder import EDGE_FEATURE_DIM
+
+    out: List[Finding] = []
+    schema_path = Path(schema.__file__)
+    protos = list(schema.L7Protocol)
+
+    # Python side: contiguity + name-table inverses (a hole or swap here
+    # silently remaps every recorded trace)
+    for i, p in enumerate(protos):
+        if int(p) != i:
+            out.append(
+                Finding(
+                    "ALZ022",
+                    f"L7Protocol.{p.name} == {int(p)} breaks the contiguous "
+                    "0..N-1 numbering the one-hot edge features index by",
+                    str(schema_path),
+                    1,
+                    0,
+                )
+            )
+    if [p.name for p in protos] != list(schema._PROTOCOL_NAMES):
+        out.append(
+            Finding(
+                "ALZ022",
+                "_PROTOCOL_NAMES is out of step with L7Protocol",
+                str(schema_path),
+                1,
+                0,
+            )
+        )
+
+    # method enums: uint8 range, 0 == UNKNOWN, string table coverage
+    for proto, enum_cls in schema._METHOD_ENUMS.items():
+        for m in enum_cls:
+            if not 0 <= int(m) < 256:
+                out.append(
+                    Finding(
+                        "ALZ022",
+                        f"{enum_cls.__name__}.{m.name} == {int(m)} does not "
+                        "fit the uint8 `method` wire field (truncation)",
+                        str(schema_path),
+                        1,
+                        0,
+                    )
+                )
+            if int(m) != 0 and (proto, m) not in schema._METHOD_STRINGS:
+                out.append(
+                    Finding(
+                        "ALZ022",
+                        f"({proto.name}, {enum_cls.__name__}.{m.name}) has "
+                        "no _METHOD_STRINGS entry — the datastore would "
+                        "export '' for a known method",
+                        str(schema_path),
+                        1,
+                        0,
+                    )
+                )
+        vals = [int(m) for m in enum_cls]
+        if len(set(vals)) != len(vals):
+            out.append(
+                Finding(
+                    "ALZ022",
+                    f"{enum_cls.__name__} has colliding values {vals}",
+                    str(schema_path),
+                    1,
+                    0,
+                )
+            )
+
+    # C side: AlzProtocol must match value-for-value
+    src = CSource(cc_path.read_text(), str(cc_path))
+    cen = src.enum("AlzProtocol")
+    if cen is None:
+        out.append(
+            Finding(
+                "ALZ022",
+                "enum AlzProtocol not found in ingest.cc — the C side has "
+                "no typed protocol contract to check",
+                str(cc_path),
+                1,
+                0,
+            )
+        )
+    else:
+        want = {f"ALZ_PROTO_{p.name}": int(p) for p in protos}
+        for m in cen.members:
+            if m.name in want and want[m.name] != m.value:
+                out.append(
+                    Finding(
+                        "ALZ022",
+                        f"{m.name} == {m.value} in ingest.cc but "
+                        f"L7Protocol.{m.name[10:]} == {want[m.name]} — "
+                        "protocol bytes cross the wire renumbered",
+                        str(cc_path),
+                        m.line,
+                        0,
+                    )
+                )
+        missing = sorted(set(want) - {m.name for m in cen.members})
+        extra = sorted({m.name for m in cen.members} - set(want))
+        if missing or extra:
+            out.append(
+                Finding(
+                    "ALZ022",
+                    f"AlzProtocol member set drifted (missing {missing}, "
+                    f"extra {extra}) from L7Protocol",
+                    str(cc_path),
+                    cen.line,
+                    0,
+                )
+            )
+
+    # the C one-hot clamp bound must track the enum size (a protocol
+    # added to both enums but not the clamp would fold into the last
+    # slot — the literal is deliberate, see ingest.cc kProtoCount)
+    n = len(protos)
+    if cen is not None:
+        kpc = src.constants().get("kProtoCount")
+        if kpc is not None and kpc[0] != n:
+            out.append(
+                Finding(
+                    "ALZ022",
+                    f"kProtoCount == {kpc[0]} in ingest.cc but L7Protocol "
+                    f"has {n} members — protocols beyond the clamp one-hot "
+                    "into the last slot",
+                    str(cc_path),
+                    kpc[1],
+                    0,
+                )
+            )
+    # model/edge-feature axes sized by the protocol count
+    if ModelConfig().num_edge_types != n:
+        out.append(
+            Finding(
+                "ALZ022",
+                f"ModelConfig.num_edge_types == {ModelConfig().num_edge_types}"
+                f" but L7Protocol has {n} members — edge-type embeddings "
+                "and the one-hot block disagree on the axis",
+                str(REPO / "alaz_tpu" / "config.py"),
+                1,
+                0,
+            )
+        )
+    if 7 + n != EDGE_FEATURE_DIM:
+        out.append(
+            Finding(
+                "ALZ022",
+                f"edge features reserve slots 7..{EDGE_FEATURE_DIM - 1} for "
+                f"the protocol one-hot but L7Protocol has {n} members",
+                str(REPO / "alaz_tpu" / "graph" / "builder.py"),
+                1,
+                0,
+            )
+        )
+
+    # the ALZ024 axis vocabulary must track MeshConfig
+    from alaz_tpu.config import mesh_axis_names
+
+    mesh_axes = mesh_axis_names()
+    if mesh_axes != MESH_AXES:
+        out.append(
+            Finding(
+                "ALZ022",
+                f"alazspec MESH_AXES {MESH_AXES} is out of step with "
+                f"MeshConfig fields {mesh_axes} — the ALZ024 axis check "
+                "would under/over-lint",
+                str(Path(__file__)),
+                1,
+                0,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def check_abi(
+    cc_path: Path = INGEST_CC, check_binary: bool = True
+) -> List[Finding]:
+    """The full ABI parity pass (ALZ020 + ALZ021 + ALZ022) over the real
+    tree; fixture paths are injected by the per-rule entry points."""
+    findings = (
+        check_record_abi(cc_path, check_binary=check_binary)
+        + check_wire_layouts()
+        + check_enums(cc_path)
+    )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings

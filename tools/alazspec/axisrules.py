@@ -1,0 +1,132 @@
+"""ALZ024 — spec hygiene (per-file AST rule, runs in the alazlint
+driver): mesh-axis-name literals outside the project vocabulary, and
+float64 dtype requests inside traced scopes.
+
+Both are the static face of contract drift the golden specfiles can
+only catch after the fact:
+
+- A ``PartitionSpec("dpp")`` or ``lax.psum(x, "node")`` literal whose
+  axis is not a MeshConfig axis (dp/tp/ep/sp) fails at runtime only on
+  a mesh that actually shards — single-device CI never sees it.
+- ``float64`` requested under jit/vmap/shard_map silently truncates to
+  f32 with x64 disabled (the repo-wide default): the dtype the author
+  wrote is not the dtype the compiled program runs, which is exactly
+  the drift class alazspec exists to kill.
+
+The axis vocabulary is the literal ``MESH_AXES`` tuple (abirules); the
+ABI pass proves it equal to MeshConfig's fields, so the two layers
+cannot drift apart silently either.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.alazlint.core import FileContext, Finding, callee as _callee
+from tools.alazlint.jax_rules import _str_literals, traced_functions
+
+# Python-side mesh axis vocabulary. Kept as a literal so the lint pass
+# stays import-light (this module loads with the alazlint rule registry);
+# abirules.check_enums verifies it against MeshConfig's fields (ALZ022),
+# so an axis added to the dataclass without updating this tuple fails
+# tier-1 instead of silently under-linting.
+MESH_AXES = ("dp", "tp", "ep", "sp")
+
+_PSPEC_CTORS = {"P", "PartitionSpec"}
+# collectives whose axis-name argument is positional arg 1 (arg 0 for
+# axis_index) or an axis/axis_name keyword
+_COLLECTIVES = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "all_gather",
+    "psum_scatter",
+    "all_to_all",
+    "axis_index",
+}
+_F64_NAMES = {"float64", "f64"}
+
+
+def _axis_literals(call: ast.Call) -> Iterable[tuple[str, ast.AST]]:
+    _, name = _callee(call)
+    if name in _PSPEC_CTORS:
+        for arg in call.args:
+            for s in _str_literals(arg):
+                yield s, arg
+    elif name in _COLLECTIVES:
+        pos = 0 if name == "axis_index" else 1
+        if len(call.args) > pos:
+            for s in _str_literals(call.args[pos]):
+                yield s, call.args[pos]
+        for kw in call.keywords:
+            if kw.arg in ("axis", "axis_name"):
+                for s in _str_literals(kw.value):
+                    yield s, kw.value
+
+
+def _is_f64(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in _F64_NAMES
+    if isinstance(node, ast.Constant):
+        return node.value in _F64_NAMES
+    return False
+
+
+def check_alz024(ctx: FileContext) -> Iterable[Finding]:
+    # (a) axis-name literals, anywhere in the file (specs are declared at
+    # module scope as often as inside makers)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for axis, anchor in _axis_literals(node):
+            if axis not in MESH_AXES:
+                yield Finding(
+                    "ALZ024",
+                    f"mesh axis `{axis}` is not a project mesh axis "
+                    f"{'/'.join(MESH_AXES)} (config.MeshConfig) — this "
+                    "PartitionSpec/collective only fails on a mesh that "
+                    "actually shards, which CI never builds",
+                    ctx.path,
+                    anchor.lineno,
+                    anchor.col_offset,
+                )
+
+    # (b) float64 requests inside directly-traced functions
+    for fn, _call in traced_functions(ctx):
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = None
+                # a bare float64 reference as ANY call argument is a
+                # dtype request in practice — .astype(f64), dtype=f64,
+                # and the positional spellings jnp.zeros(s, jnp.float64)
+                # / jnp.asarray(x, jnp.float64) all land here
+                if any(_is_f64(a) for a in node.args):
+                    hit = "float64 argument"
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args
+                    and _is_f64(node.args[0])
+                ):
+                    hit = ".astype(float64)"
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_f64(kw.value):
+                        hit = "dtype=float64"
+                if hit:
+                    yield Finding(
+                        "ALZ024",
+                        f"{hit} inside a traced scope — x64 is disabled "
+                        "repo-wide, so this silently truncates to f32: the "
+                        "written dtype and the compiled dtype drift apart; "
+                        "accumulate in f32 explicitly (or move the f64 "
+                        "math to host numpy)",
+                        ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )

@@ -1,0 +1,204 @@
+"""A deliberately small C struct/enum reader for ``native/ingest.cc``.
+
+Not a C parser — a layout extractor for the restricted dialect the
+ingest core's wire-visible declarations actually use: fixed-width
+scalar fields, explicit enum values (or previous+1), ``constexpr``
+integer constants. It computes field offsets/sizes under the x86-64
+(and aarch64) SysV rules the .so is built with: natural alignment,
+struct size rounded up to the widest member alignment.
+
+Every extracted item carries its source line so drift findings anchor
+at the drifted declaration, not at the file head.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# fixed-width scalar types the wire structs may use → (size, align)
+_SCALARS: Dict[str, int] = {
+    "bool": 1,
+    "char": 1,
+    "int8_t": 1,
+    "uint8_t": 1,
+    "int16_t": 2,
+    "uint16_t": 2,
+    "int32_t": 4,
+    "uint32_t": 4,
+    "float": 4,
+    "int64_t": 8,
+    "uint64_t": 8,
+    "double": 8,
+}
+
+_STRUCT_RE = re.compile(r"\bstruct\s+(\w+)\s*\{")
+_ENUM_RE = re.compile(r"\benum\s+(?:class\s+)?(\w+)\s*(?::\s*\w+\s*)?\{")
+_FIELD_RE = re.compile(r"^\s*(\w+)\s+(\w+)\s*;\s*$")
+_CONSTEXPR_RE = re.compile(
+    r"\bconstexpr\s+\w+\s+(\w+)\s*=\s*(\d+)\s*(?:u|U)?\s*;"
+)
+
+
+@dataclass
+class CField:
+    name: str
+    ctype: str
+    offset: int
+    size: int
+    line: int
+
+
+@dataclass
+class CStruct:
+    name: str
+    line: int
+    fields: List[CField] = field(default_factory=list)
+    size: int = 0
+
+    def layout_string(self) -> str:
+        """Same format as events/schema.py dtype_layout() and the .so's
+        alz_abi_record_layout(): one string comparison = ABI parity."""
+        parts = [f"{self.name}:{self.size}"]
+        parts += [f"{f.name}:{f.offset}:{f.size}" for f in self.fields]
+        return ";".join(parts)
+
+
+@dataclass
+class CEnumMember:
+    name: str
+    value: int
+    line: int
+
+
+@dataclass
+class CEnum:
+    name: str
+    line: int
+    members: List[CEnumMember] = field(default_factory=list)
+
+    def values(self) -> Dict[str, int]:
+        return {m.name: m.value for m in self.members}
+
+
+def _strip_comments(source: str) -> str:
+    """Blank out // and /* */ comments, preserving line structure so
+    recorded line numbers stay true."""
+    out: List[str] = []
+    in_block = False
+    for line in source.splitlines():
+        buf = []
+        i = 0
+        while i < len(line):
+            if in_block:
+                j = line.find("*/", i)
+                if j < 0:
+                    i = len(line)
+                else:
+                    in_block = False
+                    i = j + 2
+                continue
+            if line.startswith("//", i):
+                break
+            if line.startswith("/*", i):
+                in_block = True
+                i += 2
+                continue
+            buf.append(line[i])
+            i += 1
+        out.append("".join(buf))
+    return "\n".join(out)
+
+
+def _body_lines(
+    lines: List[str], open_line_idx: int
+) -> List[Tuple[int, str]]:
+    """(1-based lineno, text) pairs of a ``{ ... };`` body starting at
+    the line whose ``{`` opened it."""
+    depth = 0
+    body: List[Tuple[int, str]] = []
+    for idx in range(open_line_idx, len(lines)):
+        text = lines[idx]
+        if idx == open_line_idx:
+            text = text[text.index("{") + 1 :]
+            depth = 1
+        depth += text.count("{") - text.count("}")
+        if depth <= 0:
+            cut = text.find("}")
+            body.append((idx + 1, text[:cut] if cut >= 0 else text))
+            return body
+        body.append((idx + 1, text))
+    return body
+
+
+class CSource:
+    """Parsed view of one C/C++ source file (comment-stripped)."""
+
+    def __init__(self, source: str, path: str = "<memory>"):
+        self.path = path
+        self.text = _strip_comments(source)
+        self.lines = self.text.splitlines()
+
+    # -- structs ------------------------------------------------------------
+
+    def struct(self, name: str) -> Optional[CStruct]:
+        for i, line in enumerate(self.lines):
+            m = _STRUCT_RE.search(line)
+            if not m or m.group(1) != name or "{" not in line:
+                continue
+            return self._parse_struct(name, i)
+        return None
+
+    def _parse_struct(self, name: str, open_idx: int) -> CStruct:
+        st = CStruct(name=name, line=open_idx + 1)
+        offset = 0
+        max_align = 1
+        for lineno, text in _body_lines(self.lines, open_idx):
+            f = _FIELD_RE.match(text)
+            if not f:
+                continue
+            ctype, fname = f.group(1), f.group(2)
+            size = _SCALARS.get(ctype)
+            if size is None:
+                continue  # non-scalar member: not a wire struct concern
+            align = size
+            offset = (offset + align - 1) // align * align
+            st.fields.append(CField(fname, ctype, offset, size, lineno))
+            offset += size
+            max_align = max(max_align, align)
+        st.size = (offset + max_align - 1) // max_align * max_align
+        return st
+
+    # -- enums --------------------------------------------------------------
+
+    def enum(self, name: str) -> Optional[CEnum]:
+        for i, line in enumerate(self.lines):
+            m = _ENUM_RE.search(line)
+            if not m or m.group(1) != name or "{" not in line:
+                continue
+            en = CEnum(name=name, line=i + 1)
+            next_val = 0
+            for lineno, text in _body_lines(self.lines, i):
+                for part in text.split(","):
+                    part = part.strip()
+                    if not part:
+                        continue
+                    m2 = re.match(r"^(\w+)\s*(?:=\s*(\d+))?$", part)
+                    if not m2:
+                        continue
+                    val = int(m2.group(2)) if m2.group(2) else next_val
+                    en.members.append(CEnumMember(m2.group(1), val, lineno))
+                    next_val = val + 1
+            return en
+        return None
+
+    # -- constexpr constants ------------------------------------------------
+
+    def constants(self) -> Dict[str, Tuple[int, int]]:
+        """name → (value, 1-based line) for constexpr integer constants."""
+        out: Dict[str, Tuple[int, int]] = {}
+        for i, line in enumerate(self.lines):
+            for m in _CONSTEXPR_RE.finditer(line):
+                out[m.group(1)] = (int(m.group(2)), i + 1)
+        return out

@@ -1,0 +1,332 @@
+"""Golden shape/dtype/sharding contracts (ALZ023).
+
+One checked-in JSON specfile per (model, bucket) pins the complete typed
+surface of the JAX side: parameter shapes/dtypes with their
+PartitionSpecs (param_pspec at tp=2 and ep=3 — the smallest factors
+that divide the hidden dim and the num_edge_types=9 expert axis),
+graph-input shapes/dtypes with the dp-stacked pspec, and the forward's
+output shapes/dtypes via ``jax.eval_shape`` (tracing only — no compile,
+no RNG, CPU-safe). The node-sharded twins additionally pin the
+shard_map (in_specs, out_specs) contract, the canonical 2-shard input
+layout, and their REAL forward's outputs (eval_shape over an
+AbstractMesh — device-free, so regeneration stays deterministic
+everywhere).
+
+``write_specs()`` regenerates everything deterministically (sorted
+keys, fixed bucket list) — ``make specs`` must be byte-identical on a
+clean tree, so any re-run that produces a diff IS the finding: a silent
+dtype promotion, a shape change, or a resharding that would have shipped
+unnoticed. ``check_specs()`` is the tier-1 side: regenerate in memory,
+diff against disk, anchor each drift at the first differing line.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tools.alazlint.core import Finding
+
+REPO = Path(__file__).resolve().parent.parent.parent
+SPECS_DIR = REPO / "resources" / "specs"
+
+# (n_pad, e_pad) buckets pinned by the golden contracts: one small, one
+# serving-sized — enough to catch shape-formula drift without pinning
+# every bucket the service may visit (shapes are affine in the bucket).
+SPEC_BUCKETS = ((256, 1024), (1024, 4096))
+N_SHARDS = 2  # canonical sharded-twin layout (any pow2 divides a bucket)
+SPEC_TP = 2  # smallest nontrivial tensor-parallel factor for param specs
+SPEC_EP = 3  # divides num_edge_types=9 expert tables (experts model)
+
+
+def _sds(shape, dtype) -> dict:
+    return {"shape": list(shape), "dtype": str(dtype)}
+
+
+def _leaf_path(path) -> str:
+    import jax
+
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(getattr(p, "key", getattr(p, "name", p))))
+    return "/".join(parts)
+
+
+def _graph_shapes(cfg, n_pad: int, e_pad: int) -> Dict[str, dict]:
+    """The single-graph input surface of every model apply (snapshot.py
+    device_arrays), with the dp-stacked PartitionSpec each key gets in
+    the sharded train/score steps (sharding.graph_pspec)."""
+    import numpy as np
+
+    from alaz_tpu.parallel.sharding import graph_pspec
+
+    shapes = {
+        "node_feats": ((n_pad, cfg.node_feature_dim), np.float32),
+        "node_type": ((n_pad,), np.int32),
+        "node_mask": ((n_pad,), np.bool_),
+        "node_deg": ((n_pad,), np.float32),
+        "edge_src": ((e_pad,), np.int32),
+        "edge_dst": ((e_pad,), np.int32),
+        "edge_type": ((e_pad,), np.int32),
+        "edge_feats": ((e_pad, cfg.edge_feature_dim), np.float32),
+        "edge_mask": ((e_pad,), np.bool_),
+    }
+    pspecs = graph_pspec(stacked=True)
+    return {
+        k: dict(_sds(shape, np.dtype(dt).name), pspec=str(pspecs[k]))
+        for k, (shape, dt) in shapes.items()
+    }
+
+
+def _eval_model(name: str, cfg, n_pad: int, e_pad: int):
+    """(param shape tree, output shape dict) via eval_shape only."""
+    import jax
+    import jax.numpy as jnp
+
+    from alaz_tpu.models.registry import get_model
+
+    init, apply = get_model(name)
+    params = jax.eval_shape(lambda k: init(k, cfg), jax.random.PRNGKey(0))
+    graph = {
+        k: jax.ShapeDtypeStruct(tuple(v["shape"]), jnp.dtype(v["dtype"]))
+        for k, v in _graph_shapes(cfg, n_pad, e_pad).items()
+    }
+    outputs = jax.eval_shape(lambda p, g: apply(p, g, cfg), params, graph)
+    return params, outputs
+
+
+def _model_spec(name: str, cfg, n_pad: int, e_pad: int) -> dict:
+    import jax
+
+    from alaz_tpu.parallel.sharding import mesh_axis_names, param_pspec
+
+    params, outputs = _eval_model(name, cfg, n_pad, e_pad)
+    pspecs = param_pspec(params, tp=SPEC_TP, ep=SPEC_EP)
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(pspecs)[0]
+    param_table = {}
+    for (path, leaf), (_, spec) in zip(flat_p, flat_s):
+        param_table[_leaf_path(path)] = dict(
+            _sds(leaf.shape, leaf.dtype), pspec=str(spec)
+        )
+    out_table = {
+        _leaf_path(path): _sds(leaf.shape, leaf.dtype)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(outputs)[0]
+    }
+    spec = {
+        "model": name,
+        "bucket": {"n_pad": n_pad, "e_pad": e_pad},
+        "mesh_axes": list(mesh_axis_names()),
+        "param_sharding": {"tp": SPEC_TP, "ep": SPEC_EP},
+        "config": _cfg_dict(cfg),
+        "graph_inputs": _graph_shapes(cfg, n_pad, e_pad),
+        "params": param_table,
+        "outputs": out_table,
+    }
+    if name == "tgn":
+        from alaz_tpu.models import tgn
+
+        mem = jax.eval_shape(lambda: tgn.init_memory(cfg, cfg.tgn_max_nodes))
+        spec["memory"] = _sds(mem.shape, mem.dtype)
+    return spec
+
+
+def _sharded_spec(name: str, cfg, n_pad: int, e_pad: int) -> dict:
+    """The node-sharded twin's contract: shard_map in/out specs, the
+    canonical N_SHARDS-shard input layout (n_loc = n_pad/S; the
+    per-shard edge budget canonicalized to e_pad/S —
+    shard_graph_batch right-sizes the true budget per window, affine in
+    the same way), and the REAL forward's outputs — ``jax.eval_shape``
+    of the actual maker over an AbstractMesh, so a dtype/shape change in
+    the shard_map body drifts the specfile (no devices needed, still
+    deterministic)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import AbstractMesh
+
+    from alaz_tpu.models.registry import get_model
+    from alaz_tpu.parallel import sharded_model
+
+    n_loc = n_pad // N_SHARDS
+    e_budget = e_pad // N_SHARDS
+    in_specs, out_specs = sharded_model.node_sharded_specs()
+    shapes = {
+        "node_feats": ((N_SHARDS, n_loc, cfg.node_feature_dim), np.float32),
+        "node_type": ((N_SHARDS, n_loc), np.int32),
+        "node_mask": ((N_SHARDS, n_loc), np.bool_),
+        "edge_src": ((N_SHARDS, e_budget), np.int32),
+        "edge_dst_local": ((N_SHARDS, e_budget), np.int32),
+        "edge_type": ((N_SHARDS, e_budget), np.int32),
+        "edge_feats": ((N_SHARDS, e_budget, cfg.edge_feature_dim), np.float32),
+        "edge_mask": ((N_SHARDS, e_budget), np.bool_),
+    }
+    run = getattr(sharded_model, f"make_node_sharded_{name}")(
+        cfg, AbstractMesh((("sp", N_SHARDS),))
+    )
+    init, _ = get_model(name)
+    params = jax.eval_shape(lambda k: init(k, cfg), jax.random.PRNGKey(0))
+    g = {
+        k: jax.ShapeDtypeStruct(shape, jnp.dtype(np.dtype(dt)))
+        for k, (shape, dt) in shapes.items()
+    }
+    edge_logits, node_logits = jax.eval_shape(run, params, g)
+    return {
+        "model": f"{name}_sharded",
+        "base_model": name,
+        "axis": "sp",
+        "n_shards": N_SHARDS,
+        "bucket": {"n_pad": n_pad, "e_pad": e_pad},
+        "config": _cfg_dict(cfg),
+        "in_specs": {
+            "params": str(in_specs[0]),
+            "graph": {
+                k: str(in_specs[1][k])
+                for k in sharded_model.SHARDED_GRAPH_KEYS
+            },
+        },
+        "out_specs": [str(s) for s in out_specs],
+        "shard_inputs": {
+            k: _sds(shape, np.dtype(dt).name) for k, (shape, dt) in shapes.items()
+        },
+        "outputs": {
+            "edge_logits": _sds(edge_logits.shape, edge_logits.dtype),
+            "node_logits": _sds(node_logits.shape, node_logits.dtype),
+        },
+    }
+
+
+def _cfg_dict(cfg) -> dict:
+    import dataclasses
+
+    return dict(sorted(dataclasses.asdict(cfg).items()))
+
+
+def _spec_name(model: str, n_pad: int, e_pad: int) -> str:
+    return f"{model}_{n_pad}x{e_pad}.json"
+
+
+def _render(spec: dict) -> str:
+    return json.dumps(spec, indent=2, sort_keys=True) + "\n"
+
+
+def generate_specs() -> Dict[str, str]:
+    """filename → rendered JSON for every golden artifact (the spec set
+    plus the wire layout table)."""
+    from alaz_tpu.config import ModelConfig
+    from alaz_tpu.models.registry import NODE_SHARDED_TWINS, REGISTERED_MODELS
+
+    from tools.alazspec.abirules import wire_layout_table
+
+    out: Dict[str, str] = {}
+    for name in REGISTERED_MODELS:
+        cfg = ModelConfig(model=name)
+        for n_pad, e_pad in SPEC_BUCKETS:
+            out[_spec_name(name, n_pad, e_pad)] = _render(
+                _model_spec(name, cfg, n_pad, e_pad)
+            )
+    for name in NODE_SHARDED_TWINS:
+        cfg = ModelConfig(model=name)
+        for n_pad, e_pad in SPEC_BUCKETS:
+            out[_spec_name(f"{name}_sharded", n_pad, e_pad)] = _render(
+                _sharded_spec(name, cfg, n_pad, e_pad)
+            )
+    out["wire_layouts.json"] = _render(wire_layout_table())
+    return out
+
+
+def write_specs(out_dir: Optional[Path] = None) -> List[Path]:
+    out_dir = Path(out_dir) if out_dir is not None else SPECS_DIR
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for fname, text in sorted(generate_specs().items()):
+        p = out_dir / fname
+        p.write_text(text)
+        written.append(p)
+    return written
+
+
+def _first_diff_line(golden: str, live: str) -> int:
+    for i, (a, b) in enumerate(
+        zip(golden.splitlines(), live.splitlines()), start=1
+    ):
+        if a != b:
+            return i
+    return min(len(golden.splitlines()), len(live.splitlines())) + 1
+
+
+def _diff_summary(golden: dict, live: dict, prefix: str = "") -> Optional[str]:
+    """First drifted leaf path + values, depth-first in sorted key order."""
+    if type(golden) is not type(live):
+        return f"{prefix or '<root>'}: {golden!r} -> {live!r}"
+    if isinstance(golden, dict):
+        for k in sorted(set(golden) | set(live)):
+            if k not in golden:
+                return f"{prefix}{k}: <absent> -> {live[k]!r}"
+            if k not in live:
+                return f"{prefix}{k}: {golden[k]!r} -> <absent>"
+            d = _diff_summary(golden[k], live[k], f"{prefix}{k}/")
+            if d:
+                return d
+        return None
+    if golden != live:
+        return f"{prefix.rstrip('/')}: {golden!r} -> {live!r}"
+    return None
+
+
+def check_specs(specs_dir: Optional[Path] = None) -> List[Finding]:
+    """Tier-1 contract diff: regenerate every spec in memory and compare
+    against the checked-in goldens (byte-level; the drift message names
+    the first drifted leaf, the finding line is the first drifted line)."""
+    specs_dir = Path(specs_dir) if specs_dir is not None else SPECS_DIR
+    live = generate_specs()
+    out: List[Finding] = []
+    for fname in sorted(live):
+        if fname == "wire_layouts.json":
+            continue  # ALZ021 owns the wire table (richer message)
+        golden_path = specs_dir / fname
+        if not golden_path.exists():
+            out.append(
+                Finding(
+                    "ALZ023",
+                    f"golden specfile {fname} missing — run `make specs` "
+                    "and commit the result",
+                    str(golden_path),
+                    1,
+                    0,
+                )
+            )
+            continue
+        golden_text = golden_path.read_text()
+        if golden_text == live[fname]:
+            continue
+        detail = _diff_summary(json.loads(golden_text), json.loads(live[fname]))
+        out.append(
+            Finding(
+                "ALZ023",
+                f"model contract drifted from golden specfile: {detail} — "
+                "a shape/dtype/sharding change shipped without regenerating "
+                "the contract; if intentional, `make specs` and review the "
+                "diff",
+                str(golden_path),
+                _first_diff_line(golden_text, live[fname]),
+                0,
+            )
+        )
+    for stray in sorted(specs_dir.glob("*.json")):
+        if stray.name not in live:
+            out.append(
+                Finding(
+                    "ALZ023",
+                    f"stray specfile {stray.name} matches no registered "
+                    "model/bucket — remove it or register the model",
+                    str(stray),
+                    1,
+                    0,
+                )
+            )
+    return out
