@@ -1,0 +1,660 @@
+"""Sharded multi-worker host ingest (ISSUE 5 tentpole).
+
+PR 1 vectorized ``process_l7`` to ~1M rows/s single-threaded; the
+remaining gap to the host plane's per-stage capability is serialization,
+not work — numpy releases the GIL on every big op, so N shard workers
+running the SAME vectorized path on disjoint shards overlap most of the
+wall clock (the FeatGraph / arxiv 2310.12184 shape: keep per-partition
+aggregation data-parallel, push the irregular grouping kernel into the
+tuned native backend — here ``alz_group_edges``).
+
+Topology (every arrow a bounded queue or a locked hand-off):
+
+    submit (any thread) → hash-partition by connection key (pid, fd)
+        → [N worker queues] → shard workers, each running a PRIVATE
+          ``Aggregator`` (socket lines, h2 state, stmt caches, path
+          caches are per-connection state, and a connection always lands
+          on the same worker) over the SHARED thread-safe ``Interner`` /
+          ``ClusterInfo``, persisting REQUEST rows into a per-worker
+          ``ShardPartialStore`` (window-bucketed raw rows)
+        → close waves: when every worker's watermark passes a window,
+          the merge thread broadcasts a close request; EACH WORKER then
+          aggregates its own shard's window rows into one uid-keyed
+          ``EdgePartial`` (one grouped reduction, on the worker thread —
+          the expensive stage stays data-parallel)
+        → merge thread: recombines the N partials per window with ONE
+          more grouped reduction (sum/max per edge key) and assembles
+          the ``GraphBatch`` through the shared ``GraphBuilder`` (slot
+          assignment happens only here, so it is identical to the
+          single-thread path's). With N == 1 there is nothing to
+          recombine: the worker deposits its raw rows and the merge
+          stage runs ``GraphBuilder.build`` verbatim — the pool adds
+          queue hops, not work.
+
+Determinism contract (tests/test_sharded_ingest.py): for the same input
+rows, the merged ``GraphBatch`` is identical to the single-thread
+``WindowedGraphStore`` output — same edges, features and counts — up to
+two documented degrees of freedom: interner id NUMBERING (workers intern
+concurrently, so the ids assigned to the same strings can differ between
+runs; compare through the strings) and per-uid endpoint-type ties (a uid
+seen with two different types keeps whichever its first-mapped row
+carried). Feature equality is exact because every reduction input is an
+integer-valued float64 (per-window latency sums stay below 2^53 ns).
+
+Lock order (ARCHITECTURE §3g; alazsan-stressed in tests/test_sanitize.py):
+worker threads take a store lock OR the progress condition, never both
+at once; the merge path takes ``_merge_lock`` → worker-queue locks
+(close broadcast) → the progress condition (ack wait) → store locks
+(take_ready) → downstream emit locks — one direction only, a DAG by
+construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator, AggregatorStats, _conn_keys
+from alaz_tpu.config import RuntimeConfig
+from alaz_tpu.datastore.interface import BaseDataStore, DataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.k8s import K8sResourceMessage
+from alaz_tpu.graph.builder import (
+    EdgePartial,
+    GraphBuilder,
+    NodeTable,
+    partial_from_rows,
+)
+from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.logging import get_logger
+from alaz_tpu.utils.queues import BatchQueue, QueueClosed
+
+log = get_logger("alaz_tpu.sharded")
+
+_W_FLOOR = -(2**62)  # "no window closed yet" sentinel (below any real id)
+
+
+class _QItem:
+    """One worker-queue element. ``__len__`` is the EVENT count so
+    BatchQueue's events-denominated capacity stays truthful (a bare tuple
+    would count its arity)."""
+
+    __slots__ = ("kind", "payload", "now_ns")
+
+    def __init__(self, kind: str, payload, now_ns):
+        self.kind = kind
+        self.payload = payload
+        self.now_ns = now_ns
+
+    def __len__(self) -> int:
+        p = self.payload
+        if type(p) is tuple and len(p) == 2:  # (chunk, shard row index)
+            p = p[1]
+        shape = getattr(p, "shape", None)
+        return int(shape[0]) if shape else 1
+
+
+def _shard_rows(payload) -> np.ndarray:
+    """Materialize a scattered slice: the scatter ships ``(chunk, idx)``
+    so the record gather runs on the worker thread, not the submitter."""
+    if type(payload) is tuple:
+        chunk, idx = payload
+        return chunk[idx]
+    return payload
+
+
+class ShardPartialStore(BaseDataStore):
+    """One shard worker's DataStore sink: buckets persisted REQUEST rows
+    into time windows (raw — bucketing is one cheap copy, exactly what
+    the serial store pays) and, on a close request, aggregates each
+    closed window's rows into a uid-keyed :class:`EdgePartial` **on the
+    worker thread** — the grouped reduction is the expensive stage and
+    runs in parallel across shards, outside any lock.
+
+    Single-producer: exactly one worker thread calls persist_requests and
+    close_upto; ``_local_nodes`` (the private grouping table) is
+    worker-thread-only and never locked. The lock covers the window map,
+    the ready shelf and the counters, which the merge thread also
+    touches."""
+
+    def __init__(self, window_ms: int, label_fn=None, aggregate: bool = True):
+        self.window_ms = int(window_ms)
+        self.label_fn = label_fn
+        # False (the N==1 pool): deposit raw rows; the merge stage then
+        # runs the serial GraphBuilder.build verbatim — no partial pass
+        self.aggregate = aggregate
+        self._local_nodes = NodeTable()  # worker-thread-only grouping aid
+        self._pending: Dict[int, List[np.ndarray]] = {}  # guarded-by: self._lock
+        # closed-and-aggregated windows awaiting the merge thread:
+        # window id → EdgePartial (aggregate=True) | raw row array
+        self._ready: Dict[int, Union[EdgePartial, np.ndarray]] = {}  # guarded-by: self._lock
+        self._watermark: Optional[int] = None  # guarded-by: self._lock
+        self._closed_upto = _W_FLOOR  # guarded-by: self._lock
+        self.request_count = 0  # guarded-by: self._lock
+        self.late_dropped = 0  # guarded-by: self._lock
+        self.last_persist_monotonic: Optional[float] = None  # guarded-by: self._lock
+        self._lock = threading.Lock()
+
+    # -- DataStore surface (the worker's Aggregator persists here) ---------
+
+    def persist_requests(self, batch: np.ndarray) -> None:
+        with self._lock:
+            self.last_persist_monotonic = time.monotonic()
+            n = int(batch.shape[0])
+            self.request_count += n
+            if n == 0:
+                return
+            wids = batch["start_time_ms"] // self.window_ms
+            wmin, wmax = int(wids.min()), int(wids.max())
+            if wmin == wmax:
+                # dominant steady-state shape: whole chunk in one window.
+                # Copy — the rows are retained across calls and the
+                # caller may reuse its buffer (the serial store's rule).
+                present: Union[np.ndarray, List[int]] = [wmin]
+            elif wmax - wmin < (1 << 20):
+                present = np.flatnonzero(np.bincount(wids - wmin)) + wmin
+            else:  # degenerate timestamps: don't size a bincount by span
+                present = np.unique(wids)
+            for w in present:
+                w = int(w)
+                if w <= self._closed_upto:
+                    # stragglers for an already-closed window (the
+                    # aggregator retry path): drop, never re-emit
+                    self.late_dropped += (
+                        n if wmin == wmax else int((wids == w).sum())
+                    )
+                    continue
+                rows = batch.copy() if wmin == wmax else batch[wids == w]
+                self._pending.setdefault(w, []).append(rows)
+                if self._watermark is None or w > self._watermark:
+                    self._watermark = w
+
+    # -- worker-side close ---------------------------------------------------
+
+    def close_upto(self, upto: Optional[int]) -> None:
+        """Pop every pending window ≤ ``upto`` (None = all), aggregate it
+        on the calling (worker) thread, shelve the result for the merge
+        thread, and seal the horizon so later rows drop as late."""
+        with self._lock:
+            if upto is None:
+                upto = max(self._pending, default=self._closed_upto)
+                if self._watermark is not None:
+                    upto = max(upto, self._watermark)
+            popped = {w: ps for w, ps in self._pending.items() if w <= upto}
+            for w in popped:
+                del self._pending[w]
+            if upto > self._closed_upto:
+                self._closed_upto = upto
+        # the grouped reduction runs OUTSIDE the lock: it is the heavy
+        # stage, and it must overlap across worker threads
+        done: List[tuple] = []
+        for w, parts in sorted(popped.items()):
+            rows = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            if self.aggregate:
+                labels = self.label_fn(rows) if self.label_fn is not None else None
+                done.append((w, partial_from_rows(rows, self._local_nodes, labels)))
+            else:
+                done.append((w, rows))
+        if done:
+            with self._lock:
+                for w, item in done:
+                    self._ready[w] = item
+
+    # -- merge-side surface --------------------------------------------------
+
+    @property
+    def watermark(self) -> Optional[int]:
+        with self._lock:
+            return self._watermark
+
+    def take_ready(self, upto: Optional[int]) -> Dict[int, Union[EdgePartial, np.ndarray]]:
+        """Remove and return shelved windows ≤ ``upto`` (None = all)."""
+        with self._lock:
+            if upto is None:
+                done = dict(self._ready)
+                self._ready.clear()
+            else:
+                done = {w: p for w, p in self._ready.items() if w <= upto}
+                for w in done:
+                    del self._ready[w]
+            return done
+
+    def seal_upto(self, upto: int) -> None:
+        """Advance the never-reopen floor (applied globally after a merge
+        so EVERY store agrees on the merged horizon, even stores that had
+        no rows for those windows)."""
+        with self._lock:
+            if upto > self._closed_upto:
+                self._closed_upto = upto
+
+
+class ShardedIngest:
+    """N-worker sharded ingest pipeline with close-wave merging.
+
+    Duck-types the ``Aggregator`` ingestion surface (``process_l7`` /
+    ``process_tcp`` / ``process_proc`` / ``process_k8s`` / ``gc`` /
+    ``reap_zombies`` / ``flush_retries``) and the windowed-store surface
+    (``flush`` / ``late_dropped`` / ``last_persist_monotonic`` /
+    ``on_batch``), so `runtime.service.Service` can swap it in for the
+    serial pair. Ingestion calls are asynchronous: they partition by
+    connection key and enqueue; closed windows emit on the merge thread.
+
+    ``tee`` (optional) is an extra DataStore every worker's emitted
+    REQUEST rows fan out to (the export-backend leg). It is called from
+    N worker threads concurrently and must be thread-safe — the batching
+    export backend (queue-fronted) is; bespoke sinks must lock.
+    """
+
+    def __init__(
+        self,
+        n_workers: int,
+        interner: Optional[Interner] = None,
+        config: Optional[RuntimeConfig] = None,
+        cluster: Optional[ClusterInfo] = None,
+        window_s: float = 1.0,
+        on_batch: Optional[Callable[[GraphBatch], None]] = None,
+        label_fn=None,
+        renumber: bool = False,
+        tee: Optional[DataStore] = None,
+        queue_events: int = 1 << 18,
+        autostart: bool = True,
+    ):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.n = int(n_workers)
+        self.interner = interner if interner is not None else Interner()
+        self.config = config if config is not None else RuntimeConfig()
+        self.cluster = (
+            cluster if cluster is not None else ClusterInfo(self.interner)
+        )
+        self.window_s = window_s
+        self.window_ms = int(window_s * 1000)
+        self.on_batch = on_batch
+        self.batches: List[GraphBatch] = []
+        self.builder = GraphBuilder(window_s=window_s, renumber=renumber)
+        self.label_fn = label_fn
+        self.tee = tee
+
+        self.stores = [
+            ShardPartialStore(
+                self.window_ms,
+                # N == 1: the close wave deposits raw rows and the merge
+                # stage IS GraphBuilder.build — label_fn then applies at
+                # build time exactly like the serial store
+                label_fn=label_fn if self.n > 1 else None,
+                aggregate=self.n > 1,
+            )
+            for _ in range(self.n)
+        ]
+        self.workers = [
+            Aggregator(
+                self._worker_sink(self.stores[i]),
+                interner=self.interner,
+                config=self.config,
+                cluster=self.cluster,
+            )
+            for i in range(self.n)
+        ]
+        self._queues = [
+            BatchQueue(queue_events, f"shard{i}") for i in range(self.n)
+        ]
+
+        # progress plane: per-worker processed watermark, close-wave acks
+        # and the merged horizon, all published under one condition
+        self._wm_cond = threading.Condition()
+        self._worker_wm: List[Optional[int]] = [None] * self.n  # guarded-by: self._wm_cond
+        # scatters mid-flight: rows handed to process_l7 but not yet on
+        # every worker queue. While nonzero the idle-watermark close rule
+        # is suppressed — closing on "idle" workers whose slice of the
+        # current chunk hasn't landed yet would late-drop it.
+        self._inflight = 0  # guarded-by: self._wm_cond
+        self._wave_acks: Dict[int, int] = {}  # wave id → acks  # guarded-by: self._wm_cond
+        self._wave_seq = 0  # guarded-by: self._wm_cond
+        self._merged_upto = _W_FLOOR  # guarded-by: self._wm_cond
+        # serializes whole close waves (merge thread vs flush callers)
+        self._merge_lock = threading.Lock()
+        self.merge_s = 0.0  # merge-stage wall time (recombine+assemble)  # guarded-by: self._merge_lock
+        self.windows_merged = 0  # guarded-by: self._merge_lock
+
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        if autostart:
+            self.start()
+
+    def _worker_sink(self, store: ShardPartialStore) -> DataStore:
+        if self.tee is None:
+            return store
+        from alaz_tpu.runtime.service import FanoutDataStore
+
+        return FanoutDataStore([store, self.tee])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for i in range(self.n):
+            t = threading.Thread(
+                target=self._worker_loop, args=(i,), name=f"alaz-shard{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(
+            target=self._merger_loop, name="alaz-shard-merge", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for q in self._queues:
+            q.close()
+        with self._wm_cond:
+            self._wm_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def close(self) -> None:
+        self.stop()
+
+    # -- ingestion surface (Aggregator duck type) ----------------------------
+
+    def process_l7(self, events: np.ndarray, now_ns: Optional[int] = None) -> None:
+        """Partition an L7 batch by connection key and enqueue per-shard
+        slices. Asynchronous: returns before processing (the serial
+        Aggregator returns the emitted rows; callers needing per-batch
+        edge counts read the aggregated ``stats`` instead)."""
+        self._scatter("l7", events, now_ns)
+
+    def process_tcp(self, events: np.ndarray, now_ns: Optional[int] = None) -> None:
+        self._scatter("tcp", events, now_ns)
+
+    def process_proc(self, events: np.ndarray) -> None:
+        # proc exit tears down per-pid state on EVERY worker that may own
+        # one of the pid's connections — (pid, fd) sharding splits a
+        # pid's fds across workers, so the event broadcasts
+        self._broadcast("proc", events)
+
+    def process_k8s(self, msg: K8sResourceMessage) -> None:
+        # cluster state is shared (thread-safe _IpTable) — fold once,
+        # from the caller's thread, exactly like the serial engine
+        self.cluster.handle_msg(msg)
+        if self.tee is not None:
+            self.tee.persist_resource(msg.resource_type, msg.event_type, msg.object)
+
+    def gc(self, now_ns: Optional[int] = None) -> None:
+        """Housekeeping broadcast: each worker gc's its own aggregator ON
+        its own thread, so socket-line/h2 state is never mutated from the
+        housekeeping thread while a worker joins against it."""
+        self._broadcast("gc", now_ns)
+
+    def reap_zombies(self) -> None:
+        self._broadcast("reap", None)
+
+    def flush_retries(self, now_ns: int):
+        """Timer-driven retry flush, broadcast to the owning workers.
+        Returns None (retried rows surface through ``stats`` and the
+        merged windows, not a return value — the serial path's contract
+        of returning the rows cannot survive the queue hop)."""
+        self._broadcast("retries", now_ns)
+        return None
+
+    def _scatter(self, kind: str, events: np.ndarray, now_ns) -> None:
+        with self._wm_cond:
+            self._inflight += 1
+        try:
+            if self.n == 1:
+                self._queues[0].put(_QItem(kind, events, now_ns))
+                return
+            shard = (
+                _conn_keys(events["pid"], events["fd"]) % np.uint64(self.n)
+            ).astype(np.int64)
+            for i in range(self.n):
+                idx = np.flatnonzero(shard == i)
+                if idx.shape[0]:
+                    # ship (chunk, index) and let the WORKER extract its
+                    # slice: the 320-byte-record gather is a real copy,
+                    # and doing it here would serialize N copies on the
+                    # submitting thread
+                    self._queues[i].put(_QItem(kind, (events, idx), now_ns))
+        except QueueClosed:
+            pass  # racing a stop(): drop, like every closed-edge submit
+        finally:
+            with self._wm_cond:
+                self._inflight -= 1
+                self._wm_cond.notify_all()
+
+    def _broadcast(self, kind: str, payload) -> None:
+        for q in self._queues:
+            try:
+                q.put(_QItem(kind, payload, None))
+            except QueueClosed:
+                pass
+
+    # -- worker / merger loops -----------------------------------------------
+
+    def _worker_loop(self, i: int) -> None:
+        q = self._queues[i]
+        agg = self.workers[i]
+        store = self.stores[i]
+        last_wm: Optional[int] = None
+        while True:
+            item = q.get(timeout=0.1)
+            if item is None:
+                if self._stop.is_set() or q.closed:
+                    return
+                continue
+            kind, payload, now_ns = item.kind, item.payload, item.now_ns
+            try:
+                if kind == "l7":
+                    agg.process_l7(_shard_rows(payload), now_ns=now_ns)
+                elif kind == "tcp":
+                    agg.process_tcp(_shard_rows(payload), now_ns=now_ns)
+                elif kind == "close":
+                    wave, upto = payload
+                    try:
+                        store.close_upto(upto)
+                    finally:
+                        # the ack must flow even if aggregation raised —
+                        # a silent miss would strand the wave until stop
+                        with self._wm_cond:
+                            self._wave_acks[wave] = (
+                                self._wave_acks.get(wave, 0) + 1
+                            )
+                            self._wm_cond.notify_all()
+                elif kind == "proc":
+                    agg.process_proc(payload)
+                elif kind == "retries":
+                    agg.flush_retries(
+                        payload if payload is not None else time.time_ns()
+                    )
+                elif kind == "gc":
+                    agg.gc(payload)
+                elif kind == "reap":
+                    agg.reap_zombies()
+            except Exception as exc:  # keep the shard alive; mirror service workers
+                log.warning(f"shard{i} {kind} batch failed: {exc}")
+            finally:
+                q.task_done()
+            if kind in ("l7", "retries"):
+                wm = store.watermark
+                if wm is not None and wm != last_wm:
+                    last_wm = wm
+                    with self._wm_cond:
+                        self._worker_wm[i] = wm
+                        self._wm_cond.notify_all()
+
+    def _closable_locked(self) -> Optional[int]:
+        """Highest window id safe to close, or None. Caller holds
+        ``_wm_cond``. Workers with QUEUED work constrain the close (their
+        backlog may hold older windows): min over their processed
+        watermarks, the serial close rule taken shard-wise. Workers that
+        are idle (everything delivered is processed) do NOT hold the
+        horizon back — a shard whose connections simply went quiet must
+        not stall emission forever — so with every worker idle the rule
+        degenerates to max(watermark) - 1, exactly the serial store's.
+        Idle-based closes are suppressed while a scatter is mid-flight
+        (``_inflight``): an "idle" worker whose slice of the current
+        chunk hasn't been enqueued yet isn't idle, it's early. Rows a
+        quiet shard receives later for a closed window drop as late —
+        the same fate the serial path gives rows behind the watermark."""
+        busy: List[int] = []
+        idle: List[int] = []
+        for i in range(self.n):
+            wm = self._worker_wm[i]  # alazlint: disable=ALZ010 -- both callers hold self._wm_cond (documented caller-holds-lock helper; the lint pass is intra-function)
+            if self._queues[i].unfinished:
+                if wm is None:
+                    return None  # a worker with queued work hasn't started
+                busy.append(wm)
+            elif wm is not None:
+                idle.append(wm)
+        if busy:
+            return min(busy) - 1
+        if idle and not self._inflight:  # alazlint: disable=ALZ010 -- caller holds self._wm_cond, see above
+            return max(idle) - 1
+        return None
+
+    def _merger_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._wm_cond:
+                closable = self._closable_locked()
+                while (
+                    closable is None or closable <= self._merged_upto
+                ) and not self._stop.is_set():
+                    self._wm_cond.wait(0.2)
+                    closable = self._closable_locked()
+            if self._stop.is_set():
+                return
+            self._run_close_wave(closable)
+
+    def _run_close_wave(self, upto: Optional[int]) -> None:
+        """One full close wave: broadcast the close request, wait for
+        every worker's ack (each has aggregated its shard by then),
+        recombine + assemble + emit in window order. Serialized under
+        ``_merge_lock`` (merge thread vs flush callers), so emission
+        order is globally window-ascending."""
+        with self._merge_lock:
+            wave = self._start_wave()
+            self._broadcast("close", (wave, upto))
+            if not self._await_wave(wave):
+                return  # stopped mid-wave
+            t0 = time.perf_counter()
+            taken = [s.take_ready(upto) for s in self.stores]
+            windows = sorted(set().union(*[set(t) for t in taken]))
+            if windows:
+                horizon = windows[-1]
+                for s in self.stores:
+                    s.seal_upto(horizon)
+            for w in windows:
+                parts = [t[w] for t in taken if w in t]
+                if self.n == 1:
+                    # single shard: the serial builder path verbatim
+                    rows = parts[0]
+                    labels = (
+                        self.label_fn(rows) if self.label_fn is not None else None
+                    )
+                    batch = self.builder.build(
+                        rows,
+                        window_start_ms=w * self.window_ms,
+                        window_end_ms=(w + 1) * self.window_ms,
+                        edge_label=labels,
+                    )
+                else:
+                    batch = self.builder.build_from_partials(
+                        parts,
+                        window_start_ms=w * self.window_ms,
+                        window_end_ms=(w + 1) * self.window_ms,
+                    )
+                if self.on_batch is not None:
+                    self.on_batch(batch)
+                else:
+                    self.batches.append(batch)
+            self.merge_s += time.perf_counter() - t0
+            self.windows_merged += len(windows)
+        # advance the merged horizon to the WAVE's target even when no
+        # window had rows — otherwise an empty wave never moves it and
+        # the merger loop re-broadcasts the same close at full spin
+        target = upto
+        if windows and (target is None or windows[-1] > target):
+            target = windows[-1]
+        if target is not None:
+            with self._wm_cond:
+                if target > self._merged_upto:
+                    self._merged_upto = target
+
+    def _start_wave(self) -> int:
+        with self._wm_cond:
+            self._wave_seq += 1
+            wave = self._wave_seq
+            self._wave_acks[wave] = 0
+            return wave
+
+    def _await_wave(self, wave: int) -> bool:
+        with self._wm_cond:
+            while self._wave_acks.get(wave, 0) < self.n:
+                if self._stop.is_set():
+                    return False
+                self._wm_cond.wait(0.2)
+            del self._wave_acks[wave]
+            return True
+
+    # -- windowed-store surface ---------------------------------------------
+
+    def flush(self, timeout_s: float = 30.0) -> None:
+        """Close and merge every open window. The close requests queue
+        BEHIND all previously submitted batches, so no pre-drain is
+        needed — the wave ack means each worker has processed everything
+        that was in flight when flush was called (the serial store's
+        watermark-inclusive ``flush()`` semantics)."""
+        del timeout_s  # wave acks bound the wait; kept for API parity
+        self._run_close_wave(None)
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.unfinished == 0:
+                return True
+            time.sleep(0.002)
+        return False
+
+    @property
+    def unfinished(self) -> int:
+        return sum(q.unfinished for q in self._queues)
+
+    @property
+    def pending_retries(self) -> int:
+        return sum(a.pending_retries for a in self.workers)
+
+    @property
+    def request_count(self) -> int:
+        return sum(s.request_count for s in self.stores)
+
+    @property
+    def late_dropped(self) -> int:
+        return sum(s.late_dropped for s in self.stores)
+
+    @property
+    def last_persist_monotonic(self) -> Optional[float]:
+        stamps = [
+            s.last_persist_monotonic
+            for s in self.stores
+            if s.last_persist_monotonic is not None
+        ]
+        return max(stamps) if stamps else None
+
+    @property
+    def stats(self) -> AggregatorStats:
+        """Aggregated engine stats across the shard workers (a snapshot —
+        the summed object is fresh per read, not shared state)."""
+        total = AggregatorStats()
+        for a in self.workers:
+            for k, v in a.stats.as_dict().items():
+                setattr(total, k, getattr(total, k) + v)
+        return total

@@ -307,6 +307,13 @@ class RuntimeConfig:
     # stalled rows as late when they arrive — size this above the longest
     # stall worth riding out, not at the window length.
     idle_flush_grace_s: float = 30.0
+    # sharded host ingest (aggregator/sharded.py): >1 partitions L7/TCP
+    # traffic by connection key across this many shard workers with a
+    # merge thread recombining per-window partials — the serial
+    # Aggregator+WindowedGraphStore pair otherwise. Scaling is bounded
+    # by cores and the GIL-held fraction of process_l7 (ARCHITECTURE
+    # §3f); size to physical cores, not hyperthreads.
+    ingest_workers: int = 1
     # scorer backlog micro-batching: when >1 and the model is
     # window-independent (not tgn), up to this many ALREADY-QUEUED
     # same-bucket windows are stacked and scored through one vmapped
@@ -333,5 +340,6 @@ class RuntimeConfig:
             proc_root=env_str("PROC_ROOT", "/proc"),
             renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
+            ingest_workers=env_int("INGEST_WORKERS", 1),
             score_batch_windows=env_int("SCORE_BATCH_WINDOWS", 1),
         )

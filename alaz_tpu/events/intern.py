@@ -56,19 +56,22 @@ class Interner:
 
     def intern_many(self, strings: Iterable[str]) -> np.ndarray:
         """Batch intern: one dict probe per unique string outside the
-        lock, one lock acquisition total, one probe per unique MISS under
-        it (the race re-check the scalar path pays per string)."""
+        lock, ONE lock acquisition total (counters fold into the same
+        critical section as the miss resolution), one probe per unique
+        MISS under it (the race re-check the scalar path pays per
+        string). Single-acquisition matters under the sharded ingest
+        pool: N workers intern concurrently against this one table, and
+        a second counters-only acquisition per batch was measurable
+        contention there for zero information."""
         if not isinstance(strings, (list, tuple)):
             strings = list(strings)
         n = len(strings)
-        # counter updates take the lock: += on an instance attribute is a
-        # read-modify-write that loses increments under concurrent batch
-        # ingest (alazlint ALZ010 finding, fixed in ISSUE 2) — one
-        # uncontended acquisition per BATCH, noise next to the per-row work
-        with self._lock:
-            self.batch_calls += 1
-            self.batch_strings += n
         if n == 0:
+            # counters still advance: the perf smoke test reads them to
+            # prove the batch APIs carried the traffic (+= is a lost-
+            # update race off-lock — the ISSUE 2 ALZ010 finding)
+            with self._lock:
+                self.batch_calls += 1
             return np.zeros(0, dtype=np.int32)
         to_id = self._to_id  # alazlint: disable=ALZ010 -- lock-free resolve phase: GIL-atomic probes of an append-only dict; misses are re-checked under the lock below
         resolved: dict[str, int | None] = {}
@@ -76,8 +79,10 @@ class Interner:
             if s not in resolved:
                 resolved[s] = to_id.get(s)
         misses = [s for s, sid in resolved.items() if sid is None]
-        if misses:
-            with self._lock:
+        with self._lock:
+            self.batch_calls += 1
+            self.batch_strings += n
+            if misses:
                 table = self._strings
                 for s in misses:
                     sid = to_id.get(s)

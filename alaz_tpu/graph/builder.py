@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -304,6 +305,216 @@ def apply_renumber(
     return (perm[edge_src], perm[edge_dst]) + out_nodes
 
 
+# ---------------------------------------------------------------------------
+# Grouped reduction (the per-window argsort+reduceat stage). The numpy
+# implementation below is the fallback; when libalaz_ingest.so is loaded
+# the same reduction runs in C++ (native/ingest.cc alz_group_edges) —
+# stateless, so shard workers call it concurrently. Both produce groups
+# in ascending key order with bit-identical reductions for the
+# integer-valued float64 columns the builder feeds.
+# ---------------------------------------------------------------------------
+
+_native_grouping: Optional[bool] = None  # None = auto-detect on first use
+
+
+def set_native_grouping(enabled: Optional[bool]) -> None:
+    """Force the grouping backend: True = C++ (raises later if the .so is
+    missing — callers gate on native.available()), False = numpy,
+    None = auto-detect (the default)."""
+    global _native_grouping
+    _native_grouping = enabled
+
+
+def _use_native_grouping() -> bool:
+    global _native_grouping
+    if _native_grouping is None:
+        try:
+            from alaz_tpu.graph import native
+
+            _native_grouping = native.available()
+        except Exception:  # toolchain-less images: numpy serves
+            _native_grouping = False
+    return _native_grouping
+
+
+def pack_group_key(
+    src_slot: np.ndarray, dst_slot: np.ndarray, proto: np.ndarray
+) -> np.ndarray:
+    """DST-MAJOR (dst, src, proto) packing into one int64 sort key:
+    ascending key order is dst-sorted (the layout GraphBatch needs), src
+    keeps 28 bits (<2^28 slots), proto the low 4."""
+    return (
+        (dst_slot.astype(np.int64) << np.int64(32))
+        | (src_slot.astype(np.int64) << np.int64(4))
+        | (proto.astype(np.int64) & np.int64(0xF))
+    )
+
+
+def unpack_group_key(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(src_slot, dst_slot) halves of packed group keys. The protocol
+    nibble is NOT recovered here — callers take it from a representative
+    row so out-of-enum protocol bytes round-trip unclamped."""
+    src = ((keys >> np.int64(4)) & np.int64(0xFFFFFFF)).astype(np.int32)
+    dst = (keys >> np.int64(32)).astype(np.int32)
+    return src, dst
+
+
+def group_reduce(
+    keys: np.ndarray,
+    sum_cols: List[np.ndarray],
+    max_cols: List[np.ndarray],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, List[np.ndarray], List[np.ndarray]]:
+    """Group rows by int64 key: ``(uniq_keys, count, rep, sums, maxes)``
+    in ascending key order; ``rep`` is a representative row index per
+    group. Routes through the C++ core when loaded; the numpy
+    argsort+reduceat path is the fallback and the semantic reference."""
+    n = keys.shape[0]
+    if n and _use_native_grouping():
+        from alaz_tpu.graph import native
+
+        out = native.group_edges(keys, sum_cols, max_cols)
+        if out is not None:
+            return out
+    if n == 0:
+        empty = np.zeros(0, dtype=np.float64)
+        return (
+            np.zeros(0, dtype=np.int64), empty, np.zeros(0, dtype=np.int64),
+            [empty.copy() for _ in sum_cols], [empty.copy() for _ in max_cols],
+        )
+    # ONE argsort serves grouping AND every per-group statistic: group
+    # boundaries fall out of the sorted keys (what np.unique would have
+    # argsorted a second time), per-group sum/max run as reduceat over
+    # the sorted values. No stability requirement — any group member is
+    # a valid representative. Group order is ascending key, np.unique's.
+    order = np.argsort(keys)
+    sk = keys[order]
+    is_start = np.empty(n, dtype=bool)
+    is_start[0] = True
+    np.not_equal(sk[1:], sk[:-1], out=is_start[1:])
+    starts = np.flatnonzero(is_start)
+    count = (np.append(starts[1:], n) - starts).astype(np.float64)
+    sums = [np.add.reduceat(c[order], starts) for c in sum_cols]
+    maxes = [np.maximum.reduceat(c[order], starts) for c in max_cols]
+    return sk[starts], count, order[starts], sums, maxes
+
+
+@dataclass
+class EdgeAggregate:
+    """One window's aggregated edges, slot-keyed — what feature assembly
+    consumes. Produced either directly from REQUEST rows
+    (GraphBuilder.build) or by recombining shard-worker partials
+    (GraphBuilder.build_from_partials)."""
+
+    e_src: np.ndarray  # [E] int32 node slots
+    e_dst: np.ndarray  # [E] int32
+    e_type: np.ndarray  # [E] int32 protocol codes
+    count: np.ndarray  # [E] float64 (integer-valued)
+    lat_sum: np.ndarray  # [E] float64
+    lat_max: np.ndarray  # [E] float64
+    err5_sum: np.ndarray  # [E] float64
+    err4_sum: np.ndarray  # [E] float64
+    tls_sum: np.ndarray  # [E] float64
+    label_sum: Optional[np.ndarray] = None  # [E] float64
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.e_src.shape[0])
+
+
+@dataclass
+class EdgePartial:
+    """A shard worker's per-(window, chunk) partial aggregation, keyed by
+    UID (not slot: workers must not touch the shared NodeTable — slot
+    assignment happens once, in the merge stage). All reductions are
+    integer-valued float64, so merge-order changes cannot perturb them;
+    the merge recombines same-key partial edges with one reduceat pass."""
+
+    from_uid: np.ndarray  # [P] int32
+    to_uid: np.ndarray  # [P] int32
+    from_type: np.ndarray  # [P]
+    to_type: np.ndarray  # [P]
+    proto: np.ndarray  # [P] int32
+    count: np.ndarray  # [P] float64
+    lat_sum: np.ndarray  # [P] float64
+    lat_max: np.ndarray  # [P] float64
+    err5_sum: np.ndarray  # [P] float64
+    err4_sum: np.ndarray  # [P] float64
+    tls_sum: np.ndarray  # [P] float64
+    label_sum: Optional[np.ndarray]  # [P] float64
+    rows: int  # raw REQUEST rows folded in (conservation accounting)
+
+
+def _request_row_stats(rows: np.ndarray) -> tuple[np.ndarray, ...]:
+    """The per-row reduction inputs every aggregation path shares:
+    (lat, err5, err4, tls) as float64 columns of a REQUEST batch."""
+    lat = rows["latency_ns"].astype(np.float64)
+    status = rows["status_code"].astype(np.int64)
+    err5 = ((status >= 500) | (~rows["completed"])).astype(np.float64)
+    err4 = ((status >= 400) & (status < 500)).astype(np.float64)
+    tls = rows["tls"].astype(np.float64)
+    return lat, err5, err4, tls
+
+
+def aggregate_rows(
+    rows: np.ndarray,
+    src_slot: np.ndarray,
+    dst_slot: np.ndarray,
+    edge_label: Optional[np.ndarray] = None,
+) -> tuple[EdgeAggregate, np.ndarray]:
+    """REQUEST rows + slot columns → (EdgeAggregate, rep) via one grouped
+    reduction over the dst-major key."""
+    proto = rows["protocol"]
+    key = pack_group_key(src_slot, dst_slot, proto.astype(np.int64))
+    lat, err5, err4, tls = _request_row_stats(rows)
+    sum_cols = [lat, err5, err4, tls]
+    if edge_label is not None:
+        sum_cols.append(edge_label.astype(np.float64))
+    uniq, count, rep, sums, maxes = group_reduce(key, sum_cols, [lat])
+    e_src, e_dst = unpack_group_key(uniq)
+    agg = EdgeAggregate(
+        e_src=e_src,
+        e_dst=e_dst,
+        e_type=proto[rep].astype(np.int32),
+        count=count,
+        lat_sum=sums[0],
+        lat_max=maxes[0],
+        err5_sum=sums[1],
+        err4_sum=sums[2],
+        tls_sum=sums[3],
+        label_sum=sums[4] if edge_label is not None else None,
+    )
+    return agg, rep
+
+
+def partial_from_rows(
+    rows: np.ndarray,
+    local_nodes: NodeTable,
+    edge_label: Optional[np.ndarray] = None,
+) -> EdgePartial:
+    """A shard worker's thread-local aggregation of one chunk's window
+    rows: grouping runs against the worker's PRIVATE NodeTable (slots are
+    only a grouping aid here — the output is uid-keyed), so no shared
+    state is touched and workers aggregate fully in parallel."""
+    local_src = local_nodes.bulk_map(rows["from_uid"], rows["from_type"])
+    local_dst = local_nodes.bulk_map(rows["to_uid"], rows["to_type"])
+    agg, rep = aggregate_rows(rows, local_src, local_dst, edge_label)
+    return EdgePartial(
+        from_uid=rows["from_uid"][rep].astype(np.int32),
+        to_uid=rows["to_uid"][rep].astype(np.int32),
+        from_type=rows["from_type"][rep],
+        to_type=rows["to_type"][rep],
+        proto=agg.e_type,
+        count=agg.count,
+        lat_sum=agg.lat_sum,
+        lat_max=agg.lat_max,
+        err5_sum=agg.err5_sum,
+        err4_sum=agg.err4_sum,
+        tls_sum=agg.tls_sum,
+        label_sum=agg.label_sum,
+        rows=int(rows.shape[0]),
+    )
+
+
 class GraphBuilder:
     """Aggregates one window's REQUEST_DTYPE rows into a GraphBatch.
 
@@ -341,72 +552,83 @@ class GraphBuilder:
         """
         src_slot = self.nodes.bulk_map(rows["from_uid"], rows["from_type"])
         dst_slot = self.nodes.bulk_map(rows["to_uid"], rows["to_type"])
+        # DST-MAJOR key → grouped reduction (C++ when loaded, numpy
+        # argsort+reduceat otherwise): the aggregated edge list arrives
+        # already dst-sorted, so assembly skips the per-window stable sort
+        agg, _ = aggregate_rows(rows, src_slot, dst_slot, edge_label)
+        return self._assemble(agg, window_start_ms, window_end_ms)
 
-        proto = rows["protocol"].astype(np.int64)
-        # DST-MAJOR packing: ascending group order is then (dst, src,
-        # proto), so the aggregated edge list leaves this function
-        # already dst-sorted and GraphBatch.build skips its per-window
-        # stable argsort (sort_by_dst=False below). The final edge order
-        # is identical to sorting (src, dst, proto) groups by dst
-        # stably. src keeps 28 bits (<2^28 slots), same as the old
-        # src-major packing.
-        key = (
-            (dst_slot.astype(np.int64) << np.int64(32))
-            | (src_slot.astype(np.int64) << np.int64(4))
-            | (proto & np.int64(0xF))
+    def build_from_partials(
+        self,
+        partials: List[EdgePartial],
+        window_start_ms: int = 0,
+        window_end_ms: int = 0,
+    ) -> GraphBatch:
+        """Merge shard-worker partials into the window's GraphBatch: map
+        uids through the SHARED NodeTable (miss slots append in
+        ascending-uid order — the same assignment the single-thread path
+        makes for the same window row set), then recombine same-key
+        partial edges with one grouped-reduction pass (sum for
+        count/lat/err/tls/label, max for lat_max). Bit-identical to
+        ``build`` over the concatenated rows while per-window latency
+        sums stay integer-exact in float64 (< 2^53 ns ≈ 104 days)."""
+        from_uid = np.concatenate([p.from_uid for p in partials])
+        to_uid = np.concatenate([p.to_uid for p in partials])
+        from_type = np.concatenate([p.from_type for p in partials])
+        to_type = np.concatenate([p.to_type for p in partials])
+        proto = np.concatenate([p.proto for p in partials])
+        src_slot = self.nodes.bulk_map(from_uid, from_type)
+        dst_slot = self.nodes.bulk_map(to_uid, to_type)
+        key = pack_group_key(src_slot, dst_slot, proto.astype(np.int64))
+        has_label = bool(partials) and all(
+            p.label_sum is not None for p in partials
         )
-        lat = rows["latency_ns"].astype(np.float64)
-        n_rows = rows.shape[0]
-        # ONE argsort serves grouping AND every per-group statistic: group
-        # boundaries fall out of the sorted keys (what np.unique would
-        # have argsorted a second time), per-group max/sum run as
-        # reduceat over the sorted values. np.lexsort was measured ~5×
-        # an argsort at window scale — no multi-key sort anywhere here,
-        # and no stability requirement (any group member is a valid
-        # representative). Group order is ascending key, exactly
-        # np.unique's.
-        order = np.argsort(key)
-        sk = key[order]
-        is_start = np.empty(n_rows, dtype=bool)
-        if n_rows:
-            is_start[0] = True
-            np.not_equal(sk[1:], sk[:-1], out=is_start[1:])
-        group_of_sorted = np.cumsum(is_start) - 1
-        n_edges = int(group_of_sorted[-1]) + 1 if n_rows else 0
-        inverse = np.empty(n_rows, dtype=np.int64)
-        inverse[order] = group_of_sorted
-        starts = np.flatnonzero(is_start)
-
-        count = (np.append(starts[1:], n_rows) - starts).astype(np.float64)
-        lat_sorted = lat[order]
-        lat_sum = np.add.reduceat(lat_sorted, starts) if n_rows else np.zeros(0)
-        lat_max = np.maximum.reduceat(lat_sorted, starts) if n_rows else np.zeros(0)
-
-        status = rows["status_code"].astype(np.int64)
-        err5 = ((status >= 500) | (~rows["completed"])).astype(np.float64)
-        err4 = ((status >= 400) & (status < 500)).astype(np.float64)
-        err5_sum = np.bincount(inverse, weights=err5, minlength=n_edges)
-        err4_sum = np.bincount(inverse, weights=err4, minlength=n_edges)
-        tls_sum = np.bincount(
-            inverse, weights=rows["tls"].astype(np.float64), minlength=n_edges
+        sum_cols = [
+            np.concatenate([p.count for p in partials]),
+            np.concatenate([p.lat_sum for p in partials]),
+            np.concatenate([p.err5_sum for p in partials]),
+            np.concatenate([p.err4_sum for p in partials]),
+            np.concatenate([p.tls_sum for p in partials]),
+        ]
+        if has_label:
+            sum_cols.append(np.concatenate([p.label_sum for p in partials]))
+        max_cols = [np.concatenate([p.lat_max for p in partials])]
+        uniq, _, rep, sums, maxes = group_reduce(key, sum_cols, max_cols)
+        e_src, e_dst = unpack_group_key(uniq)
+        agg = EdgeAggregate(
+            e_src=e_src,
+            e_dst=e_dst,
+            e_type=proto[rep].astype(np.int32),
+            count=sums[0],
+            lat_sum=sums[1],
+            lat_max=maxes[0],
+            err5_sum=sums[2],
+            err4_sum=sums[3],
+            tls_sum=sums[4],
+            label_sum=sums[5] if has_label else None,
         )
+        return self._assemble(agg, window_start_ms, window_end_ms)
 
-        # any group member is a valid representative: src/dst slot and
-        # protocol are all encoded in the group key
-        rep = order[starts]
-        e_src = src_slot[rep].astype(np.int32)
-        e_dst = dst_slot[rep].astype(np.int32)
-        e_type = rows["protocol"][rep].astype(np.int32)
+    def _assemble(
+        self, agg: EdgeAggregate, window_start_ms: int, window_end_ms: int
+    ) -> GraphBatch:
+        """EdgeAggregate → GraphBatch: edge/node feature matrices, the
+        optional locality renumber, pad/bucket. The ONE feature-assembly
+        definition the direct and sharded-merge paths share — two copies
+        could drift."""
+        n_edges = agg.n_edges
+        e_src, e_dst, e_type = agg.e_src, agg.e_dst, agg.e_type
+        count = agg.count
 
         window_s = max(self.window_s, 1e-6)
-        mean_lat = lat_sum / np.maximum(count, 1.0)
+        mean_lat = agg.lat_sum / np.maximum(count, 1.0)
         ef = np.zeros((n_edges, EDGE_FEATURE_DIM), dtype=np.float32)
         ef[:, 0] = np.log1p(count)
         ef[:, 1] = np.log1p(mean_lat) / 20.0
-        ef[:, 2] = np.log1p(lat_max) / 20.0
-        ef[:, 3] = err5_sum / np.maximum(count, 1.0)
-        ef[:, 4] = err4_sum / np.maximum(count, 1.0)
-        ef[:, 5] = tls_sum / np.maximum(count, 1.0)
+        ef[:, 2] = np.log1p(agg.lat_max) / 20.0
+        ef[:, 3] = agg.err5_sum / np.maximum(count, 1.0)
+        ef[:, 4] = agg.err4_sum / np.maximum(count, 1.0)
+        ef[:, 5] = agg.tls_sum / np.maximum(count, 1.0)
         ef[:, 6] = np.log1p(count / window_s)
         # slots 7..15: protocol one-hot. Folding the edge-type embedding
         # into the edge features lets models learn type offsets through
@@ -417,24 +639,24 @@ class GraphBuilder:
         ef[np.arange(n_edges), 7 + proto_idx] = 1.0
 
         el = None
-        if edge_label is not None:
-            el = np.bincount(
-                inverse, weights=edge_label.astype(np.float64), minlength=n_edges
-            )
-            el = (el > 0).astype(np.float32)
+        if agg.label_sum is not None:
+            el = (agg.label_sum > 0).astype(np.float32)
 
         # -- node features ---------------------------------------------------
+        # Everything here derives from the EDGE aggregates (sums of sums
+        # of the per-row stats — exact, the inputs are integer-valued),
+        # so the sharded merge needs no row-level columns.
         n_nodes = len(self.nodes)
         node_type = self.nodes.types_array()
         nf = np.zeros((n_nodes, NODE_FEATURE_DIM), dtype=np.float32)
         for t in range(4):
             nf[:, t] = node_type == t
-        out_cnt = np.bincount(src_slot, minlength=n_nodes).astype(np.float64)
-        in_cnt = np.bincount(dst_slot, minlength=n_nodes).astype(np.float64)
-        out_err = np.bincount(src_slot, weights=err5, minlength=n_nodes)
-        in_err = np.bincount(dst_slot, weights=err5, minlength=n_nodes)
-        out_lat = np.bincount(src_slot, weights=lat, minlength=n_nodes)
-        in_lat = np.bincount(dst_slot, weights=lat, minlength=n_nodes)
+        out_cnt = np.bincount(e_src, weights=count, minlength=n_nodes)
+        in_cnt = np.bincount(e_dst, weights=count, minlength=n_nodes)
+        out_err = np.bincount(e_src, weights=agg.err5_sum, minlength=n_nodes)
+        in_err = np.bincount(e_dst, weights=agg.err5_sum, minlength=n_nodes)
+        out_lat = np.bincount(e_src, weights=agg.lat_sum, minlength=n_nodes)
+        in_lat = np.bincount(e_dst, weights=agg.lat_sum, minlength=n_nodes)
         out_deg = np.bincount(e_src, minlength=n_nodes).astype(np.float64)
         in_deg = np.bincount(e_dst, minlength=n_nodes).astype(np.float64)
         nf[:, 4] = np.log1p(out_cnt)

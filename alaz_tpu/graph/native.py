@@ -44,6 +44,75 @@ NATIVE_RECORD_DTYPE = np.dtype(
 
 _lib: Optional[ctypes.CDLL] = None
 
+# ---------------------------------------------------------------------------
+# Declarative export table — the single source both `_register` (ctypes
+# restype/argtypes) and tools/alazspec (`export_signatures`, pinned in the
+# golden wire table) read, so the binding and the spec can never drift
+# apart. Type vocabulary: ptr (void*), pptr (void**), i32/u32/i64/u64,
+# f32, cstr (const char*), void (no return).
+# ---------------------------------------------------------------------------
+
+NATIVE_EXPORTS: dict = {
+    "alz_create": ("ptr", ("i64", "u32", "u32", "u32")),
+    "alz_destroy": ("void", ("ptr",)),
+    "alz_push": ("u32", ("ptr", "ptr", "u32")),
+    "alz_drain": ("i64", ("ptr",)),
+    "alz_dropped": ("u64", ("ptr",)),
+    "alz_ring_dropped": ("u64", ("ptr",)),
+    "alz_late_dropped": ("u64", ("ptr",)),
+    "alz_acc_dropped": ("u64", ("ptr",)),
+    "alz_current_window": ("i64", ("ptr",)),
+    "alz_node_count": ("u32", ("ptr",)),
+    "alz_close_window": ("i32", ("ptr", "u32") + ("ptr",) * 10),
+    "alz_export_nodes": ("u32", ("ptr", "u32", "ptr", "ptr")),
+    "alz_current_edge_count": ("i64", ("ptr",)),
+    "alz_close_window_feats": (
+        "i32",
+        ("ptr", "u32", "u32", "ptr", "f32") + ("ptr",) * 6,
+    ),
+    "alz_group_edges": (
+        "i64",
+        ("ptr", "u64", "pptr", "u32", "pptr", "u32", "u64", "ptr", "ptr",
+         "ptr", "pptr", "pptr"),
+    ),
+    "alz_edge_feat_dim": ("u32", ()),
+    "alz_node_feat_dim": ("u32", ()),
+    "alz_abi_record_layout": ("cstr", ()),
+    "alz_source_hash": ("cstr", ()),
+}
+
+# The per-column meaning of alz_close_window's 10 output pointers and
+# alz_export_nodes' 2 — every aggregate column after window_start_ms must
+# be an EdgeSlot (resp. NodeSlot) field, which tools/alazspec cross-checks
+# against the parsed C structs so a renamed/dropped accumulator field
+# fails tier-1 instead of silently exporting garbage.
+CLOSE_WINDOW_COLUMNS = (
+    "window_start_ms", "src_slot", "dst_slot", "protocol", "count",
+    "lat_sum", "lat_max", "err5", "err4", "tls_cnt",
+)
+EXPORT_NODES_COLUMNS = ("uid", "type")
+
+_CTYPE_OF = {
+    "ptr": ctypes.c_void_p,
+    "pptr": ctypes.POINTER(ctypes.c_void_p),
+    "i32": ctypes.c_int32,
+    "u32": ctypes.c_uint32,
+    "i64": ctypes.c_int64,
+    "u64": ctypes.c_uint64,
+    "f32": ctypes.c_float,
+    "cstr": ctypes.c_char_p,
+    "void": None,
+}
+
+
+def export_signatures() -> dict:
+    """{export name: "ret(arg, ...)"} — the binding-side half of the
+    native-export contract tools/alazspec pins in the golden wire table."""
+    return {
+        name: f"{ret}({', '.join(args)})"
+        for name, (ret, args) in NATIVE_EXPORTS.items()
+    }
+
 
 def record_layout_string() -> str:
     """NATIVE_RECORD_DTYPE rendered in the shared layout-string format
@@ -96,40 +165,13 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def _register(lib: ctypes.CDLL) -> None:
-    lib.alz_create.restype = ctypes.c_void_p
-    lib.alz_create.argtypes = [ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32]
-    lib.alz_destroy.argtypes = [ctypes.c_void_p]
-    lib.alz_push.restype = ctypes.c_uint32
-    lib.alz_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
-    lib.alz_drain.restype = ctypes.c_int64
-    lib.alz_drain.argtypes = [ctypes.c_void_p]
-    lib.alz_dropped.restype = ctypes.c_uint64
-    lib.alz_dropped.argtypes = [ctypes.c_void_p]
-    lib.alz_ring_dropped.restype = ctypes.c_uint64
-    lib.alz_ring_dropped.argtypes = [ctypes.c_void_p]
-    lib.alz_late_dropped.restype = ctypes.c_uint64
-    lib.alz_late_dropped.argtypes = [ctypes.c_void_p]
-    lib.alz_acc_dropped.restype = ctypes.c_uint64
-    lib.alz_acc_dropped.argtypes = [ctypes.c_void_p]
-    lib.alz_current_window.restype = ctypes.c_int64
-    lib.alz_current_window.argtypes = [ctypes.c_void_p]
-    lib.alz_node_count.restype = ctypes.c_uint32
-    lib.alz_node_count.argtypes = [ctypes.c_void_p]
-    lib.alz_close_window.restype = ctypes.c_int32
-    lib.alz_close_window.argtypes = [ctypes.c_void_p, ctypes.c_uint32] + [ctypes.c_void_p] * 10
-    lib.alz_export_nodes.restype = ctypes.c_uint32
-    lib.alz_export_nodes.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p]
-    lib.alz_current_edge_count.restype = ctypes.c_int64
-    lib.alz_current_edge_count.argtypes = [ctypes.c_void_p]
-    lib.alz_close_window_feats.restype = ctypes.c_int32
-    lib.alz_close_window_feats.argtypes = [
-        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
-        ctypes.c_void_p, ctypes.c_float,
-    ] + [ctypes.c_void_p] * 6
-    lib.alz_edge_feat_dim.restype = ctypes.c_uint32
-    lib.alz_node_feat_dim.restype = ctypes.c_uint32
-    lib.alz_abi_record_layout.restype = ctypes.c_char_p
-    lib.alz_source_hash.restype = ctypes.c_char_p
+    # every export's restype/argtypes come from the declarative table —
+    # the same table alazspec pins in the golden wire table, so a binding
+    # edit without a `make specs` fails tier-1
+    for name, (ret, args) in NATIVE_EXPORTS.items():
+        fn = getattr(lib, name)  # AttributeError on a stale .so → fallback
+        fn.restype = _CTYPE_OF[ret]
+        fn.argtypes = [_CTYPE_OF[a] for a in args]
     # feature-layout contract: the C++ pass writes ef/nf rows with these
     # strides — a drifted constant would silently misalign every feature.
     # RuntimeError on purpose: _load's except clause swallows
@@ -157,6 +199,50 @@ def _register(lib: ctypes.CDLL) -> None:
 
 def available() -> bool:
     return _load() is not None
+
+
+def _ptr_array(arrays) -> "ctypes.Array":
+    """numpy float64 arrays → C `double*[]` (void** at the ctypes level)."""
+    return (ctypes.c_void_p * max(len(arrays), 1))(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays] or [None]
+    )
+
+
+def group_edges(keys, sum_cols, max_cols):
+    """Grouped reduction through the C++ core (``alz_group_edges``):
+    group rows by int64 key, per-group count + SUMs over ``sum_cols`` +
+    MAXes over ``max_cols``. Returns ``(uniq_keys, count, rep, sums,
+    maxes)`` in ascending key order, or None when the library is
+    unavailable (callers fall back to the numpy argsort+reduceat path —
+    graph/builder.group_reduce). Stateless and thread-safe: the sharded
+    ingest workers call it concurrently."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    n = keys.shape[0]
+    sc = [np.ascontiguousarray(c, dtype=np.float64) for c in sum_cols]
+    mc = [np.ascontiguousarray(c, dtype=np.float64) for c in max_cols]
+    out_keys = np.empty(n, dtype=np.int64)
+    out_count = np.empty(n, dtype=np.float64)
+    out_rep = np.empty(n, dtype=np.int64)
+    out_sums = [np.empty(n, dtype=np.float64) for _ in sc]
+    out_maxes = [np.empty(n, dtype=np.float64) for _ in mc]
+    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+    pptr = lambda arrs: ctypes.cast(_ptr_array(arrs), ctypes.POINTER(ctypes.c_void_p))  # noqa: E731
+    e = int(
+        lib.alz_group_edges(
+            ptr(keys), n, pptr(sc), len(sc), pptr(mc), len(mc), n,
+            ptr(out_keys), ptr(out_count), ptr(out_rep),
+            pptr(out_sums), pptr(out_maxes),
+        )
+    )
+    if e < 0:  # can't happen with out_cap == n; belt and braces
+        return None
+    return (
+        out_keys[:e], out_count[:e], out_rep[:e],
+        [s[:e] for s in out_sums], [m[:e] for m in out_maxes],
+    )
 
 
 _INT64_MIN = -(2**63)

@@ -18,6 +18,16 @@
 #include <unistd.h>
 #include <vector>
 
+// Source-stamp marker (the Makefile passes -DALZ_BIN_STAMP with the
+// sha256 prefix of agent_example.cc): executables can't be dlopen'd for
+// an alz_source_hash() call, so the alazspec staleness guard byte-scans
+// the binary for this marker instead (ROADMAP ALZ020 follow-up).
+#ifndef ALZ_BIN_STAMP
+#define ALZ_BIN_STAMP "unstamped"
+#endif
+__attribute__((used)) static const char kAlzSourceStamp[] =
+    "ALZ_SOURCE_STAMP:" ALZ_BIN_STAMP;
+
 struct AlzRecord {  // mirrors ingest.cc / NATIVE_RECORD_DTYPE (32 bytes)
   int64_t start_time_ms;
   uint64_t latency_ns;

@@ -19,6 +19,7 @@
 // alaz_tpu/graph/native.py; the pure-numpy GraphBuilder is the fallback).
 // `make tsan` additionally builds a -fsanitize=thread test binary.
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstddef>
@@ -591,6 +592,105 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
   if (acc->window_id() > ig->closed_upto) ig->closed_upto = acc->window_id();
   ig->release(acc);
   return static_cast<int32_t>(n);
+}
+
+// ---------------------------------------------------------------------------
+// Generic grouped reduction over packed int64 keys — the numpy builder's
+// per-window argsort+reduceat grouping stage, moved on-core (ROADMAP
+// "Ingest follow-ups"; graph/builder.py group_reduce routes here when the
+// .so is loaded, with the numpy path kept as the fallback). STATELESS on
+// purpose: no Ingest handle, no shared scratch — the sharded ingest
+// pipeline calls it concurrently from every shard worker for the
+// per-window partial aggregation AND from the merge stage for the
+// per-edge-key recombine.
+//
+// Inputs: keys[n]; n_sum double columns to per-group SUM; n_max double
+// columns to per-group MAX. Outputs (caller buffers, each sized out_cap
+// >= the group count — n always suffices): ascending unique keys (the
+// exact group order np.argsort produces), per-group row counts, a
+// representative row index per group (first-seen), and the reduced
+// columns. Sums are order-sensitive only for non-integer-valued doubles;
+// every column the builder feeds is integer-valued, so results are
+// bit-identical to the numpy reduceat path. Returns the group count, or
+// -1 when out_cap is too small.
+int64_t alz_group_edges(const int64_t* keys, uint64_t n,
+                        const double* const* sum_cols, uint32_t n_sum,
+                        const double* const* max_cols, uint32_t n_max,
+                        uint64_t out_cap, int64_t* out_keys, double* out_count,
+                        int64_t* out_rep, double* const* out_sums,
+                        double* const* out_maxes) {
+  if (n == 0) return 0;
+  // group ids live in uint32 — refuse inputs past 2^31 rows (window
+  // scale is orders of magnitude below; callers treat <0 as "use the
+  // numpy fallback", so the bound degrades gracefully, never hangs)
+  if (n > (1ull << 31)) return -1;
+  // Pass 1: open-addressing probe assigns a dense group id per distinct
+  // key and a per-row group index — O(n), no sort of the row stream.
+  // Pass 2 ranks the E distinct keys ascending (E log E over groups
+  // only) and accumulates every reduction straight into the caller's
+  // output buffers through the rank remap. The working set is
+  // E-proportional (the aggregated edge list), not n-proportional — the
+  // reason this beats sorting the full row stream at service-map
+  // compression ratios.
+  uint64_t cap = 64;
+  while (cap < 2 * n) cap <<= 1;
+  const uint64_t mask = cap - 1;
+  std::vector<uint32_t> index(cap, UINT32_MAX);
+  std::vector<int64_t> gkeys;
+  std::vector<int64_t> grep;
+  gkeys.reserve(1024);
+  grep.reserve(1024);
+  std::vector<uint32_t> ginv(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    const int64_t key = keys[i];
+    uint64_t h = mix64(static_cast<uint64_t>(key));
+    for (;; ++h) {
+      uint32_t& slot = index[h & mask];
+      if (slot == UINT32_MAX) {
+        slot = static_cast<uint32_t>(gkeys.size());
+        ginv[i] = slot;
+        gkeys.push_back(key);
+        grep.push_back(static_cast<int64_t>(i));
+        break;
+      }
+      if (gkeys[slot] == key) {
+        ginv[i] = slot;
+        break;
+      }
+    }
+  }
+  const uint64_t n_groups = gkeys.size();
+  if (n_groups > out_cap) return -1;
+
+  // rank groups by ascending key — the group order the numpy path's
+  // argsort produces, which is also the dst-major order the batcher needs
+  std::vector<uint32_t> order(n_groups);
+  for (uint32_t g = 0; g < n_groups; ++g) order[g] = g;
+  std::sort(order.begin(), order.end(),
+            [&gkeys](uint32_t x, uint32_t y) { return gkeys[x] < gkeys[y]; });
+  std::vector<uint32_t> rank(n_groups);
+  for (uint32_t o = 0; o < n_groups; ++o) {
+    const uint32_t g = order[o];
+    rank[g] = o;
+    out_keys[o] = gkeys[g];
+    out_rep[o] = grep[g];
+    out_count[o] = 0.0;
+  }
+  for (uint32_t c = 0; c < n_sum; ++c)
+    std::memset(out_sums[c], 0, n_groups * sizeof(double));
+
+  // pass 2: accumulate into the ranked outputs (E-sized, cache-warm)
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint32_t o = rank[ginv[i]];
+    out_count[o] += 1.0;
+    for (uint32_t c = 0; c < n_sum; ++c) out_sums[c][o] += sum_cols[c][i];
+    for (uint32_t c = 0; c < n_max; ++c) {
+      const double v = max_cols[c][i];
+      double& m = out_maxes[c][o];
+      if (out_count[o] == 1.0 || v > m) m = v;
+    }
+  }
+  return static_cast<int64_t>(n_groups);
 }
 
 uint32_t alz_export_nodes(void* p, uint32_t buf_cap, int32_t* uids, uint8_t* types) {

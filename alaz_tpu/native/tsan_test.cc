@@ -13,6 +13,17 @@
 #include <thread>
 #include <vector>
 
+// Source-stamp marker (the Makefile passes -DALZ_BIN_STAMP with the
+// sha256 prefix of ingest.cc + tsan_test.cc concatenated): the alazspec
+// staleness guard byte-scans the binary for it, so a tsan_test built
+// from a different ingest core than the one checked in is flagged
+// (ROADMAP ALZ020 follow-up).
+#ifndef ALZ_BIN_STAMP
+#define ALZ_BIN_STAMP "unstamped"
+#endif
+__attribute__((used)) static const char kAlzSourceStamp[] =
+    "ALZ_SOURCE_STAMP:" ALZ_BIN_STAMP;
+
 extern "C" {
 struct AlzRecord {
   int64_t start_time_ms;

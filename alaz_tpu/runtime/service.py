@@ -213,10 +213,17 @@ class Service:
                 "(cross-window slot-indexed memory); disable one of the two"
             )
         self.graph_store = None
+        self.sharded = None
+        ingest_workers = max(1, int(getattr(self.config, "ingest_workers", 1)))
         if use_native_ingest:
             from alaz_tpu.graph import native as native_mod
 
             if native_mod.available():
+                if ingest_workers > 1:
+                    log.warning(
+                        "ingest_workers > 1 ignored with use_native_ingest: "
+                        "the C++ window accumulator is its own ingest plane"
+                    )
                 self.graph_store = native_mod.NativeWindowedStore(
                     window_s=self.config.window_s,
                     on_batch=self._enqueue_window,
@@ -224,6 +231,23 @@ class Service:
                 )
             else:
                 log.warning("native ingest requested but library unavailable; using numpy store")
+        if self.graph_store is None and ingest_workers > 1:
+            # sharded multi-worker ingest (aggregator/sharded.py): the
+            # pipeline IS both the aggregator (ingestion surface) and
+            # the windowed store (flush/drop gauges) — one object plays
+            # both roles the serial pair splits
+            from alaz_tpu.aggregator.sharded import ShardedIngest
+
+            self.sharded = ShardedIngest(
+                ingest_workers,
+                interner=self.interner,
+                config=self.config,
+                window_s=self.config.window_s,
+                on_batch=self._enqueue_window,
+                renumber=renumber,
+                tee=export_backend,
+            )
+            self.graph_store = self.sharded
         if self.graph_store is None:
             self.graph_store = WindowedGraphStore(
                 self.interner,
@@ -231,13 +255,17 @@ class Service:
                 on_batch=self._enqueue_window,
                 renumber=renumber,
             )
-        sinks: List[DataStore] = [self.graph_store]
-        if export_backend is not None:
-            sinks.append(export_backend)
-        self.datastore = FanoutDataStore(sinks)
-        self.aggregator = Aggregator(
-            self.datastore, interner=self.interner, config=self.config
-        )
+        if self.sharded is not None:
+            self.datastore = None  # worker sinks fan out inside the pipeline
+            self.aggregator = self.sharded
+        else:
+            sinks: List[DataStore] = [self.graph_store]
+            if export_backend is not None:
+                sinks.append(export_backend)
+            self.datastore = FanoutDataStore(sinks)
+            self.aggregator = Aggregator(
+                self.datastore, interner=self.interner, config=self.config
+            )
 
         self.score_sink = score_sink
         if self.score_sink is None and export_backend is not None and hasattr(export_backend, "persist_scores"):
@@ -306,6 +334,14 @@ class Service:
         self.metrics.gauge(
             "ingest.acc_dropped", lambda: getattr(self.graph_store, "acc_dropped", 0)
         )
+        # sharded path only: pool width, in-flight shard backlog and the
+        # merge-stage share of the pipeline (ARCHITECTURE §3f)
+        if self.sharded is not None:
+            self.metrics.gauge("ingest.workers", lambda: self.sharded.n)
+            self.metrics.gauge(
+                "ingest.shard_unfinished", lambda: self.sharded.unfinished
+            )
+            self.metrics.gauge("ingest.merge_s", lambda: self.sharded.merge_s)
         # the TPU analog of the NVML gpu_utz gauge: fraction of wall time
         # the scorer spends in device compute (includes host→device feed)
         self._scorer_busy_s = 0.0
@@ -374,7 +410,18 @@ class Service:
     def _l7_worker(self) -> None:
         def handle(batch):
             out = self.aggregator.process_l7(batch)
-            self.metrics.counter("edges.out").inc(int(out.shape[0]))
+            if out is not None:
+                self.metrics.counter("edges.out").inc(int(out.shape[0]))
+            elif self.sharded is not None:
+                # sharded pipeline processes async and returns None —
+                # converge the counter onto the pipeline's authoritative
+                # emitted total so edges.out dashboards keep reading the
+                # truth (lag: at most the in-flight shard backlog). Only
+                # THIS thread syncs it, so the read-inc pair can't race.
+                c = self.metrics.counter("edges.out")
+                delta = self.sharded.stats.edges_out - c.value
+                if delta > 0:
+                    c.inc(delta)
 
         self._consume(self.l7_queue, handle)
 
@@ -684,6 +731,11 @@ class Service:
         )
         while time.monotonic() < deadline:
             if all(q.unfinished == 0 for q in queues):
+                # the sharded pipeline has its own in-flight queues
+                # behind the service queues; they must drain too
+                if getattr(self.aggregator, "unfinished", 0):
+                    time.sleep(0.02)
+                    continue
                 if self.aggregator.pending_retries == 0:
                     return
                 # flush due retries so the final window sees them; not-due
@@ -706,4 +758,6 @@ class Service:
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
+        if self.sharded is not None:
+            self.sharded.stop()
         log.info(f"service stopped; metrics={self.metrics.snapshot()}")

@@ -334,6 +334,31 @@ def bench_ingest(args) -> dict:
         edges = sum(b.n_edges for b in closed)
         return dt, len(closed), edges
 
+    def run_once_sharded(n: int) -> tuple[float, int, int, float]:
+        """One sharded-pipeline pass (aggregator/sharded.py): same trace,
+        same chunking, N shard workers + merge thread. Returns
+        (wall, windows, edges, merge-stage share of wall)."""
+        from alaz_tpu.aggregator.sharded import ShardedIngest
+
+        interner = Interner()
+        closed = []
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            n, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, queue_events=1 << 20,
+        )
+        t0 = time.perf_counter()
+        for i in range(0, n_rows, chunk):
+            pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        pipe.flush()
+        dt = time.perf_counter() - t0
+        merge_share = pipe.merge_s / dt if dt > 0 else 0.0
+        pipe.stop()
+        edges = sum(b.n_edges for b in closed)
+        return dt, len(closed), edges, merge_share
+
     # the host path must never touch XLA: any compile during ingest is a
     # retrace regression (a jit leaking into the hot loop), so the
     # sanitizer's compile hook rides along and its count lands in the
@@ -349,15 +374,53 @@ def bench_ingest(args) -> dict:
 
     # no warm-up run: every run_once builds fresh state, and best-of-N
     # already absorbs cold-start effects
+    def measure():
+        """(dt, windows, edges[, merge_share]) best-of-repeats for the
+        serial path and, with --workers, for each pool width up to it —
+        the worker_scaling curve the acceptance protocol records."""
+        repeats = max(1, args.repeats)
+        best = min((run_once() for _ in range(repeats)), key=lambda r: r[0])
+        scaling = None
+        if args.workers >= 1:
+            widths = sorted({1, min(2, args.workers), args.workers})
+            per_n = {}
+            for n in widths:
+                b = min(
+                    (run_once_sharded(n) for _ in range(repeats)),
+                    key=lambda r: r[0],
+                )
+                per_n[n] = b
+                print(
+                    f"# ingest workers={n} rows={n_rows} windows_closed={b[1]} "
+                    f"agg_edges={b[2]} wall={b[0]*1e3:.1f}ms "
+                    f"merge_share={b[3]:.3f}",
+                    file=sys.stderr,
+                )
+            scaling = per_n
+        return best, scaling
+
     if compile_watcher is not None:
         with compile_watcher:
-            best = min(
-                (run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0]
-            )
+            best, scaling = measure()
     else:
-        best = min((run_once() for _ in range(max(1, args.repeats))), key=lambda r: r[0])
+        best, scaling = measure()
     dt, n_windows, n_edges = best
-    rows_per_s = n_rows / dt
+    serial_rows_per_s = n_rows / dt
+    rows_per_s = serial_rows_per_s
+    worker_scaling = None
+    if scaling is not None:
+        # the headline number is the requested pool width; the sub-dict
+        # carries the whole curve plus the serial reference
+        head = scaling[args.workers]
+        rows_per_s = n_rows / head[0]
+        dt, n_windows, n_edges = head[0], head[1], head[2]
+        worker_scaling = {
+            "serial_rows_per_sec": round(serial_rows_per_s),
+            "per_n_rows_per_sec": {
+                str(n): round(n_rows / b[0]) for n, b in scaling.items()
+            },
+            "merge_share": round(head[3], 4),
+        }
     print(
         f"# ingest rows={n_rows} windows_closed={n_windows} agg_edges={n_edges} "
         f"wall={dt*1e3:.1f}ms",
@@ -374,7 +437,7 @@ def bench_ingest(args) -> dict:
         abi_findings = -1
 
     metric, unit = _metric_for(args)
-    return {
+    out = {
         "metric": metric,
         "value": round(rows_per_s),
         "unit": unit,
@@ -384,6 +447,10 @@ def bench_ingest(args) -> dict:
         "jit_compile_count": compile_watcher.total if compile_watcher else 0,
         "abi_findings": abi_findings,
     }
+    if worker_scaling is not None:
+        out["workers"] = args.workers
+        out["worker_scaling"] = worker_scaling
+    return out
 
 
 def bench_e2e(args) -> dict:
@@ -518,6 +585,8 @@ def _metric_for(args) -> tuple[str, str]:
         name = "ingest_rows_per_sec"
         if getattr(args, "ingest_scalar", False):
             name += "[scalar]"
+        if getattr(args, "workers", 0) >= 1:
+            name += f"[workers{args.workers}]"
         return name, "rows/s"
     if args.e2e:
         name = "e2e_ingest_to_score_rows_per_sec"
@@ -827,6 +896,11 @@ def main() -> None:
     p.add_argument("--ingest-scalar", action="store_true",
                    help="with --ingest: drive the pre-vectorization "
                         "_scalar_* reference paths (the A/B baseline)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="with --ingest: ALSO drive the sharded multi-worker "
+                        "pipeline at pool widths up to N (headline = N; the "
+                        "serial path and the per-N curve land in "
+                        "worker_scaling). 0 = serial only (old behavior)")
     p.add_argument("--e2e-batch", type=int, default=1,
                    help="micro-batch W same-bucket windows per dispatch "
                         "(vmap; per-window semantics preserved). Trades "

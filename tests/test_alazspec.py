@@ -199,6 +199,123 @@ class TestSpecRegeneration:
         assert "wire_layouts.json" in names
 
 
+class TestExportBufferContract:
+    """ISSUE 5 satellite: the EdgeSlot/NodeSlot export buffers and the
+    native export signatures are pinned — alz_close_window's 10-pointer
+    column contract included."""
+
+    def test_real_tree_export_buffers_clean(self):
+        findings = abirules.check_export_buffers()
+        assert findings == [], [f.render() for f in findings]
+
+    def test_golden_table_pins_the_export_surface(self):
+        golden = json.loads((SPECS / "wire_layouts.json").read_text())
+        from alaz_tpu.graph import native as gn
+
+        assert set(golden["cstructs"]) == {"AlzRecord", "EdgeSlot", "NodeSlot"}
+        for layout in golden["cstructs"].values():
+            assert layout != "MISSING"
+        assert golden["native_exports"] == gn.export_signatures()
+        assert "alz_group_edges" in golden["native_exports"]
+        cols = golden["native_export_columns"]["alz_close_window"]
+        assert len(cols) == 10 and cols[0] == "window_start_ms"
+        assert golden["native_export_columns"]["alz_export_nodes"] == [
+            "uid", "type",
+        ]
+
+    def test_renamed_edgeslot_field_is_flagged(self, tmp_path):
+        cc = tmp_path / "ingest.cc"
+        cc.write_text(
+            abirules.INGEST_CC.read_text().replace(
+                "uint64_t lat_sum;", "uint64_t latency_sum;", 1
+            )
+        )
+        findings = abirules.check_export_buffers(cc)
+        assert any(
+            f.code == "ALZ020" and "lat_sum" in f.message for f in findings
+        ), [f.render() for f in findings]
+
+    def test_doctored_golden_section_is_flagged(self, tmp_path):
+        golden = json.loads((SPECS / "wire_layouts.json").read_text())
+        golden["native_exports"]["alz_group_edges"] = "i64(ptr)"
+        work = tmp_path / "wire_layouts.json"
+        work.write_text(json.dumps(golden))
+        findings = abirules.check_wire_layouts(golden_path=work)
+        assert any(
+            f.code == "ALZ021" and "alz_group_edges" in f.message
+            for f in findings
+        ), [f.render() for f in findings]
+
+    def test_parsed_edgeslot_layout_is_sysv(self):
+        st = CSource(
+            abirules.INGEST_CC.read_text(), str(abirules.INGEST_CC)
+        ).struct("EdgeSlot")
+        offsets = {f.name: (f.offset, f.size) for f in st.fields}
+        # natural alignment: count starts on the first 8-byte boundary
+        # after the two slot words, struct rounds to 8
+        assert offsets["from_uid"] == (0, 4)
+        assert offsets["count"][0] % 8 == 0
+        assert st.size % 8 == 0
+
+
+class TestBinaryStamps:
+    """ISSUE 5 satellite (ROADMAP ALZ020 follow-up): the staleness stamp
+    extends to the tsan/agent executables."""
+
+    def test_built_binaries_are_stamped_and_fresh(self):
+        native_dir = abirules.INGEST_CC.parent
+        checked = 0
+        for name, sources in abirules.BINARY_SOURCES.items():
+            path = native_dir / name
+            if not path.exists():
+                continue
+            checked += 1
+            stamp = abirules.binary_stamp(path)
+            assert stamp is not None and stamp != "unstamped", name
+            assert stamp == abirules.binary_source_hash(
+                [native_dir / s for s in sources]
+            ), f"{name} is stale — rebuild (make tsan / make agent)"
+        if checked == 0:
+            pytest.skip("no tsan/agent binaries built in this checkout")
+
+    def test_stale_stamp_is_flagged(self, tmp_path):
+        (tmp_path / "fake.cc").write_text("// source\n")
+        (tmp_path / "fake_bin").write_bytes(
+            b"\x7fELF junk ALZ_SOURCE_STAMP:0123456789abcdef tail"
+        )
+        findings = abirules.check_binary_stamps(
+            tmp_path, {"fake_bin": ("fake.cc",)}
+        )
+        assert [f.code for f in findings] == ["ALZ020"]
+        assert "rebuild" in findings[0].message
+
+    def test_fresh_stamp_is_clean(self, tmp_path):
+        (tmp_path / "fake.cc").write_text("// source\n")
+        want = abirules.binary_source_hash([tmp_path / "fake.cc"])
+        (tmp_path / "fake_bin").write_bytes(
+            b"prefix ALZ_SOURCE_STAMP:" + want.encode() + b" tail"
+        )
+        assert (
+            abirules.check_binary_stamps(tmp_path, {"fake_bin": ("fake.cc",)})
+            == []
+        )
+
+    def test_unstamped_binary_is_flagged(self, tmp_path):
+        (tmp_path / "fake.cc").write_text("// source\n")
+        (tmp_path / "fake_bin").write_bytes(b"no marker here")
+        findings = abirules.check_binary_stamps(
+            tmp_path, {"fake_bin": ("fake.cc",)}
+        )
+        assert len(findings) == 1 and "no source stamp" in findings[0].message
+
+    def test_missing_binary_is_skipped(self, tmp_path):
+        (tmp_path / "fake.cc").write_text("// source\n")
+        assert (
+            abirules.check_binary_stamps(tmp_path, {"fake_bin": ("fake.cc",)})
+            == []
+        )
+
+
 class TestStalenessGuard:
     def test_checked_in_source_matches_loaded_binary(self):
         if not _native_available():

@@ -1,0 +1,467 @@
+"""Sharded multi-worker ingest (ISSUE 5 tentpole): equivalence against
+the single-thread path, the grouped-reduction backends, and the merge
+bookkeeping.
+
+The headline property (the acceptance bar): for N ∈ {1, 2, 4}, driving a
+randomized L7 trace through the sharded pipeline produces GraphBatches
+IDENTICAL to the serial Aggregator + WindowedGraphStore pair — same
+windows, same edges, same counts, and bit-exact features — up to the two
+documented degrees of freedom (interner id numbering, which differs
+because workers intern concurrently, so comparison goes through the
+strings; and per-uid endpoint-type ties, which the traces here don't
+exercise).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bench import make_ingest_trace
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.aggregator.sharded import ShardedIngest
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.graph import builder as builder_mod
+from alaz_tpu.graph.builder import (
+    GraphBuilder,
+    NodeTable,
+    WindowedGraphStore,
+    group_reduce,
+    pack_group_key,
+    partial_from_rows,
+)
+
+
+def _run_serial(ev, msgs, n_rows, chunk=1 << 14):
+    interner = Interner()
+    closed = []
+    store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+    cluster = ClusterInfo(interner)
+    for m in msgs:
+        cluster.handle_msg(m)
+    agg = Aggregator(store, interner=interner, cluster=cluster)
+    for i in range(0, n_rows, chunk):
+        agg.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+    store.flush()
+    return interner, closed, agg
+
+
+def _run_sharded(ev, msgs, n_rows, n_workers, chunk=1 << 14):
+    interner = Interner()
+    closed = []
+    cluster = ClusterInfo(interner)
+    for m in msgs:
+        cluster.handle_msg(m)
+    pipe = ShardedIngest(
+        n_workers, interner=interner, cluster=cluster, window_s=1.0,
+        on_batch=closed.append,
+    )
+    try:
+        for i in range(0, n_rows, chunk):
+            pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        pipe.flush()
+    finally:
+        pipe.stop()
+    return interner, closed, pipe
+
+
+def _canonical(interner, batches):
+    """Window → sorted [(from_str, to_str, proto), features] — the
+    interner-numbering-independent view both paths must agree on."""
+    out = {}
+    for b in batches:
+        uids = b.node_uids
+        edges = []
+        for i in range(b.n_edges):
+            f = interner.lookup(int(uids[b.edge_src[i]]))
+            t = interner.lookup(int(uids[b.edge_dst[i]]))
+            edges.append(
+                ((f, t, int(b.edge_type[i])), b.edge_feats[i].tobytes())
+            )
+        assert b.window_start_ms not in out, "window emitted twice"
+        out[b.window_start_ms] = sorted(edges)
+    return out
+
+
+def _node_stats(interner, batches):
+    """Window → {uid string: (type, node feature row)} for masked nodes."""
+    out = {}
+    for b in batches:
+        nodes = {}
+        for s in range(b.n_nodes):
+            uid = interner.lookup(int(b.node_uids[s]))
+            nodes[uid] = (int(b.node_type[s]), b.node_feats[s].tobytes())
+        out[b.window_start_ms] = nodes
+    return out
+
+
+class TestShardedEquivalence:
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_matches_serial_path_exactly(self, n_workers):
+        n_rows = 40_000
+        ev, msgs = make_ingest_trace(n_rows, pods=80, svcs=12, windows=5, seed=3)
+        si, sb, _ = _run_serial(ev, msgs, n_rows)
+        pi, pb, pipe = _run_sharded(ev, msgs, n_rows, n_workers)
+        ref, got = _canonical(si, sb), _canonical(pi, pb)
+        assert set(got) == set(ref), "window partition differs"
+        for w in ref:
+            assert got[w] == ref[w], f"window {w} edges/features differ"
+        # node features (degree/error/latency rollups) agree too
+        ref_nodes, got_nodes = _node_stats(si, sb), _node_stats(pi, pb)
+        for w in ref_nodes:
+            assert got_nodes[w] == ref_nodes[w], f"window {w} node rows differ"
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_randomized_chunking_and_workers(self, seed):
+        """Chunk boundaries must not matter: random chunk splits through
+        3 workers equal the serial path over one big batch."""
+        rng = np.random.default_rng(seed)
+        n_rows = 15_000
+        ev, msgs = make_ingest_trace(
+            n_rows, pods=40, svcs=8, windows=3, seed=10 + seed
+        )
+        si, sb, _ = _run_serial(ev, msgs, n_rows, chunk=n_rows)
+        interner = Interner()
+        closed = []
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            3, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append,
+        )
+        try:
+            cuts = np.sort(rng.integers(0, n_rows, 6))
+            for lo, hi in zip(np.r_[0, cuts], np.r_[cuts, n_rows]):
+                if hi > lo:
+                    pipe.process_l7(ev[lo:hi], now_ns=10_000_000_000)
+            pipe.flush()
+        finally:
+            pipe.stop()
+        assert _canonical(interner, closed) == _canonical(si, sb)
+
+    def test_stats_and_row_accounting(self):
+        n_rows = 8_000
+        ev, msgs = make_ingest_trace(n_rows, pods=30, svcs=6, windows=3, seed=7)
+        _, sb, sagg = _run_serial(ev, msgs, n_rows)
+        _, pb, pipe = _run_sharded(ev, msgs, n_rows, 3)
+        agg_stats = pipe.stats.as_dict()
+        assert agg_stats == sagg.stats.as_dict()
+        assert pipe.request_count == sum(s.request_count for s in pipe.stores)
+        # every attributed row landed in exactly one emitted edge count
+        emitted = sum(
+            int(np.rint(np.expm1(b.edge_feats[: b.n_edges, 0])).sum())
+            for b in pb
+        )
+        assert emitted + pipe.late_dropped == pipe.request_count
+
+    def test_late_rows_drop_after_flush(self):
+        ev, msgs = make_ingest_trace(2_000, pods=10, svcs=4, windows=2, seed=1)
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(2, interner=interner, cluster=cluster, window_s=1.0)
+        try:
+            pipe.process_l7(ev, now_ns=10_000_000_000)
+            pipe.flush()
+            n_windows = len(pipe.batches)
+            assert n_windows >= 2
+            before = pipe.late_dropped
+            # rows for the flushed horizon must drop as late, not re-emit
+            pipe.process_l7(ev[:500], now_ns=10_000_000_000)
+            pipe.flush()
+            assert len(pipe.batches) == n_windows
+            assert pipe.late_dropped == before + 500
+        finally:
+            pipe.stop()
+
+    def test_rejects_zero_workers(self):
+        with pytest.raises(ValueError):
+            ShardedIngest(0)
+
+    def test_quiet_shard_does_not_stall_window_emission(self):
+        """Review regression: a shard whose connections go quiet after an
+        early window must not hold every later window open forever —
+        idle workers don't constrain the close horizon."""
+        import time as time_mod
+
+        from alaz_tpu.aggregator.engine import _conn_keys
+        from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE
+        from alaz_tpu.events.k8s import (
+            EventType, K8sResourceMessage, Pod, ResourceType, Service,
+        )
+        from alaz_tpu.events.net import ip_to_u32
+        from alaz_tpu.events.schema import HttpMethod, L7Protocol, make_l7_events
+
+        # two (pid, fd) pairs mapping to DIFFERENT shards of 2 (the
+        # shard key's low bits come from fd's golden-ratio mix, so scan fd)
+        pid_a = fd_a = pid_b = fd_b = None
+        for fd in range(3, 64):
+            s = int(
+                (
+                    _conn_keys(
+                        np.array([1000], np.uint64), np.array([fd], np.uint64)
+                    )
+                    % np.uint64(2)
+                )[0]
+            )
+            if s == 0 and pid_a is None:
+                pid_a, fd_a = 1000, fd
+            if s == 1 and pid_b is None:
+                pid_b, fd_b = 1000, fd
+            if pid_a is not None and pid_b is not None:
+                break
+        assert pid_a is not None and pid_b is not None
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        cluster.handle_msg(K8sResourceMessage(
+            ResourceType.POD, EventType.ADD,
+            Pod(uid="pod-x", name="px", ip="10.0.0.1"),
+        ))
+        cluster.handle_msg(K8sResourceMessage(
+            ResourceType.SERVICE, EventType.ADD,
+            Service(uid="svc-x", name="sx", cluster_ip="10.96.0.1"),
+        ))
+
+        def mk(pid, fd, window):
+            ev = make_l7_events(10)
+            ev["pid"], ev["fd"] = pid, fd
+            ev["write_time_ns"] = (window + 1) * 1_000_000_000 + 1
+            ev["protocol"] = L7Protocol.HTTP
+            ev["method"] = HttpMethod.GET
+            ev["status"] = 200
+            ev["saddr"] = ip_to_u32("10.0.0.1")
+            ev["daddr"] = ip_to_u32("10.96.0.1")
+            ev["sport"], ev["dport"] = 1000, 80
+            return ev
+
+        closed = []
+        pipe = ShardedIngest(
+            2, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append,
+        )
+        try:
+            # shard A sees only window 1, shard B advances through 1..4
+            pipe.process_l7(mk(pid_a, fd_a, 1), now_ns=10**10)
+            for w in (1, 2, 3, 4):
+                pipe.process_l7(mk(pid_b, fd_b, w), now_ns=10**10)
+            deadline = time_mod.monotonic() + 10
+            while time_mod.monotonic() < deadline and len(closed) < 3:
+                time_mod.sleep(0.02)
+            # windows 1..3 must emit WITHOUT a flush, quiet shard or not
+            assert len(closed) >= 3, [b.window_start_ms for b in closed]
+        finally:
+            pipe.stop()
+
+    def test_idle_merger_does_not_spin_close_waves(self):
+        """Review regression: a close wave that merges nothing must still
+        advance the merged horizon — otherwise the merger re-broadcasts
+        the same wave at full spin while traffic sits in one window."""
+        import time as time_mod
+
+        ev, msgs = make_ingest_trace(2_000, pods=10, svcs=4, windows=1, seed=4)
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(2, interner=interner, cluster=cluster, window_s=1.0)
+        try:
+            pipe.process_l7(ev, now_ns=10**10)
+            pipe.drain(timeout_s=10)
+            time_mod.sleep(1.0)  # idle: one open window, nothing closable
+            with pipe._wm_cond:
+                waves = pipe._wave_seq
+            assert waves < 50, f"merger spun {waves} close waves while idle"
+        finally:
+            pipe.stop()
+
+
+class TestGroupReduceBackends:
+    def _random_cols(self, rng, n):
+        keys = pack_group_key(
+            rng.integers(0, 50, n).astype(np.int64),
+            rng.integers(0, 60, n).astype(np.int64),
+            rng.integers(0, 9, n).astype(np.int64),
+        )
+        sums = [rng.integers(0, 10_000, n).astype(np.float64) for _ in range(3)]
+        maxes = [rng.integers(0, 10_000, n).astype(np.float64)]
+        return keys, sums, maxes
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_native_matches_numpy_fallback(self, seed):
+        from alaz_tpu.graph import native
+
+        if not native.available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        rng = np.random.default_rng(seed)
+        keys, sums, maxes = self._random_cols(rng, int(rng.integers(1, 5_000)))
+        try:
+            builder_mod.set_native_grouping(False)
+            ref = group_reduce(keys, sums, maxes)
+            builder_mod.set_native_grouping(True)
+            got = group_reduce(keys, sums, maxes)
+        finally:
+            builder_mod.set_native_grouping(None)
+        np.testing.assert_array_equal(got[0], ref[0])  # keys
+        np.testing.assert_array_equal(got[1], ref[1])  # counts
+        np.testing.assert_array_equal(keys[got[2]], keys[ref[2]])  # reps
+        for g, r in zip(got[3], ref[3]):
+            np.testing.assert_array_equal(g, r)
+        for g, r in zip(got[4], ref[4]):
+            np.testing.assert_array_equal(g, r)
+
+    def test_empty_input(self):
+        out = group_reduce(
+            np.zeros(0, np.int64), [np.zeros(0)], [np.zeros(0)]
+        )
+        assert out[0].shape == (0,) and out[1].shape == (0,)
+        assert out[3][0].shape == (0,) and out[4][0].shape == (0,)
+
+    def test_builder_identical_across_backends(self):
+        """GraphBuilder.build must be bit-identical with the C++ grouping
+        and the numpy fallback — the .so-absent degradation path."""
+        from alaz_tpu.datastore.dto import make_requests
+
+        if not _native_available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        rng = np.random.default_rng(0)
+        n = 5_000
+        rows = make_requests(n)
+        rows["start_time_ms"] = 500
+        rows["from_uid"] = rng.integers(1, 40, n)
+        rows["to_uid"] = rng.integers(40, 60, n)
+        rows["from_type"] = 1
+        rows["to_type"] = 2
+        rows["protocol"] = rng.integers(0, 9, n)
+        rows["latency_ns"] = rng.integers(100, 1_000_000, n)
+        rows["status_code"] = np.where(rng.random(n) < 0.1, 500, 200)
+        rows["completed"] = True
+        try:
+            builder_mod.set_native_grouping(False)
+            ref = GraphBuilder().build(rows)
+            builder_mod.set_native_grouping(True)
+            got = GraphBuilder().build(rows)
+        finally:
+            builder_mod.set_native_grouping(None)
+        for name in (
+            "edge_src", "edge_dst", "edge_type", "edge_feats",
+            "node_feats", "node_type",
+        ):
+            np.testing.assert_array_equal(
+                getattr(got, name), getattr(ref, name), err_msg=name
+            )
+
+
+def _native_available() -> bool:
+    from alaz_tpu.graph import native
+
+    return native.available()
+
+
+class TestMergeFromPartials:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_partial_merge_equals_direct_build(self, seed):
+        """The merge invariant in isolation: random REQUEST rows split
+        into random per-worker partitions, partial-aggregated with
+        private NodeTables, then merged — must equal build() over the
+        whole row set, bit for bit."""
+        from alaz_tpu.datastore.dto import make_requests
+
+        rng = np.random.default_rng(seed)
+        n = 4_000
+        rows = make_requests(n)
+        rows["start_time_ms"] = 250
+        rows["from_uid"] = rng.integers(1, 50, n)
+        rows["to_uid"] = rng.integers(50, 80, n)
+        rows["from_type"] = 1
+        rows["to_type"] = 2
+        rows["protocol"] = rng.integers(0, 9, n)
+        rows["latency_ns"] = rng.integers(100, 5_000_000, n)
+        rows["status_code"] = np.where(rng.random(n) < 0.2, 503, 200)
+        rows["completed"] = rng.random(n) < 0.95
+        rows["tls"] = rng.random(n) < 0.3
+
+        ref = GraphBuilder().build(rows)
+        shard = rng.integers(0, 3, n)
+        partials = [
+            partial_from_rows(rows[shard == i], NodeTable())
+            for i in range(3)
+            if (shard == i).any()
+        ]
+        got = GraphBuilder().build_from_partials(partials)
+        for name in (
+            "edge_src", "edge_dst", "edge_type", "edge_feats",
+            "node_feats", "node_type", "node_uids",
+        ):
+            np.testing.assert_array_equal(
+                getattr(got, name), getattr(ref, name), err_msg=name
+            )
+
+    def test_edge_labels_survive_the_merge(self):
+        from alaz_tpu.datastore.dto import make_requests
+
+        rng = np.random.default_rng(3)
+        n = 1_000
+        rows = make_requests(n)
+        rows["start_time_ms"] = 100
+        rows["from_uid"] = rng.integers(1, 10, n)
+        rows["to_uid"] = rng.integers(10, 15, n)
+        rows["from_type"], rows["to_type"] = 1, 2
+        rows["protocol"] = 1
+        rows["completed"] = True
+        labels = (rng.random(n) < 0.05).astype(np.float32)
+        ref = GraphBuilder().build(rows, edge_label=labels)
+        shard = rng.integers(0, 2, n)
+        partials = [
+            partial_from_rows(rows[shard == i], NodeTable(), labels[shard == i])
+            for i in range(2)
+        ]
+        got = GraphBuilder().build_from_partials(partials)
+        np.testing.assert_array_equal(got.edge_label, ref.edge_label)
+
+
+class TestServiceWiring:
+    def test_service_runs_sharded_pipeline(self):
+        from alaz_tpu.config import RuntimeConfig
+        from alaz_tpu.runtime.service import Service
+
+        ev, msgs = make_ingest_trace(4_000, pods=20, svcs=4, windows=3, seed=5)
+        svc = Service(config=RuntimeConfig(ingest_workers=2))
+        assert svc.sharded is not None and svc.aggregator is svc.sharded
+        svc.start()
+        try:
+            for m in msgs:
+                svc.submit_k8s(m)
+            for i in range(0, 4_000, 1_000):
+                svc.submit_l7(ev[i : i + 1_000])
+            svc.drain(timeout_s=20)
+            svc.flush_windows()
+            assert svc.sharded.request_count == 4_000
+            assert len(svc.sharded.stats.as_dict()) > 0
+            assert svc.metrics.counter("windows.closed").value >= 3
+        finally:
+            svc.stop()
+
+    def test_serial_config_keeps_serial_pair(self):
+        from alaz_tpu.config import RuntimeConfig
+        from alaz_tpu.runtime.service import Service
+
+        svc = Service(config=RuntimeConfig(ingest_workers=1))
+        assert svc.sharded is None
+        assert isinstance(svc.graph_store, WindowedGraphStore)
+
+
+class TestBenchSurface:
+    def test_metric_name_carries_worker_tag(self):
+        import argparse
+
+        from bench import _metric_for
+
+        args = argparse.Namespace(
+            ingest=True, ingest_scalar=False, workers=4, e2e=False
+        )
+        assert _metric_for(args) == ("ingest_rows_per_sec[workers4]", "rows/s")
+        args.workers = 0
+        assert _metric_for(args) == ("ingest_rows_per_sec", "rows/s")

@@ -153,6 +153,175 @@ def source_hash(cc_path: Path = INGEST_CC) -> str:
     return hashlib.sha256(cc_path.read_bytes()).hexdigest()[:16]
 
 
+# ---------------------------------------------------------------------------
+# ALZ020 (cont.) — EdgeSlot/NodeSlot export-buffer contract. The 10
+# pointer columns of alz_close_window (and the 2 of alz_export_nodes)
+# are EdgeSlot/NodeSlot fields marshalled column-wise; a renamed or
+# dropped accumulator field would silently export garbage through a
+# still-type-correct call, so the column lists declared next to the
+# ctypes binding are cross-checked against the PARSED C structs here and
+# pinned (with the full struct layouts + every export's signature) in
+# the golden wire table.
+# ---------------------------------------------------------------------------
+
+# close_window columns that are scalars about the window, not EdgeSlot
+# fields (the remaining 9 must each name an EdgeSlot field)
+_NON_SLOT_COLUMNS = {"window_start_ms"}
+
+
+def check_export_buffers(cc_path: Path = INGEST_CC) -> List[Finding]:
+    from alaz_tpu.graph import native as gn
+
+    out: List[Finding] = []
+    src = CSource(cc_path.read_text(), str(cc_path))
+    structs = {}
+    for name in ("EdgeSlot", "NodeSlot"):
+        st = src.struct(name)
+        if st is None:
+            out.append(
+                Finding(
+                    "ALZ020",
+                    f"struct {name} not found in ingest.cc — the export "
+                    "buffer contract has no C side to check",
+                    str(cc_path),
+                    1,
+                    0,
+                )
+            )
+        structs[name] = st
+
+    # the binding's argument list must carry exactly the declared columns
+    for export, columns in (
+        ("alz_close_window", gn.CLOSE_WINDOW_COLUMNS),
+        ("alz_export_nodes", gn.EXPORT_NODES_COLUMNS),
+    ):
+        ret, args = gn.NATIVE_EXPORTS[export]
+        n_ptr_cols = sum(1 for a in args if a == "ptr") - 1  # minus the handle
+        if n_ptr_cols != len(columns):
+            out.append(
+                Finding(
+                    "ALZ020",
+                    f"{export} binds {n_ptr_cols} output pointers but "
+                    f"declares {len(columns)} columns "
+                    f"({', '.join(columns)}) — graph/native.py's column "
+                    "contract is out of step with its own argtypes",
+                    str(REPO / "alaz_tpu" / "graph" / "native.py"),
+                    1,
+                    0,
+                )
+            )
+    edge = structs.get("EdgeSlot")
+    if edge is not None:
+        fields = {f.name for f in edge.fields}
+        for col in gn.CLOSE_WINDOW_COLUMNS:
+            if col in _NON_SLOT_COLUMNS:
+                continue
+            if col not in fields:
+                out.append(
+                    Finding(
+                        "ALZ020",
+                        f"alz_close_window column `{col}` is not an "
+                        "EdgeSlot field — the C export marshals struct "
+                        "fields column-wise, so this column would ship "
+                        "garbage",
+                        str(cc_path),
+                        edge.line,
+                        0,
+                    )
+                )
+    node = structs.get("NodeSlot")
+    if node is not None:
+        fields = {f.name for f in node.fields}
+        for col in gn.EXPORT_NODES_COLUMNS:
+            if col not in fields:
+                out.append(
+                    Finding(
+                        "ALZ020",
+                        f"alz_export_nodes column `{col}` is not a "
+                        "NodeSlot field",
+                        str(cc_path),
+                        node.line,
+                        0,
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ALZ020 (cont.) — executable source stamps. tsan_test/agent_example
+# can't be dlopen'd for an alz_source_hash() call, so their Makefile
+# recipes bake an "ALZ_SOURCE_STAMP:<16-hex>" marker into .rodata and
+# the guard byte-scans the binary (ROADMAP follow-up: a drifted
+# tsan_test/agent_example is flagged too).
+# ---------------------------------------------------------------------------
+
+_STAMP_RE = re.compile(rb"ALZ_SOURCE_STAMP:([0-9a-f]{16}|unstamped)")
+
+# binary name → the source files its Makefile hash covers, in recipe
+# order (`cat a b | sha256sum`)
+BINARY_SOURCES = {
+    "tsan_test": ("ingest.cc", "tsan_test.cc"),
+    "agent_example": ("agent_example.cc",),
+}
+
+
+def binary_stamp(path: Path) -> Optional[str]:
+    """The embedded source stamp of a built executable, 'unstamped' for
+    pre-stamping builds, or None when no marker exists at all."""
+    m = _STAMP_RE.search(path.read_bytes())
+    return m.group(1).decode() if m else None
+
+
+def binary_source_hash(sources: Iterable[Path]) -> str:
+    """The Makefile's executable-stamp recipe: sha256 prefix of the
+    concatenated sources (cat order matters)."""
+    h = hashlib.sha256()
+    for s in sources:
+        h.update(Path(s).read_bytes())
+    return h.hexdigest()[:16]
+
+
+def check_binary_stamps(
+    native_dir: Optional[Path] = None,
+    binaries: Optional[Dict[str, Tuple[str, ...]]] = None,
+) -> List[Finding]:
+    """Flag tsan/agent executables built from different sources than the
+    ones on disk. Absent binaries → nothing to check (they are opt-in
+    build targets, not shipped artifacts)."""
+    native_dir = native_dir if native_dir is not None else INGEST_CC.parent
+    binaries = binaries if binaries is not None else BINARY_SOURCES
+    out: List[Finding] = []
+    for name, sources in binaries.items():
+        bin_path = native_dir / name
+        if not bin_path.exists():
+            continue
+        src_paths = [native_dir / s for s in sources]
+        if not all(p.exists() for p in src_paths):
+            continue
+        want = binary_source_hash(src_paths)
+        got = binary_stamp(bin_path)
+        if got == want:
+            continue
+        detail = (
+            "carries no source stamp (built before stamping, or out of "
+            "band)" if got in (None, "unstamped") else f"is stamped {got}"
+        )
+        rebuild = "make tsan" if name == "tsan_test" else "make agent"
+        out.append(
+            Finding(
+                "ALZ020",
+                f"{name} {detail}, but its sources "
+                f"({', '.join(sources)}) hash to {want} — rebuild with "
+                f"`{rebuild}` (in alaz_tpu/native) so the binary matches "
+                "the source the checks read",
+                str(bin_path),
+                1,
+                0,
+            )
+        )
+    return out
+
+
 def check_staleness(cc_path: Path = INGEST_CC) -> List[Finding]:
     """Flag a loadable libalaz_ingest.so built from a different ingest.cc
     than the one on disk (satellite: the stale-artifact guard). Absent or
@@ -215,6 +384,15 @@ def wire_layout_table() -> dict:
         for name, dt in schema.WIRE_DTYPES.items()
     }
     dtypes["NATIVE_RECORD_DTYPE"] = gn.record_layout_string()
+    # EdgeSlot/NodeSlot are not wire structs (they never cross a process
+    # boundary raw) but their layouts ARE the export-buffer contract the
+    # 10-pointer alz_close_window marshals column-wise — pin them, plus
+    # every native export's binding signature and the column lists
+    src = CSource(INGEST_CC.read_text(), str(INGEST_CC))
+    cstructs = {}
+    for name in ("AlzRecord", "EdgeSlot", "NodeSlot"):
+        st = src.struct(name)
+        cstructs[name] = st.layout_string() if st is not None else "MISSING"
     return {
         "frame": {
             "header_size": srv.FRAME_HEADER.size,
@@ -229,6 +407,12 @@ def wire_layout_table() -> dict:
             },
         },
         "dtypes": dtypes,
+        "cstructs": cstructs,
+        "native_exports": gn.export_signatures(),
+        "native_export_columns": {
+            "alz_close_window": list(gn.CLOSE_WINDOW_COLUMNS),
+            "alz_export_nodes": list(gn.EXPORT_NODES_COLUMNS),
+        },
     }
 
 
@@ -273,6 +457,55 @@ def check_wire_layouts(
                     0,
                 )
             )
+        # export-surface sections (ISSUE 5 satellite): EdgeSlot/NodeSlot
+        # layouts, export signatures, close/export column lists — drift
+        # on either side (C source, ctypes binding) vs the golden is a
+        # contract change that needs `make specs` in the same PR
+        for section, anchor in (
+            ("cstructs", INGEST_CC),
+            ("native_exports", REPO / "alaz_tpu" / "graph" / "native.py"),
+            (
+                "native_export_columns",
+                REPO / "alaz_tpu" / "graph" / "native.py",
+            ),
+        ):
+            live_sec = live.get(section, {})
+            gold_sec = golden.get(section)
+            if gold_sec is None:
+                out.append(
+                    Finding(
+                        "ALZ021",
+                        f"golden wire table has no `{section}` section — "
+                        "regenerate with `make specs`",
+                        str(golden_path),
+                        1,
+                        0,
+                    )
+                )
+                continue
+            if live_sec != gold_sec:
+                keys = sorted(
+                    set(live_sec).symmetric_difference(gold_sec)
+                    | {
+                        k
+                        for k in set(live_sec) & set(gold_sec)
+                        if live_sec[k] != gold_sec[k]
+                    }
+                )
+                k0 = keys[0] if keys else section
+                out.append(
+                    Finding(
+                        "ALZ021",
+                        f"native {section} contract drifted from the "
+                        f"golden wire table at `{k0}` (live "
+                        f"{live_sec.get(k0)!r} vs golden "
+                        f"{gold_sec.get(k0)!r}) — if intentional, "
+                        "regenerate with `make specs`",
+                        str(anchor),
+                        1,
+                        0,
+                    )
+                )
         live_dtypes = live["dtypes"]
     else:
         mod = _load_module(schema_path, "alazspec_schema_fixture")
@@ -541,8 +774,11 @@ def check_abi(
     tree; fixture paths are injected by the per-rule entry points."""
     findings = (
         check_record_abi(cc_path, check_binary=check_binary)
+        + check_export_buffers(cc_path)
         + check_wire_layouts()
         + check_enums(cc_path)
     )
+    if check_binary:
+        findings += check_binary_stamps(cc_path.parent)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings

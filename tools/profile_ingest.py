@@ -26,7 +26,16 @@ def main() -> None:
     p.add_argument("--top", type=int, default=20)
     p.add_argument("--sort", default="cumulative",
                    choices=["cumulative", "tottime", "ncalls"])
+    p.add_argument("--workers", type=int, default=0,
+                   help="0 = serial path under cProfile (old behavior); "
+                        "N >= 1 drives the sharded pipeline instead — the "
+                        "submit/merge threads are summarized with wall, "
+                        "merge-share and per-worker stats (cProfile is "
+                        "single-thread, so worker internals are profiled "
+                        "via the serial mode)")
     args = p.parse_args()
+
+    import time
 
     from bench import make_ingest_trace
     from alaz_tpu.aggregator.cluster import ClusterInfo
@@ -38,12 +47,41 @@ def main() -> None:
     ev, msgs = make_ingest_trace(n_rows, windows=8)
     interner = Interner()
     closed = []
-    store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
     cluster = ClusterInfo(interner)
     for m in msgs:
         cluster.handle_msg(m)
-    agg = Aggregator(store, interner=interner, cluster=cluster)
     chunk = 1 << 16
+
+    if args.workers >= 1:
+        from alaz_tpu.aggregator.sharded import ShardedIngest
+
+        pipe = ShardedIngest(
+            args.workers, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, queue_events=1 << 20,
+        )
+        t0 = time.perf_counter()
+        for i in range(0, n_rows, chunk):
+            pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        pipe.flush()
+        dt = time.perf_counter() - t0
+        print(
+            f"# rows={n_rows} workers={args.workers} "
+            f"windows_closed={len(closed)} "
+            f"agg_edges={sum(b.n_edges for b in closed)} "
+            f"rows_per_s={n_rows/dt:,.0f} wall={dt*1e3:.1f}ms "
+            f"merge_share={pipe.merge_s/dt:.3f}"
+        )
+        for i, store in enumerate(pipe.stores):
+            print(
+                f"#   shard{i}: rows={store.request_count} "
+                f"late_dropped={store.late_dropped}"
+            )
+        print(f"# engine stats: {pipe.stats.as_dict()}")
+        pipe.stop()
+        return
+
+    store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+    agg = Aggregator(store, interner=interner, cluster=cluster)
 
     def run() -> None:
         for i in range(0, n_rows, chunk):
