@@ -46,7 +46,22 @@ worker threads take a store lock OR the progress condition, never both
 at once; the merge path takes ``_merge_lock`` → worker-queue locks
 (close broadcast) → the progress condition (ack wait) → store locks
 (take_ready) → downstream emit locks — one direction only, a DAG by
-construction.
+construction. The supervision plane (ISSUE 6) adds ``_restart_lock`` →
+``_wm_cond`` (restart bookkeeping) and keeps re-drives/queue puts
+OUTSIDE the progress condition, so no reverse edge appears.
+
+Self-healing (ISSUE 6, ARCHITECTURE §3j): worker threads run under a
+supervisor shell — a crash (a chaos-injected ``WorkerCrash`` or an
+escaped bug) marks the worker dead and wakes the merge plane, which
+restarts the thread against the SAME queue/store/aggregator (none of
+that state is thread-affine) and, when a close wave was in flight,
+re-drives the close to the restarted worker so ``_await_wave`` can
+never wedge on an ack that will not come. Rows in flight on the dying
+thread are attributed to the shared :class:`DropLedger` (cause
+``dropped``); scatter backpressure past ``shed_block_s`` sheds to the
+ledger (cause ``shed``) instead of blocking the producer forever; late
+stragglers keep their ``late`` attribution. Conservation — pushed ==
+emitted + ledger total — is the chaos suite's checkable invariant.
 """
 
 from __future__ import annotations
@@ -71,9 +86,18 @@ from alaz_tpu.graph.builder import (
 )
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
+from alaz_tpu.utils.ledger import DropLedger
 from alaz_tpu.utils.queues import BatchQueue, QueueClosed
 
 log = get_logger("alaz_tpu.sharded")
+
+
+class WorkerCrash(BaseException):
+    """A worker thread's injected death (see alaz_tpu/chaos/injectors).
+
+    BaseException-derived so the per-item ``except Exception`` net that
+    keeps a shard alive through bad batches cannot absorb it — the
+    thread must actually die for the supervisor path to be real."""
 
 _W_FLOOR = -(2**62)  # "no window closed yet" sentinel (below any real id)
 
@@ -121,12 +145,21 @@ class ShardPartialStore(BaseDataStore):
     the ready shelf and the counters, which the merge thread also
     touches."""
 
-    def __init__(self, window_ms: int, label_fn=None, aggregate: bool = True):
+    def __init__(
+        self,
+        window_ms: int,
+        label_fn=None,
+        aggregate: bool = True,
+        ledger: Optional[DropLedger] = None,
+    ):
         self.window_ms = int(window_ms)
         self.label_fn = label_fn
         # False (the N==1 pool): deposit raw rows; the merge stage then
         # runs the serial GraphBuilder.build verbatim — no partial pass
         self.aggregate = aggregate
+        # shared pipeline-wide loss accounting (late stragglers land here
+        # in addition to the store-local counter)
+        self.ledger = ledger
         self._local_nodes = NodeTable()  # worker-thread-only grouping aid
         self._pending: Dict[int, List[np.ndarray]] = {}  # guarded-by: self._lock
         # closed-and-aggregated windows awaiting the merge thread:
@@ -163,10 +196,12 @@ class ShardPartialStore(BaseDataStore):
                 w = int(w)
                 if w <= self._closed_upto:
                     # stragglers for an already-closed window (the
-                    # aggregator retry path): drop, never re-emit
-                    self.late_dropped += (
-                        n if wmin == wmax else int((wids == w).sum())
-                    )
+                    # aggregator retry path, or chaos-delayed delivery):
+                    # drop, never re-emit
+                    k = n if wmin == wmax else int((wids == w).sum())
+                    self.late_dropped += k
+                    if self.ledger is not None:
+                        self.ledger.add("late", k)
                     continue
                 rows = batch.copy() if wmin == wmax else batch[wids == w]
                 self._pending.setdefault(w, []).append(rows)
@@ -178,17 +213,31 @@ class ShardPartialStore(BaseDataStore):
     def close_upto(self, upto: Optional[int]) -> None:
         """Pop every pending window ≤ ``upto`` (None = all), aggregate it
         on the calling (worker) thread, shelve the result for the merge
-        thread, and seal the horizon so later rows drop as late."""
+        thread, and seal the horizon so later rows drop as late.
+
+        Windows the sealed horizon ALREADY passed (``seal_upto`` ran
+        while this store still held their rows — only reachable through
+        a crash/restart interleave) are late-dropped here instead of
+        shelved: re-emitting a merged window would corrupt every
+        downstream consumer, losing attributed rows merely degrades."""
         with self._lock:
             if upto is None:
                 upto = max(self._pending, default=self._closed_upto)
                 if self._watermark is not None:
                     upto = max(upto, self._watermark)
+            floor = self._closed_upto
             popped = {w: ps for w, ps in self._pending.items() if w <= upto}
             for w in popped:
                 del self._pending[w]
+            stale_rows = 0
+            for w in [w for w in popped if w <= floor]:
+                stale_rows += sum(int(p.shape[0]) for p in popped.pop(w))
+            if stale_rows:
+                self.late_dropped += stale_rows
             if upto > self._closed_upto:
                 self._closed_upto = upto
+        if stale_rows and self.ledger is not None:
+            self.ledger.add("late", stale_rows, reason="sealed_horizon")
         # the grouped reduction runs OUTSIDE the lock: it is the heavy
         # stage, and it must overlap across worker threads
         done: List[tuple] = []
@@ -262,10 +311,24 @@ class ShardedIngest:
         tee: Optional[DataStore] = None,
         queue_events: int = 1 << 18,
         autostart: bool = True,
+        ledger: Optional[DropLedger] = None,
+        fault_hook: Optional[Callable[[int, str], None]] = None,
+        shed_block_s: float = 5.0,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
         self.n = int(n_workers)
+        # unified loss accounting (ISSUE 6): every row this pipeline
+        # loses lands in exactly one ledger cause — the conservation
+        # invariant the chaos suite checks
+        self.ledger = ledger if ledger is not None else DropLedger()
+        # chaos seam: called as fault_hook(worker_idx, kind) at item
+        # boundaries on the worker thread; may raise WorkerCrash or stall
+        self.fault_hook = fault_hook
+        # scatter backpressure bound: a producer blocks at most this long
+        # on a backlogged shard queue before the rows shed to the ledger
+        # (a stalled/dead worker must not wedge the submitting thread)
+        self.shed_block_s = float(shed_block_s)
         self.interner = interner if interner is not None else Interner()
         self.config = config if config is not None else RuntimeConfig()
         self.cluster = (
@@ -287,6 +350,7 @@ class ShardedIngest:
                 # build time exactly like the serial store
                 label_fn=label_fn if self.n > 1 else None,
                 aggregate=self.n > 1,
+                ledger=self.ledger,
             )
             for _ in range(self.n)
         ]
@@ -312,7 +376,13 @@ class ShardedIngest:
         # is suppressed — closing on "idle" workers whose slice of the
         # current chunk hasn't landed yet would late-drop it.
         self._inflight = 0  # guarded-by: self._wm_cond
-        self._wave_acks: Dict[int, int] = {}  # wave id → acks  # guarded-by: self._wm_cond
+        # wave id → set of worker indices that acked. A SET, not a
+        # count: a restarted worker sees both the original close item
+        # (queued behind its backlog) and the re-driven one — counting
+        # it twice would let a wave complete before some OTHER worker
+        # closed its shard, and the merge would seal rows that store
+        # still holds (the seed-0 duplicate-emission bug).
+        self._wave_acks: Dict[int, set] = {}  # guarded-by: self._wm_cond
         self._wave_seq = 0  # guarded-by: self._wm_cond
         self._merged_upto = _W_FLOOR  # guarded-by: self._wm_cond
         # serializes whole close waves (merge thread vs flush callers)
@@ -320,8 +390,23 @@ class ShardedIngest:
         self.merge_s = 0.0  # merge-stage wall time (recombine+assemble)  # guarded-by: self._merge_lock
         self.windows_merged = 0  # guarded-by: self._merge_lock
 
+        # supervision plane (ISSUE 6): per-worker thread handles so a
+        # dead worker can be restarted in place; _worker_dead is the
+        # dying thread's wake signal to anyone blocked on the condition
+        self._restart_lock = threading.Lock()
+        self._worker_threads: List[Optional[threading.Thread]] = []  # guarded-by: self._restart_lock
+        self._merge_thread: Optional[threading.Thread] = None  # guarded-by: self._restart_lock
+        self._worker_dead = [False] * self.n  # guarded-by: self._wm_cond
+        self._worker_restarts = 0  # guarded-by: self._restart_lock
+        # per-worker restart generation: close-wave re-drives key off
+        # "was worker i restarted since this wave began", NOT "did MY
+        # _supervise call do the restart" — the merger's supervision
+        # heartbeat races the wave-waiter's, and whoever loses that race
+        # must still re-drive (the original close died with the thread)
+        self._worker_gen = [0] * self.n  # guarded-by: self._restart_lock
+        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge
+
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
         if autostart:
             self.start()
 
@@ -335,34 +420,108 @@ class ShardedIngest:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._threads:
-            return
-        self._stop.clear()
-        for i in range(self.n):
+        with self._restart_lock:
+            if self._worker_threads or self._merge_thread is not None:
+                return
+            self._stop.clear()
+            for i in range(self.n):
+                t = threading.Thread(
+                    target=self._worker_main, args=(i,), name=f"alaz-shard{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._worker_threads.append(t)
             t = threading.Thread(
-                target=self._worker_loop, args=(i,), name=f"alaz-shard{i}",
-                daemon=True,
+                target=self._merger_loop, name="alaz-shard-merge", daemon=True
             )
             t.start()
-            self._threads.append(t)
-        t = threading.Thread(
-            target=self._merger_loop, name="alaz-shard-merge", daemon=True
-        )
-        t.start()
-        self._threads.append(t)
+            self._merge_thread = t
 
     def stop(self) -> None:
-        self._stop.set()
+        self._stop.set()  # BEFORE the snapshot: _supervise refuses
+        # restarts once set, so no thread can appear after we collect
         for q in self._queues:
             q.close()
         with self._wm_cond:
             self._wm_cond.notify_all()
-        for t in self._threads:
+        with self._restart_lock:
+            threads = [t for t in self._worker_threads if t is not None]
+            if self._merge_thread is not None:
+                threads.append(self._merge_thread)
+            self._worker_threads = []
+            self._merge_thread = None
+        for t in threads:
             t.join(timeout=5)
-        self._threads.clear()
 
     def close(self) -> None:
         self.stop()
+
+    # -- supervision (ISSUE 6) ----------------------------------------------
+
+    @property
+    def worker_restarts(self) -> int:
+        with self._restart_lock:
+            return self._worker_restarts
+
+    @property
+    def last_wave_age_s(self) -> float:
+        """Seconds since the last close wave completed its merge — the
+        gauge that makes a stalled merge thread visible."""
+        return time.monotonic() - self._last_wave_monotonic
+
+    def _worker_main(self, i: int) -> None:
+        """Supervisor shell around the worker loop: any escape — a chaos
+        WorkerCrash or a real bug — marks the worker dead and wakes the
+        merge plane (which restarts it) instead of leaving every future
+        wave to wedge on a silent missing ack."""
+        try:
+            self._worker_loop(i)
+            return  # clean shutdown path (stop/close)
+        except WorkerCrash:
+            log.warning(f"shard{i} worker killed (injected crash)")
+        except BaseException as exc:
+            log.error(f"shard{i} worker died: {exc!r}")
+        with self._wm_cond:
+            self._worker_dead[i] = True
+            self._wm_cond.notify_all()
+
+    def _supervise(self) -> List[int]:
+        """Restart every worker whose thread died; returns the restarted
+        indices so a waiting close wave can re-drive its close request.
+        The restarted thread resumes the SAME queue, store and private
+        aggregator — none of that state died with the thread — so the
+        shard's backlog (including any queued close items) drains in
+        order exactly as if the worker had merely stalled."""
+        restarted: List[int] = []
+        if self._stop.is_set():
+            return restarted
+        with self._restart_lock:
+            if not self._worker_threads:
+                return restarted  # never started / already stopped
+            for i in range(self.n):
+                t = self._worker_threads[i]
+                if t is None or t.is_alive():
+                    continue
+                self._worker_restarts += 1
+                self._worker_gen[i] += 1
+                with self._wm_cond:
+                    self._worker_dead[i] = False
+                nt = threading.Thread(
+                    target=self._worker_main, args=(i,),
+                    name=f"alaz-shard{i}r{self._worker_restarts}", daemon=True,
+                )
+                self._worker_threads[i] = nt
+                nt.start()
+                restarted.append(i)
+                log.warning(
+                    f"shard{i} worker restarted "
+                    f"(restart #{self._worker_restarts})"
+                )
+        return restarted
+
+    def _gen_snapshot(self) -> List[int]:
+        with self._restart_lock:
+            return list(self._worker_gen)
 
     # -- ingestion surface (Aggregator duck type) ----------------------------
 
@@ -406,12 +565,24 @@ class ShardedIngest:
         self._broadcast("retries", now_ns)
         return None
 
+    def _put_or_shed(self, i: int, item: _QItem) -> None:
+        """Bounded-backpressure enqueue: block at most ``shed_block_s``
+        on a backlogged shard queue, then SHED the rows to the ledger —
+        a stalled or dead worker must cost data (attributed), never
+        wedge the submitting thread (the drop-not-block contract, one
+        hop deeper)."""
+        if self._queues[i].put(item, timeout=self.shed_block_s):
+            return
+        n = len(item)
+        self.ledger.add("shed", n, reason=f"shard{i}_backlog")
+        log.warning(f"shard{i} backlogged past {self.shed_block_s}s; shed {n} rows")
+
     def _scatter(self, kind: str, events: np.ndarray, now_ns) -> None:
         with self._wm_cond:
             self._inflight += 1
         try:
             if self.n == 1:
-                self._queues[0].put(_QItem(kind, events, now_ns))
+                self._put_or_shed(0, _QItem(kind, events, now_ns))
                 return
             shard = (
                 _conn_keys(events["pid"], events["fd"]) % np.uint64(self.n)
@@ -423,7 +594,7 @@ class ShardedIngest:
                     # slice: the 320-byte-record gather is a real copy,
                     # and doing it here would serialize N copies on the
                     # submitting thread
-                    self._queues[i].put(_QItem(kind, (events, idx), now_ns))
+                    self._put_or_shed(i, _QItem(kind, (events, idx), now_ns))
         except QueueClosed:
             pass  # racing a stop(): drop, like every closed-edge submit
         finally:
@@ -432,9 +603,17 @@ class ShardedIngest:
                 self._wm_cond.notify_all()
 
     def _broadcast(self, kind: str, payload) -> None:
-        for q in self._queues:
+        """Control-plane broadcast (close/gc/proc/...): must DELIVER, so
+        it retries a full queue instead of shedding — but a queue stays
+        full forever only when its worker died, so each retry round
+        supervises (restarts dead workers) to unwedge itself."""
+        for i, q in enumerate(self._queues):
+            item = _QItem(kind, payload, None)
             try:
-                q.put(_QItem(kind, payload, None))
+                while not q.put(item, timeout=0.5):
+                    if self._stop.is_set():
+                        return
+                    self._supervise()
             except QueueClosed:
                 pass
 
@@ -453,6 +632,10 @@ class ShardedIngest:
                 continue
             kind, payload, now_ns = item.kind, item.payload, item.now_ns
             try:
+                if self.fault_hook is not None:
+                    # chaos seam: fires at the item boundary (all-or-
+                    # nothing row accounting), may raise WorkerCrash
+                    self.fault_hook(i, kind)
                 if kind == "l7":
                     agg.process_l7(_shard_rows(payload), now_ns=now_ns)
                 elif kind == "tcp":
@@ -463,11 +646,15 @@ class ShardedIngest:
                         store.close_upto(upto)
                     finally:
                         # the ack must flow even if aggregation raised —
-                        # a silent miss would strand the wave until stop
+                        # a silent miss would strand the wave until stop.
+                        # Membership-guarded: a straggler ack for a wave
+                        # that already completed (or timed out) must not
+                        # resurrect its entry. Per-worker set: a
+                        # restarted worker acking both the original and
+                        # the re-driven close counts ONCE.
                         with self._wm_cond:
-                            self._wave_acks[wave] = (
-                                self._wave_acks.get(wave, 0) + 1
-                            )
+                            if wave in self._wave_acks:
+                                self._wave_acks[wave].add(i)
                             self._wm_cond.notify_all()
                 elif kind == "proc":
                     agg.process_proc(payload)
@@ -479,6 +666,13 @@ class ShardedIngest:
                     agg.gc(payload)
                 elif kind == "reap":
                     agg.reap_zombies()
+            except WorkerCrash:
+                # the thread dies with this item in flight: attribute its
+                # rows before going (conservation survives the crash),
+                # then let the supervisor shell take over
+                if kind in ("l7", "tcp"):
+                    self.ledger.add("dropped", len(item), reason="worker_crash")
+                raise
             except Exception as exc:  # keep the shard alive; mirror service workers
                 log.warning(f"shard{i} {kind} batch failed: {exc}")
             finally:
@@ -525,26 +719,60 @@ class ShardedIngest:
         while not self._stop.is_set():
             with self._wm_cond:
                 closable = self._closable_locked()
-                while (
-                    closable is None or closable <= self._merged_upto
-                ) and not self._stop.is_set():
+                if closable is None or closable <= self._merged_upto:
                     self._wm_cond.wait(0.2)
                     closable = self._closable_locked()
+                ready = closable is not None and closable > self._merged_upto
             if self._stop.is_set():
                 return
-            self._run_close_wave(closable)
+            # supervision heartbeat: a worker that died outside any wave
+            # (mid-l7) would otherwise pin _closable_locked to None via
+            # its stale watermark + growing backlog, stalling every
+            # window silently — restart it here, wave or no wave
+            self._supervise()
+            if ready:
+                # bounded even on the merge thread: _await_wave self-
+                # heals dead workers, so the bound only trips on a
+                # pathological stall — in which case the merger must
+                # come back to supervise rather than wedge forever
+                self._run_close_wave(closable, timeout_s=60.0)
 
-    def _run_close_wave(self, upto: Optional[int]) -> None:
+    def _run_close_wave(
+        self, upto: Optional[int], timeout_s: Optional[float] = None
+    ) -> bool:
         """One full close wave: broadcast the close request, wait for
         every worker's ack (each has aggregated its shard by then),
         recombine + assemble + emit in window order. Serialized under
         ``_merge_lock`` (merge thread vs flush callers), so emission
-        order is globally window-ascending."""
-        with self._merge_lock:
+        order is globally window-ascending. With ``timeout_s`` the whole
+        wave — including the wait for a concurrent wave's lock — is
+        bounded: on expiry it returns False with shelved windows intact
+        (the next wave merges them); True once the merge ran."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        if timeout_s is None:
+            self._merge_lock.acquire()  # alazlint: disable=ALZ012 -- paired with the finally below; the timeout branch needs acquire(timeout=...) and `with` can't express it
+        elif not self._merge_lock.acquire(timeout=timeout_s):  # alazlint: disable=ALZ012 -- bounded acquire (a stalled merge must not wedge flush); released in the finally
+            log.error(
+                f"close wave: merge lock not free within {timeout_s}s "
+                "(stalled merge?); giving up this wave"
+            )
+            return False
+        try:
+            # restart-generation baseline BEFORE the broadcast: a worker
+            # restarted between here and the ack wait shows as a gen
+            # bump, and _await_wave re-drives its close regardless of
+            # WHICH thread's supervision performed the restart
+            gen0 = self._gen_snapshot()
             wave = self._start_wave()
             self._broadcast("close", (wave, upto))
-            if not self._await_wave(wave):
-                return  # stopped mid-wave
+            remaining = (
+                None if deadline is None
+                else max(deadline - time.monotonic(), 0.05)
+            )
+            if not self._await_wave(wave, upto, remaining, gen0):
+                return False  # stopped or timed out mid-wave
             t0 = time.perf_counter()
             taken = [s.take_ready(upto) for s in self.stores]
             windows = sorted(set().union(*[set(t) for t in taken]))
@@ -576,8 +804,11 @@ class ShardedIngest:
                     self.on_batch(batch)
                 else:
                     self.batches.append(batch)
-            self.merge_s += time.perf_counter() - t0
-            self.windows_merged += len(windows)
+            self.merge_s += time.perf_counter() - t0  # alazlint: disable=ALZ010 -- _merge_lock IS held here via the bounded acquire above (the lint only models `with` blocks)
+            self.windows_merged += len(windows)  # alazlint: disable=ALZ010 -- held via the bounded acquire above, see merge_s
+            self._last_wave_monotonic = time.monotonic()
+        finally:
+            self._merge_lock.release()
         # advance the merged horizon to the WAVE's target even when no
         # window had rows — otherwise an empty wave never moves it and
         # the merger loop re-broadcasts the same close at full spin
@@ -588,33 +819,104 @@ class ShardedIngest:
             with self._wm_cond:
                 if target > self._merged_upto:
                     self._merged_upto = target
+        return True
 
     def _start_wave(self) -> int:
         with self._wm_cond:
             self._wave_seq += 1
             wave = self._wave_seq
-            self._wave_acks[wave] = 0
+            self._wave_acks[wave] = set()
             return wave
 
-    def _await_wave(self, wave: int) -> bool:
-        with self._wm_cond:
-            while self._wave_acks.get(wave, 0) < self.n:
+    def _await_wave(
+        self,
+        wave: int,
+        upto: Optional[int],
+        timeout_s: Optional[float],
+        gen0: List[int],
+    ) -> bool:
+        """Wait for every worker's close ack, self-healing as it waits:
+        a worker that died can never ack, so each poll round restarts
+        dead workers and RE-DRIVES the close to any worker whose restart
+        GENERATION moved past the wave-start baseline ``gen0`` without
+        an ack — whichever thread's supervision actually performed the
+        restart (the merger heartbeat races this waiter; keying off "my
+        _supervise restarted it" loses that race and strands the wave).
+        The original close item died with the crashed thread or sits
+        behind a backlog the restarted thread drains first — a duplicate
+        close is idempotent: the store pops nothing new and the
+        straggler ack is a per-worker set entry. Returns False when
+        stopped or when ``timeout_s`` expires first."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        seen_gen = list(gen0)
+        while True:
+            with self._wm_cond:
+                if len(self._wave_acks.get(wave, ())) >= self.n:
+                    del self._wave_acks[wave]
+                    return True
                 if self._stop.is_set():
+                    self._wave_acks.pop(wave, None)
                     return False
                 self._wm_cond.wait(0.2)
-            del self._wave_acks[wave]
-            return True
+                if len(self._wave_acks.get(wave, ())) >= self.n:
+                    del self._wave_acks[wave]
+                    return True
+                acked = set(self._wave_acks.get(wave, ()))
+            if deadline is not None and time.monotonic() > deadline:
+                with self._wm_cond:
+                    self._wave_acks.pop(wave, None)
+                log.error(
+                    f"close wave {wave} timed out awaiting worker acks"
+                )
+                return False
+            # outside the condition (lock order: never queue-put under
+            # _wm_cond): restart the dead, re-drive restarted non-ackers
+            self._supervise()
+            cur = self._gen_snapshot()
+            for i in range(self.n):
+                if cur[i] != seen_gen[i] and i not in acked:
+                    if self._redrive_close(i, wave, upto, deadline):
+                        seen_gen[i] = cur[i]
+                    # on failure seen_gen stays: the next poll round
+                    # retries the re-drive (gen still differs)
+
+    def _redrive_close(
+        self, i: int, wave: int, upto: Optional[int], deadline: Optional[float]
+    ) -> bool:
+        """Bounded, self-healing re-drive: each retry round supervises
+        (the restarted worker may have crashed AGAIN with its queue at
+        capacity — without a restart nothing ever drains it) and the
+        wave's own deadline caps the whole attempt, so the merge thread
+        can degrade to a timed-out wave but never wedge here."""
+        item = _QItem("close", (wave, upto), None)
+        while not self._stop.is_set():
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            try:
+                if self._queues[i].put(item, timeout=0.5):
+                    return True
+            except QueueClosed:
+                return False
+            self._supervise()
+        return False
 
     # -- windowed-store surface ---------------------------------------------
 
-    def flush(self, timeout_s: float = 30.0) -> None:
+    def flush(self, timeout_s: float = 30.0) -> bool:
         """Close and merge every open window. The close requests queue
         BEHIND all previously submitted batches, so no pre-drain is
         needed — the wave ack means each worker has processed everything
         that was in flight when flush was called (the serial store's
-        watermark-inclusive ``flush()`` semantics)."""
-        del timeout_s  # wave acks bound the wait; kept for API parity
-        self._run_close_wave(None)
+        watermark-inclusive ``flush()`` semantics).
+
+        BOUNDED (ISSUE 6): returns within ~``timeout_s`` even with a
+        worker killed or stalled mid-wave — dead workers restart and the
+        close re-drives; a stall longer than the budget yields False
+        with all state intact (call again to finish). The regression
+        gate: flush/drain may degrade to False, never to a hang."""
+        return self._run_close_wave(None, timeout_s=timeout_s)
 
     def drain(self, timeout_s: float = 10.0) -> bool:
         deadline = time.monotonic() + timeout_s

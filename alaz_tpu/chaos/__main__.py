@@ -1,0 +1,51 @@
+"""CLI driver for the chaos suite — what ``make chaos`` runs.
+
+One JSON line per seed; exit 1 if any seed produced findings. The
+default seeds are the fixed acceptance set: every PR must keep them
+finding-free (wired into ``make test``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from alaz_tpu.chaos.harness import run_chaos_suite
+from alaz_tpu.config import ChaosConfig
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m alaz_tpu.chaos",
+        description="run the chaos suite (all four seams) over fixed seeds",
+    )
+    p.add_argument("--seeds", type=int, nargs="+", default=[0, 1, 2])
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--rows", type=int, default=48_000)
+    p.add_argument(
+        "--legs", nargs="+", default=["pipeline", "frames", "backend"],
+        choices=["pipeline", "frames", "backend"],
+    )
+    args = p.parse_args(argv)
+
+    failed = 0
+    for seed in args.seeds:
+        cfg = ChaosConfig(enabled=True, seed=seed)
+        rep = run_chaos_suite(
+            cfg,
+            n_workers=args.workers,
+            n_rows=args.rows,
+            legs=tuple(args.legs),
+        )
+        print(json.dumps(rep.as_dict(), sort_keys=True))
+        if not rep.ok:
+            failed += 1
+    if failed:
+        print(f"# {failed} seed(s) with findings", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,451 @@
+"""The chaos suite: drive the REAL pipeline through all four fault
+seams and check invariants, not vibes.
+
+Three legs, each wrapping production code with an injector from
+``chaos.injectors`` (nothing under test is mocked):
+
+1. **pipeline** — ``ShardedIngest`` fed a perturbed delivery (duplicated
+   / reordered / late batches) with crash+stall injection on the worker
+   threads and bounded-block shedding on the scatter. Gates:
+   - *bounded*: ``flush``/``drain`` return within their timeouts with
+     workers killed mid-run (the supervisor restarts them);
+   - *conservation*: delivered rows == emitted rows + drop-ledger total
+     (+ aggregator semantic drops, zero on this trace) — EXACT;
+   - *monotonic*: emitted windows strictly ascend; duplicate delivery
+     never re-emits a window;
+   - *self-healing*: injected crashes imply observed restarts.
+2. **frames** — a real ``IngestServer`` on a loopback socket fed
+   chaos-mutated wire frames over ONE connection. Gates: the connection
+   survives corruption (resync), every clean frame's rows arrive, and
+   accepted == sent − destroyed (exact when truncation is off — the
+   default — because only header/count corruption is then in play and
+   neither can eat a neighboring frame).
+3. **backend** — a ``BatchingBackend`` over a ``FlakyTransport``
+   (5xx + timeouts) on a fake clock. Gates: every appended row settles
+   as sent or failed (no row stuck or double-counted), the breaker
+   opens under sustained failure, and it closes again after ``heal()``.
+
+``run_chaos_suite`` returns a :class:`ChaosReport`; ``findings`` empty
+means every gate held. ``python -m alaz_tpu.chaos`` (= ``make chaos``)
+sweeps fixed seeds and exits nonzero on any finding; ``bench.py
+--ingest`` runs a short suite every round and reports
+``chaos_findings`` (expected 0) next to ``ingest_rows_per_sec``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.sharded import ShardedIngest
+from alaz_tpu.chaos.injectors import (
+    BatchChaos,
+    FlakyTransport,
+    FrameChaos,
+    WorkerChaos,
+)
+from alaz_tpu.config import BackendConfig, ChaosConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.logging import get_logger
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.utils.ledger import DropLedger
+
+log = get_logger("alaz_tpu.chaos")
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    n_workers: int
+    findings: List[str] = field(default_factory=list)
+    pipeline: dict = field(default_factory=dict)
+    frames: dict = field(default_factory=dict)
+    backend: dict = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def as_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "n_workers": self.n_workers,
+            "chaos_findings": len(self.findings),
+            "findings": self.findings,
+            "pipeline": self.pipeline,
+            "frames": self.frames,
+            "backend": self.backend,
+        }
+
+
+def emitted_rows(batches) -> int:
+    """Rows aggregated into emitted GraphBatches: edge feature 0 is
+    log1p(request count), so the inverse transform recovers the exact
+    integer row count per edge (the sanitize suite's accounting trick)."""
+    return sum(
+        int(np.rint(np.expm1(b.edge_feats[: b.n_edges, 0])).sum())
+        for b in batches
+    )
+
+
+def _run_pipeline_leg(
+    cfg: ChaosConfig,
+    n_workers: int,
+    n_rows: int,
+    n_windows: int,
+    findings: List[str],
+) -> dict:
+    ev, msgs = make_ingest_trace(
+        n_rows, pods=60, svcs=10, windows=n_windows, seed=cfg.seed
+    )
+    interner = Interner()
+    cluster = ClusterInfo(interner)
+    for m in msgs:
+        cluster.handle_msg(m)
+    ledger = DropLedger()
+    closed: List = []
+    wchaos = WorkerChaos(
+        seed=cfg.seed,
+        crash_prob=cfg.worker_crash_prob,
+        stall_prob=cfg.worker_stall_prob,
+        stall_s=cfg.worker_stall_s,
+        max_crashes=cfg.worker_max_crashes,
+        ensure_crash=True,  # ≥1 mid-wave kill per run, never vacuous
+    )
+    bchaos = BatchChaos(
+        seed=cfg.seed + 1,
+        dup_prob=cfg.batch_dup_prob,
+        reorder_prob=cfg.batch_reorder_prob,
+        late_prob=cfg.batch_late_prob,
+        min_each=True,  # every enabled delivery fault fires ≥ once
+    )
+    chunk = max(2048, n_rows // 32)
+    chunks = [ev[i : i + chunk] for i in range(0, n_rows, chunk)]
+    delivery, late = bchaos.perturb(chunks)
+    pipe = ShardedIngest(
+        n_workers,
+        interner=interner,
+        cluster=cluster,
+        window_s=1.0,
+        on_batch=closed.append,
+        ledger=ledger,
+        fault_hook=wchaos,
+        shed_block_s=0.5,
+    )
+    t0 = time.perf_counter()
+    try:
+        for c in delivery:
+            pipe.process_l7(c, now_ns=10_000_000_000)
+        tf = time.perf_counter()
+        if not pipe.flush(timeout_s=30.0):
+            findings.append("pipeline: flush #1 did not complete in 30s")
+        flush_wall = time.perf_counter() - tf
+        if flush_wall > 35.0:
+            findings.append(
+                f"pipeline: flush #1 overran its timeout ({flush_wall:.1f}s)"
+            )
+        # partial agent outage replay: the held-back batches arrive after
+        # the horizon sealed — every row must drop as LATE, none vanish
+        for c in late:
+            pipe.process_l7(c, now_ns=10_000_000_000)
+        if not pipe.flush(timeout_s=30.0):
+            findings.append("pipeline: flush #2 did not complete in 30s")
+        td = time.perf_counter()
+        if not pipe.drain(timeout_s=10.0):
+            findings.append("pipeline: drain did not settle in 10s")
+        drain_wall = time.perf_counter() - td
+        if drain_wall > 12.0:
+            findings.append(
+                f"pipeline: drain overran its timeout ({drain_wall:.1f}s)"
+            )
+        wall = time.perf_counter() - t0
+    finally:
+        pipe.stop()
+
+    delivered = int(sum(c.shape[0] for c in delivery)) + int(
+        sum(c.shape[0] for c in late)
+    )
+    emitted = emitted_rows(closed)
+    stats = pipe.stats.as_dict()
+    semantic = (
+        stats["l7_dropped_no_socket"]
+        + stats["l7_dropped_not_pod"]
+        + stats["l7_rate_limited"]
+    )
+    gap = ledger.conservation_gap(delivered, emitted + semantic)
+    if gap != 0:
+        findings.append(
+            f"pipeline: row conservation broken — delivered={delivered} "
+            f"emitted={emitted} semantic={semantic} "
+            f"ledger={ledger.snapshot()} gap={gap}"
+        )
+    starts = [b.window_start_ms for b in closed]
+    if any(b <= a for a, b in zip(starts, starts[1:])):
+        findings.append(
+            "pipeline: window emission not strictly ascending "
+            f"(duplicate or reordered emit): {starts}"
+        )
+    if wchaos.crashes > 0 and pipe.worker_restarts == 0:
+        findings.append(
+            f"pipeline: {wchaos.crashes} crashes injected but no worker restart observed"
+        )
+    if late and ledger.count("late") == 0:
+        findings.append(
+            "pipeline: late delivery injected but nothing ledgered as late"
+        )
+    return {
+        "delivered_rows": delivered,
+        "emitted_rows": emitted,
+        "windows": len(closed),
+        "rows_per_sec": round(delivered / wall) if wall > 0 else 0,
+        "flush_wall_s": round(flush_wall, 3),
+        "ledger": ledger.snapshot(),
+        "worker_restarts": pipe.worker_restarts,
+        "crashes": wchaos.crashes,
+        "stalls": wchaos.stalls,
+        "duplicated_batches": bchaos.duplicated,
+        "reordered_batches": bchaos.reordered,
+        "late_batches": bchaos.delayed,
+    }
+
+
+class _CountingSink:
+    """Minimal service duck-type for the frame leg: counts submitted
+    rows; no pipeline behind it (the pipeline leg covers that)."""
+
+    graph_store = None
+    metrics = None
+
+    def __init__(self, ledger: DropLedger):
+        self.ledger = ledger
+        self.rows = 0
+
+    def submit_l7(self, batch) -> bool:
+        self.rows += int(batch.shape[0])
+        return True
+
+    def submit_tcp(self, batch) -> bool:
+        return True
+
+    def submit_proc(self, batch) -> bool:
+        return True
+
+
+def _run_frame_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
+    from alaz_tpu.sources.ingest_server import KIND_L7, IngestServer, pack_frame
+
+    n_frames, rows_per_frame = 48, 256
+    ev, _ = make_ingest_trace(
+        n_frames * rows_per_frame, pods=20, svcs=4, windows=2, seed=cfg.seed
+    )
+    fchaos = FrameChaos(
+        seed=cfg.seed + 2,
+        corrupt_prob=cfg.frame_corrupt_prob,
+        truncate_prob=cfg.frame_truncate_prob,
+        garble_prob=cfg.frame_garble_prob,
+        min_each=True,
+        expect_frames=n_frames,
+    )
+    ledger = DropLedger()
+    sink = _CountingSink(ledger)
+    server = IngestServer(sink, port=0)
+    server.start()
+    try:
+        wire = b"".join(
+            fchaos.perturb(
+                pack_frame(KIND_L7, ev[k * rows_per_frame : (k + 1) * rows_per_frame]),
+                rows_per_frame,
+            )
+            for k in range(n_frames)
+        )
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.connect(server.address)
+        try:
+            s.sendall(wire)
+        finally:
+            s.close()
+        # one connection carried everything; wait for the serve thread to
+        # drain it (EOF after the last byte)
+        deadline = time.monotonic() + 10.0
+        sent_rows = n_frames * rows_per_frame
+        expect = sent_rows - fchaos.destroyed_rows
+        while time.monotonic() < deadline and sink.rows < expect:
+            time.sleep(0.02)
+    finally:
+        server.stop()
+
+    mutated = fchaos.corrupted + fchaos.garbled + fchaos.truncated
+    if fchaos.truncate_prob == 0.0:
+        # exact contract: header/count corruption destroys only its own
+        # frame — every clean frame survives the resyncs around it
+        if sink.rows != expect:
+            findings.append(
+                f"frames: accepted {sink.rows} rows, expected {expect} "
+                f"(sent {sent_rows}, destroyed {fchaos.destroyed_rows})"
+            )
+    elif sink.rows > expect:
+        findings.append(
+            f"frames: accepted {sink.rows} rows > conservable {expect}"
+        )
+    if mutated and server.quarantined_frames == 0:
+        findings.append(
+            f"frames: {mutated} frames mutated but none quarantined"
+        )
+    if fchaos.corrupted and server.resyncs == 0:
+        findings.append(
+            f"frames: {fchaos.corrupted} headers corrupted but no resync ran"
+        )
+    return {
+        "frames_sent": n_frames,
+        "rows_sent": sent_rows,
+        "rows_accepted": sink.rows,
+        "destroyed_rows": fchaos.destroyed_rows,
+        "corrupted": fchaos.corrupted,
+        "garbled": fchaos.garbled,
+        "truncated": fchaos.truncated,
+        "quarantined_frames": server.quarantined_frames,
+        "resyncs": server.resyncs,
+        "resync_bytes": server.resync_bytes,
+        "ledger": ledger.snapshot(),
+    }
+
+
+def _run_backend_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
+    from alaz_tpu.datastore.backend import BatchingBackend
+    from alaz_tpu.datastore.dto import make_requests
+
+    clock = [0.0]
+
+    def time_fn() -> float:
+        return clock[0]
+
+    def sleep_fn(s: float) -> None:
+        clock[0] += s
+
+    calls = [0]
+
+    def ok_transport(endpoint, payload) -> int:
+        calls[0] += 1
+        return 200
+
+    flaky = FlakyTransport(
+        ok_transport,
+        seed=cfg.seed + 3,
+        error_prob=cfg.backend_error_prob,
+        timeout_prob=cfg.backend_timeout_prob,
+    )
+    be = BatchingBackend(
+        flaky,
+        Interner(),
+        BackendConfig(
+            batch_size=40,
+            max_retries=1,
+            backoff_min_s=0.05,
+            backoff_max_s=0.2,
+            breaker_threshold=3,
+            breaker_cooldown_s=5.0,
+        ),
+        time_fn=time_fn,
+        sleep_fn=sleep_fn,
+    )
+    appended = 0
+    # phase 1 — DEGRADED: cfg-intensity flapping (some sends fail, some
+    # land; the breaker may or may not trip — either is legal here)
+    for _ in range(6):
+        be.persist_requests(make_requests(40))
+        appended += 40
+        be.pump(force=True)
+        sleep_fn(0.5)
+    # phase 2 — OUTAGE: the backend goes fully dark; the breaker MUST
+    # open within threshold sends and then short the rest (the failure
+    # cost becomes a counter bump, not retries×backoff per batch)
+    flaky.error_prob, flaky.timeout_prob = 1.0, 0.0
+    for _ in range(6):
+        be.persist_requests(make_requests(40))
+        appended += 40
+        be.pump(force=True)
+        sleep_fn(0.5)
+    if be.breaker.opens == 0:
+        findings.append("backend: full outage never opened the circuit breaker")
+    if be.breaker.shorted == 0:
+        findings.append("backend: open breaker never short-circuited a send")
+    # phase 3 — RECOVERY: faults off, cooldown elapses; the half-open
+    # probe must close the circuit and deliveries must resume
+    flaky.heal()
+    sleep_fn(6.0)
+    be.persist_requests(make_requests(40))
+    appended += 40
+    be.pump(force=True)
+    st = be.stats()
+    settled = st["requests"]["sent"] + st["requests"]["failed"]
+    if settled + st["requests"]["pending"] != appended:
+        findings.append(
+            f"backend: rows unaccounted — appended={appended} "
+            f"sent={st['requests']['sent']} failed={st['requests']['failed']} "
+            f"pending={st['requests']['pending']}"
+        )
+    if be.breaker.state != "closed":
+        findings.append(
+            f"backend: breaker stuck {be.breaker.state} after recovery"
+        )
+    return {
+        "appended_rows": appended,
+        "sent": st["requests"]["sent"],
+        "failed": st["requests"]["failed"],
+        "breaker_opens": be.breaker.opens,
+        "breaker_shorted": be.breaker.shorted,
+        "breaker_state": be.breaker.state,
+        "transport_errors": flaky.errors,
+        "transport_timeouts": flaky.timeouts,
+    }
+
+
+def run_chaos_suite(
+    cfg: Optional[ChaosConfig] = None,
+    *,
+    seed: Optional[int] = None,
+    n_workers: int = 2,
+    n_rows: int = 48_000,
+    n_windows: int = 5,
+    legs: tuple = ("pipeline", "frames", "backend"),
+) -> ChaosReport:
+    """One full chaos run at ``cfg`` intensity (default intensities with
+    ``seed`` when only a seed is given). Deterministic per (cfg, seed)
+    up to thread interleaving; the GATES hold for every interleaving.
+
+    ``cfg.enabled`` is honored: a disabled config zeroes every
+    intensity, so the same gates run over a CLEAN pipeline — conservation
+    with an all-zero ledger (what the no-chaos bench ride-along checks)."""
+    if cfg is None:
+        cfg = ChaosConfig(enabled=True, seed=seed if seed is not None else 0)
+    elif seed is not None:
+        # never mutate the caller's config object (it may be the live
+        # service's config.chaos, whose seed a soak consumer reads later)
+        cfg = dataclasses.replace(cfg, seed=seed)
+    if not cfg.enabled:
+        cfg = ChaosConfig(
+            seed=cfg.seed,
+            frame_corrupt_prob=0.0, frame_truncate_prob=0.0,
+            frame_garble_prob=0.0,
+            batch_dup_prob=0.0, batch_reorder_prob=0.0, batch_late_prob=0.0,
+            worker_crash_prob=0.0, worker_stall_prob=0.0,
+            backend_error_prob=0.0, backend_timeout_prob=0.0,
+        )
+    report = ChaosReport(seed=cfg.seed, n_workers=n_workers)
+    if "pipeline" in legs:
+        report.pipeline = _run_pipeline_leg(
+            cfg, n_workers, n_rows, n_windows, report.findings
+        )
+    if "frames" in legs:
+        report.frames = _run_frame_leg(cfg, report.findings)
+    if "backend" in legs:
+        report.backend = _run_backend_leg(cfg, report.findings)
+    for f in report.findings:
+        log.warning(f"chaos finding: {f}")
+    return report

@@ -115,6 +115,13 @@ class BackendConfig:
     timeout_s: float = 10.0
     metrics_export: bool = False
     metrics_export_interval_s: float = 10.0
+    # circuit breaker on the send path (datastore/backend.py, ISSUE 6):
+    # this many CONSECUTIVE failed sends (retry ladders included) open
+    # the circuit; sends then shed instantly until a cooldown-gated
+    # half-open probe succeeds. Sized so one flaky batch never trips it
+    # but a down backend trips within seconds at default cadence.
+    breaker_threshold: int = 5
+    breaker_cooldown_s: float = 30.0
 
     @classmethod
     def from_env(cls) -> "BackendConfig":
@@ -124,6 +131,8 @@ class BackendConfig:
             node_id=env_str("NODE_NAME", "node-0"),
             batch_size=env_int("BATCH_SIZE", 1_000),
             metrics_export=env_bool("METRICS_ENABLED", False),
+            breaker_threshold=env_int("BREAKER_THRESHOLD", 5),
+            breaker_cooldown_s=env_float("BREAKER_COOLDOWN_S", 30.0),
         )
 
 
@@ -173,6 +182,56 @@ class SimulationConfig:
             if key in known:
                 kwargs[key] = v
         return cls(**kwargs)
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault-injection intensities (alaz_tpu/chaos).
+
+    OFF by default — ``enabled`` gates the whole plane; the chaos
+    harness / ``bench.py --ingest --chaos <seed>`` / ``make chaos`` flip
+    it on with a seed. The default intensities are the "default
+    intensity" the acceptance gates run at: every seam active, faults
+    frequent enough to exercise each degradation path in a short run,
+    rare enough that detection quality must survive them."""
+
+    enabled: bool = False
+    seed: int = 0
+    # frame seam (sources/ingest_server.py)
+    frame_corrupt_prob: float = 0.02  # header magic garbled → resync
+    frame_truncate_prob: float = 0.0  # payload cut short → resync
+    frame_garble_prob: float = 0.02  # count field off → quarantine
+    # delivery seam (batches between source and ingestion surface)
+    batch_dup_prob: float = 0.05
+    batch_reorder_prob: float = 0.05
+    batch_late_prob: float = 0.03
+    # worker seam (aggregator/sharded.py shard threads)
+    worker_crash_prob: float = 0.01
+    worker_stall_prob: float = 0.02
+    worker_stall_s: float = 0.02
+    worker_max_crashes: int = 4
+    # backend seam (datastore/backend.py transport)
+    backend_error_prob: float = 0.3
+    backend_timeout_prob: float = 0.1
+
+    @classmethod
+    def from_env(cls) -> "ChaosConfig":
+        return cls(
+            enabled=env_bool("CHAOS_ENABLED", False),
+            seed=env_int("CHAOS_SEED", 0),
+            frame_corrupt_prob=env_float("CHAOS_FRAME_CORRUPT_PROB", 0.02),
+            frame_truncate_prob=env_float("CHAOS_FRAME_TRUNCATE_PROB", 0.0),
+            frame_garble_prob=env_float("CHAOS_FRAME_GARBLE_PROB", 0.02),
+            batch_dup_prob=env_float("CHAOS_BATCH_DUP_PROB", 0.05),
+            batch_reorder_prob=env_float("CHAOS_BATCH_REORDER_PROB", 0.05),
+            batch_late_prob=env_float("CHAOS_BATCH_LATE_PROB", 0.03),
+            worker_crash_prob=env_float("CHAOS_WORKER_CRASH_PROB", 0.01),
+            worker_stall_prob=env_float("CHAOS_WORKER_STALL_PROB", 0.02),
+            worker_stall_s=env_float("CHAOS_WORKER_STALL_S", 0.02),
+            worker_max_crashes=env_int("CHAOS_WORKER_MAX_CRASHES", 4),
+            backend_error_prob=env_float("CHAOS_BACKEND_ERROR_PROB", 0.3),
+            backend_timeout_prob=env_float("CHAOS_BACKEND_TIMEOUT_PROB", 0.1),
+        )
 
 
 @dataclass(frozen=True)
@@ -314,6 +373,16 @@ class RuntimeConfig:
     # by cores and the GIL-held fraction of process_l7 (ARCHITECTURE
     # §3f); size to physical cores, not hyperthreads.
     ingest_workers: int = 1
+    # scatter backpressure bound (aggregator/sharded.py, ISSUE 6): a
+    # producer blocks at most this long on a backlogged shard queue
+    # before the rows SHED to the drop ledger — a stalled or dead worker
+    # costs attributed data, never a wedged submitter. Size above the
+    # longest GC-or-merge pause a healthy worker takes, well below any
+    # upstream socket timeout.
+    shed_block_s: float = 5.0
+    # deterministic fault injection (alaz_tpu/chaos) — off unless the
+    # chaos harness / bench / env flips it
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     # scorer backlog micro-batching: when >1 and the model is
     # window-independent (not tgn), up to this many ALREADY-QUEUED
     # same-bucket windows are stacked and scored through one vmapped
@@ -341,5 +410,7 @@ class RuntimeConfig:
             renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
             ingest_workers=env_int("INGEST_WORKERS", 1),
+            shed_block_s=env_float("SHED_BLOCK_S", 5.0),
+            chaos=ChaosConfig.from_env(),
             score_batch_windows=env_int("SCORE_BATCH_WINDOWS", 1),
         )

@@ -56,6 +56,80 @@ Transport = Callable[[str, dict], int]
 """(endpoint, json-able payload) -> HTTP-like status code."""
 
 
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker for the export leg (ISSUE 6).
+
+    closed --(threshold consecutive send failures)--> open
+    open   --(cooldown elapses)--> half-open: ONE probe send passes
+    half-open --probe success--> closed / --probe failure--> open again
+
+    While open, sends short-circuit without touching the wire — a dead
+    or drowning backend costs one counter bump per batch instead of a
+    full retry ladder (max_retries × backoff) per batch, which is what
+    turns a backend brownout into an agent-side CPU/latency incident.
+    Thread-safe; ``time_fn`` injectable for tests."""
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 30.0,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.time_fn = time_fn
+        self._lock = threading.Lock()
+        self._failures = 0  # consecutive  # guarded-by: self._lock
+        self._opened_at: Optional[float] = None  # guarded-by: self._lock
+        self._probe_out = False  # a half-open probe is in flight  # guarded-by: self._lock
+        self.opens = 0  # guarded-by: self._lock
+        self.shorted = 0  # sends skipped while open  # guarded-by: self._lock
+
+    def allow(self) -> bool:
+        """May a send go to the wire right now?"""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self.time_fn() - self._opened_at >= self.cooldown_s:
+                if not self._probe_out:
+                    self._probe_out = True  # exactly one probe through
+                    return True
+            self.shorted += 1
+            return False
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            probe = self._probe_out
+            self._probe_out = False
+            if ok:
+                self._failures = 0
+                self._opened_at = None
+                return
+            if self._opened_at is not None:
+                if probe:
+                    # failed half-open probe: restart the cooldown window
+                    self._opened_at = self.time_fn()
+                    self.opens += 1
+                # else: a STRAGGLER failure — a send that departed before
+                # the circuit opened (concurrent pump threads). The
+                # outage is already accounted; re-counting it would
+                # inflate `opens` and push recovery out a full cooldown.
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._opened_at = self.time_fn()
+                self.opens += 1
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self.time_fn() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+
 def http_transport(host: str, timeout_s: float = 10.0) -> Transport:
     """Real HTTP POST transport over urllib (the retryablehttp client's
     wire role, backend.go:210-278; retries/backoff live in
@@ -126,6 +200,13 @@ class BatchingBackend(BaseDataStore):
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._warned_endpoints: set = set()
+        # flapping-backend protection (ISSUE 6): consecutive failed sends
+        # open the circuit; sends shed fast until a cooldown probe heals
+        self.breaker = CircuitBreaker(
+            threshold=cfg.breaker_threshold,
+            cooldown_s=cfg.breaker_cooldown_s,
+            time_fn=time_fn,
+        )
         # metrics scrape-and-push leg (backend.go:340-392): a render
         # function (Prometheus text) polled every metrics_export_interval_s
         self._metrics_render: Optional[Callable[[], str]] = None
@@ -261,6 +342,11 @@ class BatchingBackend(BaseDataStore):
                     stream.failed += len(chunk)
 
     def _send(self, endpoint: str, rows: List[Any]) -> bool:
+        if not self.breaker.allow():
+            # circuit open: shed without touching the wire (the caller
+            # counts the rows into stream.failed — same fate a failed
+            # retry ladder ends in, minus the retry ladder)
+            return False
         payload = {
             "metadata": {
                 "monitoring_id": self.cfg.monitoring_id,
@@ -278,19 +364,30 @@ class BatchingBackend(BaseDataStore):
                 log.warning(f"transport error on {endpoint}: {exc}")
                 status = 599
             if status < 400:
+                self.breaker.record(True)
                 return True
             if status not in (400, 429) and status < 500:
                 # non-retryable 4xx: drop loudly (once per endpoint) so a
-                # backend without this endpoint doesn't silently eat data
+                # backend without this endpoint doesn't silently eat data.
+                # The backend ANSWERED — availability-wise that's a
+                # success, so the breaker doesn't count it.
                 if endpoint not in self._warned_endpoints:
                     self._warned_endpoints.add(endpoint)
                     log.warning(
                         f"dropping batch for {endpoint}: non-retryable HTTP {status}"
                     )
+                self.breaker.record(True)
                 return False
             if attempt < self.cfg.max_retries:
-                self.sleep_fn(min(backoff + random.random() * 0.1, self.cfg.backoff_max_s))
+                # exponential backoff with FULL jitter (not a fixed 0.1s
+                # additive fuzz): N agents retrying a recovered backend
+                # spread over the whole window instead of stampeding at
+                # backoff-aligned instants
+                self.sleep_fn(
+                    random.uniform(0, min(backoff, self.cfg.backoff_max_s))
+                )
                 backoff *= 2
+        self.breaker.record(False)
         return False
 
     # -- lifecycle ---------------------------------------------------------
@@ -321,6 +418,11 @@ class BatchingBackend(BaseDataStore):
         out = {}
         for s in list(self._streams.values()) + list(self._resource_streams.values()):
             out[s.name] = {"pending": len(s.pending), "sent": s.sent, "failed": s.failed}
+        out["breaker"] = {
+            "state": self.breaker.state,
+            "opens": self.breaker.opens,
+            "shorted": self.breaker.shorted,
+        }
         return out
 
 

@@ -707,12 +707,16 @@ class WindowedGraphStore(BaseDataStore):
         on_batch: Optional[Callable[[GraphBatch], None]] = None,
         label_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         renumber: bool = False,
+        ledger=None,
     ):
         self.interner = interner
         self.window_s = window_s
         self.window_ms = int(window_s * 1000)
         self.on_batch = on_batch
         self.label_fn = label_fn
+        # shared DropLedger (ISSUE 6): late stragglers attribute there in
+        # addition to the store-local counter
+        self.ledger = ledger
         self.builder = GraphBuilder(window_s=window_s, renumber=renumber)
         self.batches: List[GraphBatch] = []
         self.request_count = 0
@@ -751,9 +755,10 @@ class WindowedGraphStore(BaseDataStore):
                     # stragglers for an already-emitted window (e.g. the
                     # aggregator's retry path): drop, never re-emit a
                     # window — and never pay the row copy for them
-                    self.late_dropped += (
-                        batch.shape[0] if wmin == wmax else int((wids == w).sum())
-                    )
+                    k = batch.shape[0] if wmin == wmax else int((wids == w).sum())
+                    self.late_dropped += k
+                    if self.ledger is not None:
+                        self.ledger.add("late", k)
                     continue
                 rows = batch.copy() if wmin == wmax else batch[wids == w]
                 self._pending.setdefault(w, []).append(rows)

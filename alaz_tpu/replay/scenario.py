@@ -61,11 +61,18 @@ def _run_scenario(
     seed: int,
     plan_fn,
     label_fn,
+    chaos=None,
 ) -> ScenarioData:
     """The shared scenario pipeline: simulate → inject per ``plan_fn(rng,
     uid_pairs)`` → aggregate into labeled windows via ``label_fn(batch,
     plan)`` → time-split. Both public scenarios are thin wrappers so the
-    replay plumbing (flush timing, store wiring) can never diverge."""
+    replay plumbing (flush timing, store wiring) can never diverge.
+
+    ``chaos`` (a :class:`alaz_tpu.chaos.BatchChaos`) perturbs the L7
+    delivery — duplicated/reordered/late batches — BEFORE the
+    aggregator, replaying infrastructure faults under the semantic fault
+    plan: the chaos-AUROC gate trains and evaluates on exactly this
+    degraded stream (ISSUE 6 acceptance)."""
     rng = np.random.default_rng(seed)
     interner = Interner()
     sim = Simulator(
@@ -95,7 +102,13 @@ def _run_scenario(
     for m in kube_msgs:
         agg.process_k8s(m)
     agg.process_tcp(sim.tcp_events())
-    for batch in sim.iter_l7_batches():
+    l7_batches = list(sim.iter_l7_batches())
+    if chaos is not None:
+        delivery, late = chaos.perturb(l7_batches)
+        # late batches re-deliver at the end of the stream — past their
+        # windows' watermarks, so they exercise the late-drop path
+        l7_batches = delivery + late
+    for batch in l7_batches:
         agg.process_l7(batch, now_ns=int(batch["write_time_ns"][-1]))
     agg.flush_retries(now_ns=_BASE_TIME_NS + int((n_windows + 10) * window_s * 1e9))
     store.flush()
@@ -121,9 +134,12 @@ def run_anomaly_scenario(
     train_frac: float = 0.6,
     fault_kinds: tuple = faults_mod.FAULT_KINDS,
     seed: int = 0,
+    chaos=None,
 ) -> ScenarioData:
     """Replay ``n_windows`` of traffic with a persistent fault plan, label
-    every closed window with the oracle, and split train/eval by time."""
+    every closed window with the oracle, and split train/eval by time.
+    ``chaos`` (optional BatchChaos) degrades the delivery plane — the
+    detection-under-chaos gate runs this with default intensities."""
 
     def label(b, plan):
         b.edge_label = faults_mod.label_batch_edges(b, plan)
@@ -136,6 +152,7 @@ def run_anomaly_scenario(
             rng, pairs, fault_fraction, kinds=fault_kinds
         ),
         label_fn=label,
+        chaos=chaos,
     )
 
 

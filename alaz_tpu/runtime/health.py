@@ -34,12 +34,18 @@ class HealthChecker:
         on_stop: Optional[Callable[[], None]] = None,
         on_resume: Optional[Callable[[], None]] = None,
         metrics_snapshot: Optional[Callable[[], dict]] = None,
+        degraded_snapshot: Optional[Callable[[], dict]] = None,
     ):
         self.transport = transport
         self.interval_s = interval_s
         self.on_stop = on_stop
         self.on_resume = on_resume
         self.metrics_snapshot = metrics_snapshot
+        # self-healing visibility (ISSUE 6): drop-ledger breakdown,
+        # worker restarts, breaker state, last-wave age — wire it to
+        # Service.degraded_snapshot so a stalled merge thread or an open
+        # circuit shows up in every health PUT instead of staying silent
+        self.degraded_snapshot = degraded_snapshot
         self.state = HealthState.RUNNING
         self.checks = 0
         self.failures = 0
@@ -50,6 +56,11 @@ class HealthChecker:
         payload = {"state": self.state.value}
         if self.metrics_snapshot is not None:
             payload["metrics"] = self.metrics_snapshot()
+        if self.degraded_snapshot is not None:
+            try:
+                payload["degraded"] = self.degraded_snapshot()
+            except Exception as exc:
+                log.warning(f"degraded snapshot failed: {exc}")
         try:
             status = self.transport(EP_HEALTHCHECK, payload)
         except Exception as exc:

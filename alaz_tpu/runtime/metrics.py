@@ -124,6 +124,17 @@ class Metrics:
         return "\n".join(lines) + "\n"
 
 
+def ledger_gauges(metrics: Metrics, ledger) -> None:
+    """Surface the unified drop ledger (utils/ledger.py, ISSUE 6):
+    one gauge per loss cause plus the grand total, so a degraded-mode
+    incident reads as WHICH failure mode is eating rows (queue drops vs
+    lateness vs quarantined frames vs deliberate shedding) instead of a
+    single opaque drop counter."""
+    for cause in ledger.CAUSES:
+        metrics.gauge(f"ledger.{cause}", lambda c=cause: ledger.count(c))
+    metrics.gauge("ledger.total", lambda: ledger.total)
+
+
 def host_gauges(metrics: Metrics) -> None:
     """Node metrics — the embedded node_exporter scrape analog
     (backend.go:1038-1105): process, memory, load, cpu, network, disk and

@@ -28,7 +28,8 @@ from alaz_tpu.events.intern import Interner
 from alaz_tpu.graph.builder import WindowedGraphStore, src_locality_gauges
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
-from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges
+from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges, ledger_gauges
+from alaz_tpu.utils.ledger import DropLedger
 from alaz_tpu.utils.queues import BatchQueue
 
 log = get_logger("alaz_tpu.service")
@@ -196,12 +197,23 @@ class Service:
         self.metrics = Metrics()
         device_gauges(self.metrics)
         host_gauges(self.metrics)
+        # unified loss accounting (ISSUE 6): every row this service
+        # loses — queue-mouth drop, late straggler, quarantined frame,
+        # deliberate shed — lands in exactly one ledger cause
+        self.ledger = DropLedger()
+        ledger_gauges(self.metrics, self.ledger)
+        self._export_backend = export_backend
 
         q = self.config.queues
-        self.l7_queue = BatchQueue(q.l7_events, "l7")
-        self.tcp_queue = BatchQueue(q.tcp_events, "tcp")
-        self.proc_queue = BatchQueue(q.proc_events, "proc")
-        self.k8s_queue = BatchQueue(q.kube_events, "k8s")
+        self.l7_queue = BatchQueue(q.l7_events, "l7", ledger=self.ledger)
+        self.tcp_queue = BatchQueue(q.tcp_events, "tcp", ledger=self.ledger)
+        self.proc_queue = BatchQueue(q.proc_events, "proc", ledger=self.ledger)
+        self.k8s_queue = BatchQueue(q.kube_events, "k8s", ledger=self.ledger)
+        # the window queue is interior backpressure, not a source edge —
+        # a drop there is the pipeline choosing to shed. NOT ledger-wired
+        # at the queue mouth: its items are [GraphBatch] lists (size 1),
+        # and the ledger's contract is ROWS — _enqueue_window attributes
+        # the batch's true aggregated row count on drop instead
         self.window_queue = BatchQueue(10_000_000, "windows")
 
         renumber = getattr(self.config, "renumber_nodes", False)
@@ -238,6 +250,23 @@ class Service:
             # both roles the serial pair splits
             from alaz_tpu.aggregator.sharded import ShardedIngest
 
+            # soak mode (CHAOS_ENABLED=1): the worker seam injects
+            # config-intensity crashes/stalls into the LIVE pool so a
+            # staging deployment continuously proves its self-healing;
+            # the other seams are driven externally (harness/bench)
+            fault_hook = None
+            ccfg = getattr(self.config, "chaos", None)
+            if ccfg is not None and ccfg.enabled:
+                from alaz_tpu.chaos.injectors import WorkerChaos
+
+                fault_hook = WorkerChaos(
+                    seed=ccfg.seed,
+                    crash_prob=ccfg.worker_crash_prob,
+                    stall_prob=ccfg.worker_stall_prob,
+                    stall_s=ccfg.worker_stall_s,
+                    max_crashes=ccfg.worker_max_crashes,
+                )
+                log.warning("chaos soak enabled: worker-seam fault injection live")
             self.sharded = ShardedIngest(
                 ingest_workers,
                 interner=self.interner,
@@ -246,6 +275,9 @@ class Service:
                 on_batch=self._enqueue_window,
                 renumber=renumber,
                 tee=export_backend,
+                ledger=self.ledger,
+                shed_block_s=self.config.shed_block_s,
+                fault_hook=fault_hook,
             )
             self.graph_store = self.sharded
         if self.graph_store is None:
@@ -254,6 +286,7 @@ class Service:
                 window_s=self.config.window_s,
                 on_batch=self._enqueue_window,
                 renumber=renumber,
+                ledger=self.ledger,
             )
         if self.sharded is not None:
             self.datastore = None  # worker sinks fan out inside the pipeline
@@ -342,6 +375,23 @@ class Service:
                 "ingest.shard_unfinished", lambda: self.sharded.unfinished
             )
             self.metrics.gauge("ingest.merge_s", lambda: self.sharded.merge_s)
+            # self-healing plane (ISSUE 6): restarts say workers are
+            # dying; a climbing last-wave age says the merge thread is
+            # stalled — the failure that used to be perfectly silent
+            self.metrics.gauge(
+                "ingest.worker_restarts", lambda: self.sharded.worker_restarts
+            )
+            self.metrics.gauge(
+                "ingest.last_wave_age_s", lambda: self.sharded.last_wave_age_s
+            )
+        if export_backend is not None and hasattr(export_backend, "breaker"):
+            # 0 closed / 1 half-open / 2 open — numeric for dashboards
+            self.metrics.gauge(
+                "backend.breaker_state",
+                lambda: {"closed": 0.0, "half-open": 1.0, "open": 2.0}[
+                    export_backend.breaker.state
+                ],
+            )
         # the TPU analog of the NVML gpu_utz gauge: fraction of wall time
         # the scorer spends in device compute (includes host→device feed)
         self._scorer_busy_s = 0.0
@@ -382,7 +432,14 @@ class Service:
     # -- workers -------------------------------------------------------------
 
     def _enqueue_window(self, batch: GraphBatch) -> None:
-        self.window_queue.put_nowait_drop([batch])
+        if not self.window_queue.put_nowait_drop([batch]):
+            # ledger in ROWS, not batches: edge feature 0 is
+            # log1p(request count), so the inverse recovers the exact
+            # aggregated row count this shed window carried
+            rows = int(
+                np.rint(np.expm1(batch.edge_feats[: batch.n_edges, 0])).sum()
+            )
+            self.ledger.add("shed", rows, reason="windows")
         self.metrics.counter("windows.closed").inc()
         # the banded src-gather's cost models on live traffic: lets an
         # operator read off whether SRC_GATHER=banded would pay here.
@@ -692,6 +749,26 @@ class Service:
             score=scores[keep],
             interner=self.interner,
         )
+
+    def degraded_snapshot(self) -> dict:
+        """One dict answering "what is this node losing and why": the
+        per-cause drop ledger, worker restarts, merge-wave age and the
+        export circuit state. Wire it to HealthChecker(degraded_snapshot=)
+        so every health PUT carries it — the observable that turns
+        "windows stopped arriving" from a mystery into a diagnosis."""
+        out: dict = {"ledger": self.ledger.snapshot()}
+        if self.sharded is not None:
+            out["worker_restarts"] = self.sharded.worker_restarts
+            out["last_wave_age_s"] = round(self.sharded.last_wave_age_s, 3)
+            out["shard_backlog"] = self.sharded.unfinished
+        be = self._export_backend
+        if be is not None and hasattr(be, "breaker"):
+            out["breaker"] = {
+                "state": be.breaker.state,
+                "opens": be.breaker.opens,
+                "shorted": be.breaker.shorted,
+            }
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 

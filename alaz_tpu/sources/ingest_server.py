@@ -22,10 +22,18 @@ format (graph/native.py) for pre-attributed edges pushed straight at the
 windowed graph store — the "native fast path" of INTEGRATION.md over a
 socket instead of in-process ctypes.
 
-Malformed frames (bad magic, length mismatch, unknown kind) drop the
-connection — the agent is the untrusted side. Backpressure follows the
-service contract: submit_* drop-not-block, so a flooding agent loses
-events rather than stalling the socket reader into TCP backpressure.
+Malformed frames QUARANTINE instead of killing the connection (ISSUE 6,
+ARCHITECTURE §3j): a frame whose header parses but whose payload is
+inconsistent (count*itemsize != length, unknown kind) is counted and
+skipped — the framing is intact, so the stream just continues. A frame
+whose HEADER is garbage (bad magic, absurd length) means framing is
+lost: the reader resyncs by scanning the byte stream for the next frame
+magic and resumes there. A healthy agent behind one corrupted frame
+keeps its connection; rows in quarantined frames land in the service's
+drop ledger (cause ``quarantined``) when their count is readable.
+Backpressure follows the service contract: submit_* drop-not-block, so
+a flooding agent loses events rather than stalling the socket reader
+into TCP backpressure.
 """
 
 from __future__ import annotations
@@ -66,6 +74,20 @@ _KIND_DTYPE = {
 
 MAX_FRAME_BYTES = 64 * 1024 * 1024  # one frame must fit in memory comfortably
 
+# the 4 magic bytes as they appear on the wire (little-endian), the
+# resync scanner's needle
+_MAGIC_BYTES = struct.pack("<I", MAGIC)
+
+# per-connection garbage budgets: quarantine/resync keep a healthy
+# agent's stream alive through the occasional corrupted frame, but an
+# agent streaming endless garbage is hostile or broken — past either
+# budget the connection drops (the pre-ISSUE-6 defense, restored with
+# margins). Bytes bound the unframeable-garbage scan; the frame count
+# bounds the well-framed-but-malformed flood (valid magic/length,
+# inconsistent count or unknown kind), which never touches the scanner.
+MAX_RESYNC_BYTES_PER_CONN = 16 * 1024 * 1024
+MAX_QUARANTINED_FRAMES_PER_CONN = 64
+
 
 def pack_frame(kind: int, batch: np.ndarray) -> bytes:
     """Client-side helper: one event batch → one wire frame."""
@@ -92,6 +114,15 @@ class IngestServer:
         self.records = 0  # guarded-by: self._state_lock
         self.bad_frames = 0  # guarded-by: self._state_lock
         self.unsupported_frames = 0  # guarded-by: self._state_lock
+        # ISSUE 6 quarantine/resync plane: frames rejected while keeping
+        # the connection, resync scans performed, and garbage bytes
+        # skipped while hunting for the next frame magic
+        self.quarantined_frames = 0  # guarded-by: self._state_lock
+        self.resyncs = 0  # guarded-by: self._state_lock
+        self.resync_bytes = 0  # guarded-by: self._state_lock
+        # rows in quarantined frames attribute to the service's unified
+        # drop ledger when it has one (and their count field is readable)
+        self._ledger = getattr(service, "ledger", None)
         self._state_lock = threading.Lock()
         self._stop = threading.Event()
         # the accept loop rebinds/appends while stop() iterates — the
@@ -159,6 +190,10 @@ class IngestServer:
             metrics.gauge(
                 "ingest_socket.unsupported_frames", lambda: self.unsupported_frames  # alazlint: disable=ALZ010 -- racy gauge read, see above
             )
+            metrics.gauge(
+                "ingest_socket.quarantined_frames", lambda: self.quarantined_frames  # alazlint: disable=ALZ010 -- racy gauge read, see above
+            )
+            metrics.gauge("ingest_socket.resyncs", lambda: self.resyncs)  # alazlint: disable=ALZ010 -- racy gauge read, see above
         t = threading.Thread(target=self._accept_loop, name="alaz-ingest-accept", daemon=True)
         t.start()
         with self._state_lock:
@@ -214,40 +249,147 @@ class IngestServer:
                 self._threads = [x for x in self._threads if x.is_alive()]
                 self._threads.append(t)
 
-    def _recv_exact(self, conn: socket.socket, n: int) -> Optional[bytearray]:
+    def _recv_exact(
+        self, conn: socket.socket, n: int, carry: bytes = b""
+    ) -> tuple[Optional[bytearray], bytes]:
         """Read exactly n bytes into one preallocated buffer (no copies:
-        struct.unpack and np.frombuffer consume the bytearray directly)."""
+        struct.unpack and np.frombuffer consume the bytearray directly),
+        consuming ``carry`` — bytes already pulled off the socket by a
+        resync scan — first. Returns (buf, remaining_carry); buf is None
+        when the stream ended."""
         buf = bytearray(n)
         view = memoryview(buf)
         got = 0
+        if carry:
+            take = min(n, len(carry))
+            view[:take] = carry[:take]
+            got = take
+            carry = carry[take:]
         while got < n:
             try:
                 k = conn.recv_into(view[got:], n - got)
+            except socket.timeout:
+                if self._stop.is_set():
+                    return None, b""
+                continue
+            except OSError:
+                return None, b""
+            if k == 0:
+                return None, b""
+            got += k
+        return buf, carry
+
+    def _recv_some(self, conn: socket.socket, n: int) -> Optional[bytes]:
+        """One bounded read (for the resync scanner); None on EOF/error."""
+        while True:
+            try:
+                chunk = conn.recv(n)
             except socket.timeout:
                 if self._stop.is_set():
                     return None
                 continue
             except OSError:
                 return None
-            if k == 0:
-                return None
-            got += k
-        return buf
+            return chunk if chunk else None
+
+    def _resync(
+        self, conn: socket.socket, garbage: bytes, scanned_before: int
+    ) -> tuple[Optional[bytes], int]:
+        """Framing lost: scan the byte stream for the next frame magic.
+        Returns ``(carry, scanned)`` where carry holds the bytes
+        STARTING AT the magic (the next header read consumes them) and
+        scanned is this scan's garbage byte count; carry is None when
+        the stream ended first — or when the connection's cumulative
+        garbage (``scanned_before`` + this scan) exceeds
+        MAX_RESYNC_BYTES_PER_CONN: an agent that streams unframeable
+        bytes without end gets dropped, not served a CPU spin. The scan
+        starts at offset 1 of ``garbage`` — offset 0 is the header that
+        just failed — and keeps a 3-byte tail between reads so a magic
+        straddling a read boundary is found."""
+        with self._state_lock:
+            self.resyncs += 1
+        budget = MAX_RESYNC_BYTES_PER_CONN - scanned_before
+        scanned = 0
+        buf = bytes(garbage)
+        start = 1
+        while True:
+            idx = buf.find(_MAGIC_BYTES, start)
+            if idx >= 0:
+                scanned += idx
+                with self._state_lock:
+                    self.resync_bytes += idx
+                return buf[idx:], scanned
+            skipped = max(len(buf) - 3, 0)
+            scanned += skipped
+            with self._state_lock:
+                self.resync_bytes += skipped
+            if scanned >= budget:
+                log.warning(
+                    "resync budget exhausted "
+                    f"({MAX_RESYNC_BYTES_PER_CONN} garbage bytes); "
+                    "dropping connection"
+                )
+                return None, scanned
+            tail = buf[-3:]
+            chunk = self._recv_some(conn, 4096)
+            if chunk is None:
+                return None, scanned
+            buf = tail + chunk
+            start = 0
+
+    @staticmethod
+    def _rows_in(kind: int, length: int) -> Optional[int]:
+        """Whole records the verified payload length can hold — the
+        trusted row measure for ledger attribution (None for unknown
+        kinds, whose record size we cannot know)."""
+        if kind == KIND_NATIVE:
+            from alaz_tpu.graph.native import NATIVE_RECORD_DTYPE
+
+            return length // NATIVE_RECORD_DTYPE.itemsize
+        dtype = _KIND_DTYPE.get(kind)
+        return None if dtype is None else length // dtype.itemsize
+
+    def _quarantine(self, count: Optional[int], why: str) -> None:
+        """Account one rejected frame without dropping the connection."""
+        with self._state_lock:
+            self.bad_frames += 1
+            self.quarantined_frames += 1
+        if self._ledger is not None and count:
+            self._ledger.add("quarantined", int(count), reason=why)
+        log.warning(f"quarantined frame ({why}); stream continues")
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(0.5)
+        carry = b""  # bytes a resync scan already pulled off the socket
+        conn_garbage = 0  # cumulative resync-scanned bytes, this conn
+        conn_quarantined = 0  # frames quarantined on this conn
         try:
             while not self._stop.is_set():
-                header = self._recv_exact(conn, FRAME_HEADER.size)
+                if conn_quarantined > MAX_QUARANTINED_FRAMES_PER_CONN:
+                    log.warning(
+                        "quarantine budget exhausted "
+                        f"({MAX_QUARANTINED_FRAMES_PER_CONN} frames); "
+                        "dropping connection"
+                    )
+                    return
+                header, carry = self._recv_exact(conn, FRAME_HEADER.size, carry)
                 if header is None:
                     return
                 magic, kind, count, length = FRAME_HEADER.unpack(header)
                 if magic != MAGIC or length > MAX_FRAME_BYTES:
-                    with self._state_lock:
-                        self.bad_frames += 1
-                    log.warning("bad frame header; dropping connection")
-                    return
-                payload = self._recv_exact(conn, length)
+                    # header corruption: framing is lost — the count/
+                    # length fields are untrustworthy, so no row count
+                    # can be attributed; scan forward to the next magic
+                    self._quarantine(None, "bad_header")
+                    conn_quarantined += 1
+                    carry, scanned = self._resync(
+                        conn, bytes(header) + carry, conn_garbage
+                    )
+                    conn_garbage += scanned
+                    if carry is None:
+                        return
+                    continue
+                payload, carry = self._recv_exact(conn, length, carry)
                 if payload is None:
                     return
                 ok = self._dispatch(kind, count, payload)
@@ -259,10 +401,19 @@ class IngestServer:
                         self.unsupported_frames += 1
                     continue
                 if not ok:
-                    with self._state_lock:
-                        self.bad_frames += 1
-                    log.warning(f"malformed frame kind={kind}; dropping connection")
-                    return
+                    # well-FRAMED but malformed payload (count/length
+                    # mismatch, unknown kind): the boundary held, so the
+                    # stream is still in sync — quarantine and continue.
+                    # Rows attribute from the TRUSTED measure (payload
+                    # bytes actually read / itemsize), never the count
+                    # field — that field being wrong is why we're here,
+                    # and a bit-flipped count must not poison the ledger
+                    # with billions of phantom rows.
+                    self._quarantine(
+                        self._rows_in(kind, length), f"malformed_kind{kind}"
+                    )
+                    conn_quarantined += 1
+                    continue
                 with self._state_lock:
                     self.frames += 1
                     self.records += count
@@ -270,8 +421,9 @@ class IngestServer:
             conn.close()
 
     def _dispatch(self, kind: int, count: int, payload: bytes | bytearray) -> bool | None:
-        """True = accepted; False = malformed (drop connection); None =
-        well-formed but unsupported by this service's configuration."""
+        """True = accepted; False = malformed payload (quarantine the
+        frame, keep the connection — framing held); None = well-formed
+        but unsupported by this service's configuration."""
         if kind == KIND_NATIVE:
             from alaz_tpu.graph.native import NATIVE_RECORD_DTYPE
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Any, Optional
 
 
@@ -22,11 +23,24 @@ class QueueClosed(Exception):
 
 
 class BatchQueue:
-    """Bounded MPMC queue of (batch, aux) items; capacity in events."""
+    """Bounded MPMC queue of (batch, aux) items; capacity in events.
 
-    def __init__(self, capacity_events: int, name: str = "queue"):
+    ``ledger``/``drop_cause`` (optional) route mouth drops into the
+    unified :class:`~alaz_tpu.utils.ledger.DropLedger` so every lost row
+    carries exactly one attribution (ISSUE 6): the queue keeps its local
+    ``dropped`` gauge AND reports to the shared ledger."""
+
+    def __init__(
+        self,
+        capacity_events: int,
+        name: str = "queue",
+        ledger=None,
+        drop_cause: str = "dropped",
+    ):
         self.name = name
         self.capacity = int(capacity_events)
+        self._ledger = ledger
+        self._drop_cause = drop_cause
         self._items: collections.deque = collections.deque()  # guarded-by: self._lock
         self._events = 0  # guarded-by: self._lock
         self._dropped = 0  # guarded-by: self._lock
@@ -69,6 +83,10 @@ class BatchQueue:
                 raise QueueClosed(self.name)
             if self._events + n > self.capacity:
                 self._dropped += n
+                if self._ledger is not None:
+                    # ledger.add is lock-leaf: the queue→ledger edge has
+                    # no reverse path (alazsan DAG)
+                    self._ledger.add(self._drop_cause, n, reason=self.name)
                 return False
             self._items.append(batch)
             self._events += n
@@ -78,12 +96,22 @@ class BatchQueue:
             return True
 
     def put(self, batch: Any, timeout: Optional[float] = None) -> bool:
-        """Blocking enqueue for interior stages."""
+        """Blocking enqueue for interior stages. ``timeout`` is a real
+        DEADLINE, not a per-wakeup budget: under producer contention a
+        loser's wait used to restart at the full timeout every time a
+        competitor stole the freed capacity, making the shed bound
+        (sharded _put_or_shed) no bound at all."""
         n = self._size_of(batch)
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._not_full:
             while not self._closed and self._events + n > self.capacity and self._events > 0:
-                if not self._not_full.wait(timeout):
+                if deadline is None:
+                    self._not_full.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     return False
+                self._not_full.wait(remaining)
             if self._closed:
                 raise QueueClosed(self.name)
             self._items.append(batch)

@@ -206,87 +206,11 @@ def make_e2e_rows(n_rows: int, pods: int, svcs: int, windows: int = 4, seed: int
     return rows
 
 
-def make_ingest_trace(
-    n_rows: int,
-    pods: int = 500,
-    svcs: int = 50,
-    outbound_ips: int = 200,
-    paths: int = 64,
-    windows: int = 8,
-    seed: int = 0,
-):
-    """Synthetic L7 trace for the host-ingest microbench: V2 events with
-    embedded addresses (pod sources; half service, half outbound
-    destinations) and a bounded set of unique HTTP payloads. ONE
-    definition shared by bench.py --ingest, tools/profile_ingest.py and
-    the perf smoke test, so all three drive the identical row stream.
-
-    Returns (events, cluster_msgs): feed the msgs into a ClusterInfo and
-    the events through Aggregator.process_l7.
-    """
-    import numpy as np
-
-    from alaz_tpu.events.k8s import EventType, K8sResourceMessage, Pod, ResourceType, Service
-    from alaz_tpu.events.net import ip_to_u32
-    from alaz_tpu.events.schema import HttpMethod, L7Protocol, make_l7_events
-
-    rng = np.random.default_rng(seed)
-    msgs = []
-    pod_ips = np.empty(pods, dtype=np.uint32)
-    for p in range(pods):
-        ip = f"10.{(p >> 16) & 0xFF}.{(p >> 8) & 0xFF}.{p & 0xFF}"
-        pod_ips[p] = ip_to_u32(ip)
-        msgs.append(
-            K8sResourceMessage(
-                ResourceType.POD, EventType.ADD, Pod(uid=f"pod-{p}", name=f"p{p}", ip=ip)
-            )
-        )
-    svc_ips = np.empty(svcs, dtype=np.uint32)
-    for s in range(svcs):
-        ip = f"10.96.{(s >> 8) & 0xFF}.{s & 0xFF}"
-        svc_ips[s] = ip_to_u32(ip)
-        msgs.append(
-            K8sResourceMessage(
-                ResourceType.SERVICE, EventType.ADD,
-                Service(uid=f"svc-{s}", name=f"s{s}", cluster_ip=ip),
-            )
-        )
-    # outbound destinations: third-party IPs the cluster tables don't know
-    out_ips = (
-        np.uint32(ip_to_u32("52.0.0.1")) + rng.permutation(1 << 16)[:outbound_ips].astype(np.uint32)
-    )
-
-    ev = make_l7_events(n_rows)
-    ev["pid"] = rng.integers(1000, 1000 + pods, n_rows)
-    ev["fd"] = rng.integers(3, 500, n_rows)
-    # event time advances through `windows` one-second windows so window
-    # closes interleave with ingest (the watermark path, not just flush)
-    ev["write_time_ns"] = 1_000_000_000 + (
-        np.arange(n_rows, dtype=np.uint64) * np.uint64(windows) * np.uint64(1_000_000_000)
-    ) // np.uint64(max(n_rows, 1))
-    ev["duration_ns"] = rng.integers(10_000, 5_000_000, n_rows)
-    ev["protocol"] = L7Protocol.HTTP
-    ev["method"] = HttpMethod.GET
-    ev["status"] = np.where(rng.random(n_rows) < 0.05, 500, 200)
-    ev["saddr"] = pod_ips[rng.integers(0, pods, n_rows)]
-    ev["sport"] = rng.integers(1024, 65535, n_rows)
-    # destination mix: ~half in-cluster services, ~half outbound (the
-    # outbound half is what exercises the reverse-DNS intern path)
-    is_out = rng.random(n_rows) < 0.5
-    daddr = svc_ips[rng.integers(0, svcs, n_rows)]
-    daddr[is_out] = out_ips[rng.integers(0, outbound_ips, int(is_out.sum()))]
-    ev["daddr"] = daddr
-    ev["dport"] = np.where(is_out, 443, 80)
-    # bounded unique-payload set: the hashed-parse cache amortizes parsing,
-    # so path enrichment is per-unique, as in production
-    path_idx = rng.integers(0, paths, n_rows)
-    for p in range(paths):
-        payload = f"GET /api/v1/resource{p} HTTP/1.1\r\nHost: bench\r\n\r\n".encode()
-        rows_p = np.flatnonzero(path_idx == p)
-        buf = np.frombuffer(payload, dtype=np.uint8)
-        ev["payload"][rows_p[:, None], np.arange(buf.shape[0])[None, :]] = buf
-        ev["payload_size"][rows_p] = len(payload)
-    return ev, msgs
+# make_ingest_trace moved to alaz_tpu/replay/synth.py (ISSUE 6) so the
+# chaos harness can share the one trace definition; re-exported here so
+# `from bench import make_ingest_trace` keeps working for the test suite
+# and tools/profile_ingest.py.
+from alaz_tpu.replay.synth import make_ingest_trace  # noqa: E402
 
 
 def bench_ingest(args) -> dict:
@@ -352,7 +276,11 @@ def bench_ingest(args) -> dict:
         t0 = time.perf_counter()
         for i in range(0, n_rows, chunk):
             pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
-        pipe.flush()
+        if not pipe.flush(timeout_s=120.0):
+            # flush is bounded since ISSUE 6 and may return False: a
+            # silent partial flush would publish a quietly-wrong perf
+            # number — fail the bench loudly instead
+            raise RuntimeError("sharded flush timed out; bench invalid")
         dt = time.perf_counter() - t0
         merge_share = pipe.merge_s / dt if dt > 0 else 0.0
         pipe.stop()
@@ -436,6 +364,24 @@ def bench_ingest(args) -> dict:
     except Exception:  # repo layout unavailable (installed wheel): skip
         abi_findings = -1
 
+    # robustness rides along too (ISSUE 6): every round runs a short
+    # chaos suite — all four seams, fixed seed — and reports its finding
+    # count (expected: 0) next to the perf number, so a regression in
+    # crash recovery or row conservation is as loud as a perf cliff
+    chaos_seed = args.chaos if getattr(args, "chaos", None) is not None else 0
+    try:
+        from alaz_tpu.chaos import run_chaos_suite
+
+        chaos_report = run_chaos_suite(
+            seed=chaos_seed,
+            n_workers=max(2, args.workers),
+            n_rows=min(n_rows, 48_000),
+        )
+        chaos_findings = len(chaos_report.findings)
+    except Exception as exc:  # a crashed harness is itself a finding
+        print(f"# chaos suite crashed: {exc!r}", file=sys.stderr)
+        chaos_report, chaos_findings = None, -1
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -446,10 +392,27 @@ def bench_ingest(args) -> dict:
         "windows_closed": n_windows,
         "jit_compile_count": compile_watcher.total if compile_watcher else 0,
         "abi_findings": abi_findings,
+        "chaos_findings": chaos_findings,
     }
     if worker_scaling is not None:
         out["workers"] = args.workers
         out["worker_scaling"] = worker_scaling
+    if getattr(args, "chaos", None) is not None and chaos_report is not None:
+        # --chaos SEED: publish the degraded-mode numbers next to the
+        # clean ones — chaos-run throughput and the per-cause drop-
+        # ledger breakdown (what the pipeline lost, attributed)
+        p = chaos_report.pipeline
+        out["chaos"] = {
+            "seed": chaos_seed,
+            "degraded_rows_per_sec": p.get("rows_per_sec", 0),
+            "drop_ledger": p.get("ledger", {}),
+            "worker_restarts": p.get("worker_restarts", 0),
+            "crashes": p.get("crashes", 0),
+            "windows": p.get("windows", 0),
+            "frames": chaos_report.frames,
+            "backend": chaos_report.backend,
+            "findings": chaos_report.findings,
+        }
     return out
 
 
@@ -893,6 +856,11 @@ def main() -> None:
     p.add_argument("--ingest", action="store_true",
                    help="CPU-only host-ingest microbench (L7 trace → "
                         "process_l7 → window close); no accelerator needed")
+    p.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                   help="with --ingest: run the chaos suite at this seed "
+                        "and record degraded-mode throughput + the drop-"
+                        "ledger breakdown (a short suite runs every round "
+                        "regardless; chaos_findings expected 0)")
     p.add_argument("--ingest-scalar", action="store_true",
                    help="with --ingest: drive the pre-vectorization "
                         "_scalar_* reference paths (the A/B baseline)")

@@ -1,0 +1,40 @@
+"""ALZ030 clean: worker loops route failures; narrow idle-poll catches
+and non-worker helpers stay out of scope."""
+
+import socket
+
+from alaz_tpu.utils.queues import QueueClosed
+
+
+class Service:
+    def _worker_loop(self, q):
+        while True:
+            item = q.get()
+            try:
+                self._handle(item)
+            except Exception as exc:
+                # routed: the supervisor (and the operator) can see it
+                self.log.warning(f"batch failed: {exc}")
+
+    def _accept_loop(self):
+        while True:
+            try:
+                self._sock.accept()
+            except socket.timeout:  # narrow idle-poll catch: legal
+                continue
+            except QueueClosed:  # narrow shutdown race: legal
+                pass
+
+    def _merger_loop(self):
+        while True:
+            try:
+                self._merge_once()
+            except Exception:
+                raise  # re-raising routes to the supervisor shell
+
+    def helper(self):
+        # broad swallow OUTSIDE a worker loop: not this rule's business
+        try:
+            self._probe()
+        except Exception:
+            pass

@@ -1,0 +1,36 @@
+"""ALZ030 flagged: worker-loop bodies that swallow failures.
+
+A worker/merger/consumer thread that eats its own exceptions leaves the
+supervisor blind — a dead shard looks identical to an idle one."""
+
+
+class Service:
+    def _worker_loop(self, q):
+        while True:
+            item = q.get()
+            try:
+                self._handle(item)
+            except:  # alz-expect: ALZ030
+                pass
+
+    def _merger_loop(self):
+        while True:
+            try:
+                self._merge_once()
+            except Exception:  # alz-expect: ALZ030
+                continue
+
+    def _consume(self, queue, fn):
+        while True:
+            batch = queue.get()
+            try:
+                fn(batch)
+            except BaseException:  # alz-expect: ALZ030
+                pass
+
+    def _stage_worker(self):
+        while True:
+            try:
+                self._stage_once()
+            except (ValueError, Exception):  # alz-expect: ALZ030
+                ...

@@ -48,6 +48,7 @@ PAIRED_CODES = [
     "ALZ013",
     "ALZ014",
     "ALZ024",
+    "ALZ030",
 ]
 
 

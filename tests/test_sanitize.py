@@ -304,6 +304,87 @@ class TestShardedPipelineLockOrder:
         assert summary["locks"] >= 8, summary
         assert summary["acquisitions"] > 200, summary
 
+    def test_worker_pool_chaos_stress_is_acyclic_and_conserves_rows(
+        self, lock_sanitizer
+    ):
+        """ISSUE 6 satellite: the SAME worker-pool stress, now under
+        chaos — workers killed mid-wave (every close item is at risk
+        until the crash cap) and restarted by the supervisor while
+        pushers and a concurrent flusher hammer the pool. The observed
+        lock-order graph (which now includes the restart/re-drive plane)
+        must stay acyclic, no thread may wedge, and row conservation
+        holds THROUGH the drop ledger: every pushed row is emitted or
+        attributed to exactly one cause."""
+        mon = lock_sanitizer
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.aggregator.sharded import ShardedIngest
+        from alaz_tpu.chaos import DropLedger, WorkerChaos, emitted_rows
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.replay.synth import make_ingest_trace
+
+        n_rows = 24_000
+        ev, msgs = make_ingest_trace(
+            n_rows, pods=40, svcs=8, windows=4, seed=13
+        )
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        closed = []
+        ledger = DropLedger()
+        # kills aimed at close items: every crash lands MID-WAVE, the
+        # hardest case for the merge plane (the re-drive path); capped so
+        # the run terminates in bounded restarts
+        wchaos = WorkerChaos(
+            seed=5, crash_prob=1.0, max_crashes=2, kinds=("close",),
+            stall_prob=0.2, stall_s=0.005,
+        )
+        pipe = ShardedIngest(
+            3, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, ledger=ledger, fault_hook=wchaos,
+        )
+        try:
+            chunks = [ev[i : i + 2_000] for i in range(0, n_rows, 2_000)]
+
+            def pusher(tid: int) -> None:
+                for c in chunks[tid::4]:
+                    pipe.process_l7(c, now_ns=10_000_000_000)
+
+            def flusher() -> None:
+                for _ in range(3):
+                    pipe.flush(timeout_s=20)
+
+            threads = [
+                threading.Thread(target=pusher, args=(t,)) for t in range(4)
+            ] + [threading.Thread(target=flusher)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+                assert not t.is_alive(), "chaos stress thread wedged (deadlock?)"
+            assert pipe.flush(timeout_s=30)
+            assert pipe.drain(timeout_s=10)
+
+            stats = pipe.stats.as_dict()
+            emitted = emitted_rows(closed)
+            # conservation through the ledger: close-item kills lose no
+            # rows, so everything is emitted or late/shed-attributed
+            assert stats["l7_dropped_no_socket"] == 0
+            assert stats["l7_dropped_not_pod"] == 0
+            assert emitted + ledger.total == n_rows, (
+                emitted, ledger.snapshot()
+            )
+            assert wchaos.crashes == 2
+            assert pipe.worker_restarts >= 2
+            assert emitted > 0 and len(closed) >= 4
+        finally:
+            pipe.stop()
+
+        mon.assert_acyclic()
+        summary = mon.graph_summary()
+        assert summary["locks"] >= 8, summary
+        assert summary["acquisitions"] > 200, summary
+
 
 def _mk_batch(n_nodes: int, n_edges: int, cfg, seed: int = 0):
     """Synthetic GraphBatch at an exact (node, edge) bucket."""

@@ -1239,26 +1239,32 @@ class TestIngestServer:
             srv.stop()
             svc.graph_store.close()
 
-    def test_malformed_frame_drops_connection(self, tmp_path):
+    def test_malformed_frame_quarantines_and_stream_resyncs(self, tmp_path):
+        """ISSUE 6: a corrupted header no longer kills the connection —
+        the reader quarantines the frame, scans to the next magic, and
+        the SAME connection keeps delivering (a healthy agent behind one
+        bit-flip keeps its stream)."""
         import socket as socketlib
         import struct
         import time
+
+        from alaz_tpu.events.schema import make_l7_events
+        from alaz_tpu.sources.ingest_server import KIND_L7, pack_frame
 
         svc, srv = self._service_and_server(tmp_path)
         try:
             s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
             s.connect(str(tmp_path / "ingest.sock"))
+            # garbage header + junk, then a GOOD frame on the same stream
             s.sendall(struct.pack("<IB3xII", 0xDEAD, 1, 1, 4) + b"xxxx")
+            s.sendall(pack_frame(KIND_L7, make_l7_events(3)))
             deadline = time.time() + 5
-            while time.time() < deadline and srv.bad_frames == 0:
+            while time.time() < deadline and srv.records < 3:
                 time.sleep(0.01)
             assert srv.bad_frames == 1
-            # server closed us: read EOF or reset, either proves the drop
-            s.settimeout(2)
-            try:
-                assert s.recv(1) == b""
-            except ConnectionResetError:
-                pass
+            assert srv.quarantined_frames == 1
+            assert srv.resyncs == 1
+            assert srv.records == 3  # the clean frame survived the resync
             s.close()
         finally:
             srv.stop()

@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable
 
-from tools.alazlint import jax_rules, lock_rules, program
+from tools.alazlint import jax_rules, lock_rules, program, thread_rules
 from tools.alazlint.core import FileContext, Finding
 
 
@@ -126,6 +126,12 @@ _ALL = [
         "spec hygiene: PartitionSpec/collective axis name outside the "
         "project mesh, or float64 requested inside a traced scope",
         _alz024,
+    ),
+    Rule(
+        "ALZ030",
+        "bare/broad except swallowed inside a worker-loop body "
+        "(failures must route to the supervisor, not pass)",
+        thread_rules.check_alz030,
     ),
 ]
 

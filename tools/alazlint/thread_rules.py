@@ -1,0 +1,93 @@
+"""Worker-thread discipline rules (ALZ030).
+
+The self-healing host plane (ISSUE 6) only works if failures REACH the
+supervisor: a worker/merger/consumer loop that swallows an exception
+with a bare ``except:`` or an empty broad handler turns a dying shard
+into a silently wedged one — exactly the failure class the chaos suite
+exists to kill. The rule scopes to functions that NAME themselves
+worker loops (``*_loop`` / ``*_worker`` / ``*_main`` / ``_consume``),
+where a swallowed exception is a supervision hole rather than a local
+style choice.
+
+Legal patterns stay legal: narrow catches with ``pass``/``continue``
+(``except socket.timeout: continue`` idle polls, ``except QueueClosed:
+pass`` shutdown races) and broad handlers that DO something (log,
+count, notify, re-raise) — routing is what the supervisor needs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.alazlint.core import FileContext, Finding
+
+# the thread-body naming convention this repo's worker loops follow
+# (service._consume, sharded._worker_loop/_merger_loop/_worker_main,
+# ingest_server._accept_loop, ...)
+_WORKER_NAME_RE = re.compile(r"(_loop|_worker|_main)$|^_consume$")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_worker_fn(name: str) -> bool:
+    return bool(_WORKER_NAME_RE.search(name))
+
+
+def _exc_names(node: ast.AST) -> Iterable[str]:
+    """Exception type names a handler catches (tuple-aware)."""
+    if node is None:
+        return
+    targets = node.elts if isinstance(node, ast.Tuple) else [node]
+    for t in targets:
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, ast.Attribute):
+            yield t.attr
+
+
+def _swallows(body) -> bool:
+    """True when the handler body routes NOTHING: only pass/continue/
+    break/constant expressions — no call, raise, assignment, return or
+    control construct that could inform a supervisor."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # a bare docstring/ellipsis
+        return False
+    return True
+
+
+def check_alz030(ctx: FileContext) -> Iterable[Finding]:
+    """ALZ030: bare/broad except swallowed inside a worker-loop body."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.FunctionDef) or not _is_worker_fn(node.name):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            if sub.type is None:
+                yield Finding(
+                    "ALZ030",
+                    f"bare `except:` in worker loop `{node.name}` — it "
+                    "absorbs even injected crashes; catch something "
+                    "specific or route the failure to the supervisor",
+                    ctx.path,
+                    sub.lineno,
+                    sub.col_offset,
+                )
+                continue
+            caught = set(_exc_names(sub.type))
+            if caught & _BROAD and _swallows(sub.body):
+                broad = "/".join(sorted(caught & _BROAD))
+                yield Finding(
+                    "ALZ030",
+                    f"`except {broad}` swallowed in worker loop "
+                    f"`{node.name}` — a dying iteration vanishes; log, "
+                    "count, notify or re-raise so the supervisor sees it",
+                    ctx.path,
+                    sub.lineno,
+                    sub.col_offset,
+                )

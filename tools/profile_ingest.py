@@ -62,7 +62,8 @@ def main() -> None:
         t0 = time.perf_counter()
         for i in range(0, n_rows, chunk):
             pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
-        pipe.flush()
+        if not pipe.flush(timeout_s=120.0):
+            raise RuntimeError("sharded flush timed out; profile invalid")
         dt = time.perf_counter() - t0
         print(
             f"# rows={n_rows} workers={args.workers} "
