@@ -7,20 +7,23 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint sanitize abi-check chaos specs image image-multiarch bench
+.PHONY: native test lint sanitize abi-check chaos scenarios specs image image-multiarch bench
 
 native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
 
-# sanitize/abi-check/chaos run first as their own gates; the main run
-# skips their test files so the (not-cheap) stress and spec-regen work
-# isn't paid twice per invocation (tier-1 CI runs plain `pytest tests/`
-# and still covers both)
-test: lint sanitize abi-check chaos
+# sanitize/abi-check/chaos/scenarios run first as their own gates; the
+# main run skips their test files so the (not-cheap) stress and
+# spec-regen work isn't paid twice per invocation (tier-1 CI runs plain
+# `pytest tests/` and still covers both)
+test: lint sanitize abi-check chaos scenarios
 	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py --ignore=tests/test_alazspec.py
 
-chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates (no accelerator needed)
-	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2
+chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates + one composed scenario×chaos case (no accelerator needed)
+	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2 --composed hot_key
+
+scenarios:  ## incident scenario sweep (ISSUE 7): fixed seeds, all five scenarios, host-plane + detection gates, plus the hot_key 500k-fan-in stress bound
+	env JAX_PLATFORMS=cpu python -m alaz_tpu.replay --seeds 0 --workers 2 --stress
 
 sanitize:  ## alazsan runtime heads: lock-order stress + retrace budgets + transfer guard (CPU-only, no TPU needed)
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_sanitize.py -q

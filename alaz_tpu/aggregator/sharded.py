@@ -314,6 +314,8 @@ class ShardedIngest:
         ledger: Optional[DropLedger] = None,
         fault_hook: Optional[Callable[[int, str], None]] = None,
         shed_block_s: float = 5.0,
+        degree_cap: int = 0,
+        sample_seed: int = 0,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -338,7 +340,17 @@ class ShardedIngest:
         self.window_ms = int(window_s * 1000)
         self.on_batch = on_batch
         self.batches: List[GraphBatch] = []
-        self.builder = GraphBuilder(window_s=window_s, renumber=renumber)
+        # the cap applies HERE, at the merge-stage assembly, never in the
+        # per-shard partials: each worker sees only its shard's slice of
+        # a dst's fan-in, so capping early would make the sample depend
+        # on worker count — the merge sees the whole window, and the
+        # priority hash (seed, window, uids) makes N∈{1..} select
+        # identically (ISSUE 7 N-invariance contract)
+        self.builder = GraphBuilder(
+            window_s=window_s, renumber=renumber,
+            degree_cap=degree_cap, sample_seed=sample_seed,
+            ledger=self.ledger,
+        )
         self.label_fn = label_fn
         self.tee = tee
 

@@ -27,6 +27,15 @@ def main(argv=None) -> int:
         "--legs", nargs="+", default=["pipeline", "frames", "backend"],
         choices=["pipeline", "frames", "backend"],
     )
+    from alaz_tpu.replay.incidents import SCENARIO_NAMES
+
+    p.add_argument(
+        "--composed", default="hot_key", metavar="SCENARIO",
+        choices=list(SCENARIO_NAMES) + ["none"],
+        help="also run one scenario×chaos composition (ISSUE 7): the "
+        "named incident scenario's host leg with the chaos seams armed "
+        "on top — 'hot-key during a degraded delivery'. 'none' skips it",
+    )
     args = p.parse_args(argv)
 
     failed = 0
@@ -40,6 +49,19 @@ def main(argv=None) -> int:
         )
         print(json.dumps(rep.as_dict(), sort_keys=True))
         if not rep.ok:
+            failed += 1
+    if args.composed and args.composed != "none":
+        from alaz_tpu.replay.incidents import run_incident_scenario
+
+        srep = run_incident_scenario(
+            args.composed,
+            seed=args.seeds[0],
+            n_workers=args.workers,
+            detection=False,
+            chaos=ChaosConfig(enabled=True, seed=args.seeds[0]),
+        )
+        print(json.dumps(srep.as_dict(), sort_keys=True))
+        if not srep.ok:
             failed += 1
     if failed:
         print(f"# {failed} seed(s) with findings", file=sys.stderr)

@@ -234,6 +234,34 @@ class ChaosConfig:
         )
 
 
+@dataclass
+class ScenarioConfig:
+    """Incident-scenario suite knobs (alaz_tpu/replay/incidents.py).
+
+    The scenario library itself is parameterized per call; these are the
+    defaults the suite drivers (``make scenarios``, ``bench.py
+    --scenario`` and the ``--ingest`` ride-along) read, so a deployment
+    can re-scale the fixed-seed sweep without touching code."""
+
+    seed: int = 0
+    n_workers: int = 2
+    # hot_key stress fan-in (the acceptance bound); gate-scale runs use
+    # the per-scenario defaults in incidents.py
+    hot_key_fanin: int = 500_000
+    # degree cap the hot_key scenario survives under (0 would disable
+    # the defense and let the fan-in through — never the suite default)
+    degree_cap: int = 1_024
+
+    @classmethod
+    def from_env(cls) -> "ScenarioConfig":
+        return cls(
+            seed=env_int("SCENARIO_SEED", 0),
+            n_workers=env_int("SCENARIO_WORKERS", 2),
+            hot_key_fanin=env_int("SCENARIO_HOT_KEY_FANIN", 500_000),
+            degree_cap=env_int("SCENARIO_DEGREE_CAP", 1_024),
+        )
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """Flagship model hyperparameters (BASELINE.json configs 2-4)."""
@@ -380,6 +408,17 @@ class RuntimeConfig:
     # longest GC-or-merge pause a healthy worker takes, well below any
     # upstream socket timeout.
     shed_block_s: float = 5.0
+    # degree-capped reservoir sampling at window close (ISSUE 7,
+    # graph/builder.py): bound every dst's aggregated fan-in to this
+    # many edges — the hot-key defense (one service with in-degree ~N
+    # otherwise turns each window into an N-row batch). 0 = unlimited
+    # (bit-identical to the uncapped path). Deterministic per
+    # (sample_seed, window, dst-uid, src-uid); cut rows attribute to the
+    # ledger's `sampled` cause. Size well above the fleet's honest
+    # fan-in (p99.9 of per-service callers), well below the bucket
+    # ladder's top rung.
+    degree_cap: int = 0
+    sample_seed: int = 0
     # deterministic fault injection (alaz_tpu/chaos) — off unless the
     # chaos harness / bench / env flips it
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
@@ -411,6 +450,8 @@ class RuntimeConfig:
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
             ingest_workers=env_int("INGEST_WORKERS", 1),
             shed_block_s=env_float("SHED_BLOCK_S", 5.0),
+            degree_cap=env_int("DEGREE_CAP", 0),
+            sample_seed=env_int("SAMPLE_SEED", 0),
             chaos=ChaosConfig.from_env(),
             score_batch_windows=env_int("SCORE_BATCH_WINDOWS", 1),
         )

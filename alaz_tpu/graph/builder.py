@@ -398,6 +398,110 @@ def group_reduce(
     return sk[starts], count, order[starts], sums, maxes
 
 
+# ---------------------------------------------------------------------------
+# Degree-capped neighbor sampling (ISSUE 7). One dst with in-degree ~N (a
+# hot-key service) turns a window's aggregated edge list into an N-row
+# batch: the bucket ladder jumps to its top rung and the close wave pays
+# an N-proportional assembly. The cap bounds per-dst fan-in at window
+# close with DETERMINISTIC reservoir sampling — every edge draws a 64-bit
+# priority that is a pure function of (seed, window, dst-uid, src-uid,
+# proto), and each over-cap dst keeps the `cap` smallest (bottom-k ==
+# uniform reservoir sample under hash-random priorities, the
+# sample-and-aggregate GNN sampling form, PAPERS.md). Purity is the
+# point: serial builds, N-worker merges and reruns all select the same
+# edges, so the sharded equivalence contract survives the cap.
+#
+# The selection routes through the C++ core (alz_sample_degree_cap,
+# operating on the already-dst-grouped edges alz_group_edges emits) when
+# the .so is loaded — same toggle as the grouping backend
+# (set_native_grouping) so parity tests A/B both with one switch; the
+# numpy lexsort path below is the fallback and the semantic reference.
+# Ties break by ascending row index in BOTH backends (numpy's stable
+# lexsort == the C++ (prio, idx) comparator), so they are bit-identical.
+# ---------------------------------------------------------------------------
+
+_MIX_C1 = 0xFF51AFD7ED558CCD  # splitmix64 finalizer constants — mirrored
+_MIX_C2 = 0xC4CEB9FE1A85EC53  # by mix64() in native/ingest.cc (alazspec-pinned)
+_U64 = np.uint64
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized mix64 — the same finalizer native/ingest.cc uses for
+    its hash probes; uint64 arithmetic wraps mod 2^64 on both sides."""
+    x = x.astype(np.uint64, copy=True)
+    x ^= x >> _U64(33)
+    x *= _U64(_MIX_C1)
+    x ^= x >> _U64(33)
+    x *= _U64(_MIX_C2)
+    x ^= x >> _U64(33)
+    return x
+
+
+def _mix64_int(x: int) -> int:
+    """Scalar mix64 over Python ints (avoids numpy scalar overflow
+    warnings when mixing the (seed, window) base)."""
+    x &= _MASK64
+    x ^= x >> 33
+    x = (x * _MIX_C1) & _MASK64
+    x ^= x >> 33
+    x = (x * _MIX_C2) & _MASK64
+    x ^= x >> 33
+    return x
+
+
+def sample_priorities(
+    seed: int,
+    window_start_ms: int,
+    dst_uid: np.ndarray,
+    src_uid: np.ndarray,
+    proto: np.ndarray,
+) -> np.ndarray:
+    """Per-edge sampling priority: a pure function of (seed, window,
+    dst-uid, src-uid, proto) — uids, not slots, so any pipeline that
+    interns the same strings draws the same sample regardless of worker
+    count or slot-assignment order."""
+    base = _mix64_int((int(seed) << 32) ^ (int(window_start_ms) & _MASK64))
+    x = (
+        (dst_uid.astype(np.int64).astype(np.uint64) << _U64(32))
+        ^ src_uid.astype(np.int64).astype(np.uint64)
+        ^ (proto.astype(np.int64).astype(np.uint64) << _U64(56))
+    )
+    x ^= _U64(base)
+    return _mix64(x)
+
+
+def degree_cap_select(
+    e_dst: np.ndarray, prio: np.ndarray, cap: int
+) -> np.ndarray:
+    """Indices (ascending) of the edges that survive the per-dst cap:
+    for every dst group in the DST-SORTED edge list, the ``cap``
+    smallest priorities (ties by row index). C++ when loaded, numpy
+    lexsort fallback otherwise — bit-identical by construction."""
+    n = e_dst.shape[0]
+    if n and _use_native_grouping():
+        from alaz_tpu.graph import native
+
+        out = native.sample_degree_cap(e_dst, prio, cap)
+        if out is not None:
+            return out
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    # stable lexsort: within a dst group, ascending (prio, original
+    # index) — the exact order the C++ (prio, idx) comparator ranks
+    order = np.lexsort((prio, e_dst))
+    sd = e_dst[order]
+    is_start = np.empty(n, dtype=bool)
+    is_start[0] = True
+    np.not_equal(sd[1:], sd[:-1], out=is_start[1:])
+    starts = np.flatnonzero(is_start)
+    sizes = np.diff(np.append(starts, n))
+    rank = np.arange(n, dtype=np.int64) - np.repeat(starts, sizes)
+    keep = order[rank < cap]
+    keep.sort()
+    return keep
+
+
 @dataclass
 class EdgeAggregate:
     """One window's aggregated edges, slot-keyed — what feature assembly
@@ -532,10 +636,21 @@ class GraphBuilder:
         nodes: Optional[NodeTable] = None,
         window_s: float = 1.0,
         renumber: bool = False,
+        degree_cap: int = 0,
+        sample_seed: int = 0,
+        ledger=None,
     ):
         self.nodes = nodes if nodes is not None else NodeTable()
         self.window_s = window_s
         self.renumber = renumber
+        # per-dst fan-in bound at window close (0 = unlimited — the
+        # bit-identical legacy path). Sampled-away edges attribute their
+        # request rows to the ledger's closed `sampled` cause.
+        self.degree_cap = int(degree_cap)
+        self.sample_seed = int(sample_seed)
+        self.ledger = ledger
+        self.sampled_rows = 0  # request rows cut by the cap (cumulative)
+        self.sampled_edges = 0  # aggregated edges cut by the cap
 
     def build(
         self,
@@ -619,33 +734,18 @@ class GraphBuilder:
         n_edges = agg.n_edges
         e_src, e_dst, e_type = agg.e_src, agg.e_dst, agg.e_type
         count = agg.count
-
-        window_s = max(self.window_s, 1e-6)
-        mean_lat = agg.lat_sum / np.maximum(count, 1.0)
-        ef = np.zeros((n_edges, EDGE_FEATURE_DIM), dtype=np.float32)
-        ef[:, 0] = np.log1p(count)
-        ef[:, 1] = np.log1p(mean_lat) / 20.0
-        ef[:, 2] = np.log1p(agg.lat_max) / 20.0
-        ef[:, 3] = agg.err5_sum / np.maximum(count, 1.0)
-        ef[:, 4] = agg.err4_sum / np.maximum(count, 1.0)
-        ef[:, 5] = agg.tls_sum / np.maximum(count, 1.0)
-        ef[:, 6] = np.log1p(count / window_s)
-        # slots 7..15: protocol one-hot. Folding the edge-type embedding
-        # into the edge features lets models learn type offsets through
-        # their edge-feature projection instead of a per-edge embedding
-        # gather — a [1M]-row gather costs ~9ms/step on TPU (row-op bound)
-        # while these host-side writes are free.
-        proto_idx = np.clip(e_type, 0, 8)
-        ef[np.arange(n_edges), 7 + proto_idx] = 1.0
-
-        el = None
-        if agg.label_sum is not None:
-            el = (agg.label_sum > 0).astype(np.float32)
+        lat_sum, lat_max = agg.lat_sum, agg.lat_max
+        err5_sum, err4_sum, tls_sum = agg.err5_sum, agg.err4_sum, agg.tls_sum
+        label_sum = agg.label_sum
 
         # -- node features ---------------------------------------------------
         # Everything here derives from the EDGE aggregates (sums of sums
         # of the per-row stats — exact, the inputs are integer-valued),
-        # so the sharded merge needs no row-level columns.
+        # so the sharded merge needs no row-level columns. Computed from
+        # the FULL aggregate, BEFORE any degree-cap sampling: the host
+        # knows the true totals, so a hot-key dst keeps its real
+        # in-degree/in-count/in-error signal even when its edge list is
+        # cut — the anomaly stays visible while the batch stays bounded.
         n_nodes = len(self.nodes)
         node_type = self.nodes.types_array()
         nf = np.zeros((n_nodes, NODE_FEATURE_DIM), dtype=np.float32)
@@ -653,10 +753,10 @@ class GraphBuilder:
             nf[:, t] = node_type == t
         out_cnt = np.bincount(e_src, weights=count, minlength=n_nodes)
         in_cnt = np.bincount(e_dst, weights=count, minlength=n_nodes)
-        out_err = np.bincount(e_src, weights=agg.err5_sum, minlength=n_nodes)
-        in_err = np.bincount(e_dst, weights=agg.err5_sum, minlength=n_nodes)
-        out_lat = np.bincount(e_src, weights=agg.lat_sum, minlength=n_nodes)
-        in_lat = np.bincount(e_dst, weights=agg.lat_sum, minlength=n_nodes)
+        out_err = np.bincount(e_src, weights=err5_sum, minlength=n_nodes)
+        in_err = np.bincount(e_dst, weights=err5_sum, minlength=n_nodes)
+        out_lat = np.bincount(e_src, weights=lat_sum, minlength=n_nodes)
+        in_lat = np.bincount(e_dst, weights=lat_sum, minlength=n_nodes)
         out_deg = np.bincount(e_src, minlength=n_nodes).astype(np.float64)
         in_deg = np.bincount(e_dst, minlength=n_nodes).astype(np.float64)
         nf[:, 4] = np.log1p(out_cnt)
@@ -667,6 +767,57 @@ class GraphBuilder:
         nf[:, 9] = np.log1p(in_lat / np.maximum(in_cnt, 1.0)) / 20.0
         nf[:, 10] = np.log1p(out_deg)
         nf[:, 11] = np.log1p(in_deg)
+
+        # -- degree-capped sampling (ISSUE 7) --------------------------------
+        # n_edges <= cap is a free sufficient no-op check; past it, one
+        # O(E) bincount decides whether any dst actually exceeds the cap
+        # (the steady-state service map never does — this path costs one
+        # bincount until the day a hot key shows up).
+        if 0 < self.degree_cap < n_edges and int(in_deg.max()) > self.degree_cap:
+            uids = self.nodes.uids_array()
+            prio = sample_priorities(
+                self.sample_seed, window_start_ms,
+                uids[e_dst], uids[e_src], e_type,
+            )
+            keep = degree_cap_select(e_dst, prio, self.degree_cap)
+            if keep.shape[0] < n_edges:
+                cut_edges = n_edges - int(keep.shape[0])
+                total_rows = int(round(float(count.sum())))
+                e_src, e_dst, e_type = e_src[keep], e_dst[keep], e_type[keep]
+                count = count[keep]
+                lat_sum, lat_max = lat_sum[keep], lat_max[keep]
+                err5_sum, err4_sum = err5_sum[keep], err4_sum[keep]
+                tls_sum = tls_sum[keep]
+                if label_sum is not None:
+                    label_sum = label_sum[keep]
+                cut_rows = total_rows - int(round(float(count.sum())))
+                n_edges = int(keep.shape[0])
+                self.sampled_edges += cut_edges
+                self.sampled_rows += cut_rows
+                if self.ledger is not None:
+                    self.ledger.add("sampled", cut_rows, reason="degree_cap")
+
+        window_s = max(self.window_s, 1e-6)
+        mean_lat = lat_sum / np.maximum(count, 1.0)
+        ef = np.zeros((n_edges, EDGE_FEATURE_DIM), dtype=np.float32)
+        ef[:, 0] = np.log1p(count)
+        ef[:, 1] = np.log1p(mean_lat) / 20.0
+        ef[:, 2] = np.log1p(lat_max) / 20.0
+        ef[:, 3] = err5_sum / np.maximum(count, 1.0)
+        ef[:, 4] = err4_sum / np.maximum(count, 1.0)
+        ef[:, 5] = tls_sum / np.maximum(count, 1.0)
+        ef[:, 6] = np.log1p(count / window_s)
+        # slots 7..15: protocol one-hot. Folding the edge-type embedding
+        # into the edge features lets models learn type offsets through
+        # their edge-feature projection instead of a per-edge embedding
+        # gather — a [1M]-row gather costs ~9ms/step on TPU (row-op bound)
+        # while these host-side writes are free.
+        proto_idx = np.clip(e_type, 0, 8)
+        ef[np.arange(n_edges), 7 + proto_idx] = 1.0
+
+        el = None
+        if label_sum is not None:
+            el = (label_sum > 0).astype(np.float32)
 
         node_uids = self.nodes.uids_array()
         if self.renumber and n_edges > 0:
@@ -708,6 +859,8 @@ class WindowedGraphStore(BaseDataStore):
         label_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
         renumber: bool = False,
         ledger=None,
+        degree_cap: int = 0,
+        sample_seed: int = 0,
     ):
         self.interner = interner
         self.window_s = window_s
@@ -715,9 +868,13 @@ class WindowedGraphStore(BaseDataStore):
         self.on_batch = on_batch
         self.label_fn = label_fn
         # shared DropLedger (ISSUE 6): late stragglers attribute there in
-        # addition to the store-local counter
+        # addition to the store-local counter; degree-cap cuts (ISSUE 7)
+        # attribute through the builder as `sampled`
         self.ledger = ledger
-        self.builder = GraphBuilder(window_s=window_s, renumber=renumber)
+        self.builder = GraphBuilder(
+            window_s=window_s, renumber=renumber,
+            degree_cap=degree_cap, sample_seed=sample_seed, ledger=ledger,
+        )
         self.batches: List[GraphBatch] = []
         self.request_count = 0
         self.late_dropped = 0

@@ -75,6 +75,10 @@ NATIVE_EXPORTS: dict = {
         ("ptr", "u64", "pptr", "u32", "pptr", "u32", "u64", "ptr", "ptr",
          "ptr", "pptr", "pptr"),
     ),
+    "alz_sample_degree_cap": (
+        "i64",
+        ("ptr", "ptr", "i64", "u32", "ptr", "u64"),
+    ),
     "alz_edge_feat_dim": ("u32", ()),
     "alz_node_feat_dim": ("u32", ()),
     "alz_abi_record_layout": ("cstr", ()),
@@ -243,6 +247,33 @@ def group_edges(keys, sum_cols, max_cols):
         out_keys[:e], out_count[:e], out_rep[:e],
         [s[:e] for s in out_sums], [m[:e] for m in out_maxes],
     )
+
+
+def sample_degree_cap(dst, prio, cap: int):
+    """Degree-capped bottom-k selection through the C++ core
+    (``alz_sample_degree_cap``): over DST-SORTED aggregated edges, keep
+    at most ``cap`` edges per dst — the ones with the smallest 64-bit
+    priorities (ties by ascending row index, matching the numpy
+    fallback's stable lexsort bit for bit). Returns kept indices in
+    ascending order, or None when the library is unavailable (callers
+    fall back to graph/builder.py's numpy path). Stateless and
+    thread-safe like ``alz_group_edges``."""
+    lib = _load()
+    if lib is None:
+        return None
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    prio = np.ascontiguousarray(prio, dtype=np.uint64)
+    n = int(dst.shape[0])
+    out = np.empty(n, dtype=np.int64)
+    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+    k = int(
+        lib.alz_sample_degree_cap(
+            ptr(dst), ptr(prio), n, int(cap), ptr(out), n
+        )
+    )
+    if k < 0:  # cap==0 or short buffer: both are caller bugs — fall back
+        return None
+    return out[:k]
 
 
 _INT64_MIN = -(2**63)

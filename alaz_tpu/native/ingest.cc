@@ -693,6 +693,61 @@ int64_t alz_group_edges(const int64_t* keys, uint64_t n,
   return static_cast<int64_t>(n_groups);
 }
 
+// ---------------------------------------------------------------------------
+// Degree-capped neighbor sampling (ISSUE 7). Operates over the
+// dst-grouped aggregated edge list the grouping stage emits (dst[] is
+// dst-sorted — ascending dst-major group keys, alz_group_edges'
+// contract): for every dst whose in-degree exceeds `cap`, keep the
+// `cap` edges with the SMALLEST priority (bottom-k — the deterministic
+// form of reservoir sampling: with hash-random priorities, bottom-k is
+// a uniform sample, and the same (seed, window, dst-uid, src-uid) keys
+// always draw the same sample, so N-worker merges and reruns select
+// identically). Priorities are computed caller-side (one shared
+// definition, graph/builder.py sample_priorities, mix64 over the uid
+// pair) so the C++ path and the numpy fallback can never hash apart.
+//
+// STATELESS like alz_group_edges — the sharded merge calls it on the
+// merge thread, parity tests call it concurrently. Selection ties
+// break by ascending row index, matching numpy's stable lexsort, so
+// both backends are bit-identical. Kept indices are written ascending
+// (the dst-major order of the input survives the cut). Returns the
+// kept count; -1 when out_cap is too small (never with out_cap == n),
+// -2 on cap == 0 (unlimited is the CALLER's fast path, not a mode
+// here).
+int64_t alz_sample_degree_cap(const int32_t* dst, const uint64_t* prio,
+                              int64_t n, uint32_t cap, int64_t* out_idx,
+                              uint64_t out_cap) {
+  if (cap == 0) return -2;
+  int64_t kept = 0;
+  std::vector<int64_t> heavy;  // per-group scratch, reused across groups
+  int64_t g0 = 0;
+  while (g0 < n) {
+    const int32_t d = dst[g0];
+    int64_t g1 = g0 + 1;
+    while (g1 < n && dst[g1] == d) ++g1;
+    const int64_t size = g1 - g0;
+    if (size <= static_cast<int64_t>(cap)) {
+      if (kept + size > static_cast<int64_t>(out_cap)) return -1;
+      for (int64_t i = g0; i < g1; ++i) out_idx[kept++] = i;
+    } else {
+      heavy.resize(static_cast<size_t>(size));
+      for (int64_t i = 0; i < size; ++i) heavy[static_cast<size_t>(i)] = g0 + i;
+      // O(size) partial selection of the cap smallest (prio, idx) pairs
+      std::nth_element(
+          heavy.begin(), heavy.begin() + cap, heavy.end(),
+          [prio](int64_t a, int64_t b) {
+            return prio[a] != prio[b] ? prio[a] < prio[b] : a < b;
+          });
+      std::sort(heavy.begin(), heavy.begin() + cap);  // restore dst-major order
+      if (kept + static_cast<int64_t>(cap) > static_cast<int64_t>(out_cap))
+        return -1;
+      for (uint32_t i = 0; i < cap; ++i) out_idx[kept++] = heavy[i];
+    }
+    g0 = g1;
+  }
+  return kept;
+}
+
 uint32_t alz_export_nodes(void* p, uint32_t buf_cap, int32_t* uids, uint8_t* types) {
   Ingest* ig = static_cast<Ingest*>(p);
   uint32_t n = static_cast<uint32_t>(ig->node_uids.size());

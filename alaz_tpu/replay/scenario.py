@@ -19,6 +19,7 @@ from alaz_tpu.events.intern import Interner
 from alaz_tpu.graph.builder import WindowedGraphStore
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.replay import faults as faults_mod
+from alaz_tpu.replay.incidents import replay_delivery
 from alaz_tpu.replay.simulator import _BASE_TIME_NS, Simulator
 
 
@@ -47,6 +48,10 @@ class ScenarioData:
     eval: List[GraphBatch]
     interner: Interner
     plan: faults_mod.FaultPlan
+    # request rows cut by degree-capped sampling (ISSUE 7) — lets the
+    # sampling-parity gate assert the cap actually BIT, not that it was
+    # vacuously within tolerance
+    sampled_rows: int = 0
 
     @property
     def all_batches(self) -> List[GraphBatch]:
@@ -62,6 +67,8 @@ def _run_scenario(
     plan_fn,
     label_fn,
     chaos=None,
+    incident=None,
+    degree_cap: int = 0,
 ) -> ScenarioData:
     """The shared scenario pipeline: simulate → inject per ``plan_fn(rng,
     uid_pairs)`` → aggregate into labeled windows via ``label_fn(batch,
@@ -72,7 +79,20 @@ def _run_scenario(
     delivery — duplicated/reordered/late batches — BEFORE the
     aggregator, replaying infrastructure faults under the semantic fault
     plan: the chaos-AUROC gate trains and evaluates on exactly this
-    degraded stream (ISSUE 6 acceptance)."""
+    degraded stream (ISSUE 6 acceptance).
+
+    ``incident`` (an :class:`alaz_tpu.replay.incidents.Incident`, or a
+    list of them) reshapes the traffic itself — hot-key fan-in, deploy
+    rollout churn, retry storms (ISSUE 7). Incidents compose with chaos:
+    the incident shapes the stream, chaos degrades its delivery, so
+    "hot-key during a degraded delivery" is one call with both args.
+    Incident-labeled pairs (e.g. the retry storm's victim edges) fold
+    into the oracle next to the fault plan's labels.
+
+    ``degree_cap`` arms degree-capped reservoir sampling at window close
+    (graph/builder.py) — the hot-key defense under detection test."""
+    from alaz_tpu.replay import incidents as incidents_mod
+
     rng = np.random.default_rng(seed)
     interner = Interner()
     sim = Simulator(
@@ -96,26 +116,38 @@ def _run_scenario(
     ]
     plan = plan_fn(rng, pairs)
 
-    store = WindowedGraphStore(interner, window_s=window_s)
+    store = WindowedGraphStore(
+        interner, window_s=window_s, degree_cap=degree_cap, sample_seed=seed
+    )
     injected = FaultInjectingStore(store, plan, rng)
     agg = Aggregator(injected, interner=interner)
     for m in kube_msgs:
         agg.process_k8s(m)
     agg.process_tcp(sim.tcp_events())
-    l7_batches = list(sim.iter_l7_batches())
+
+    traffic = incidents_mod.base_traffic(sim)
+    if incident is not None:
+        for inc in incident if isinstance(incident, (list, tuple)) else [incident]:
+            traffic = inc.apply(sim, traffic)
+    deliveries = traffic.deliveries
     if chaos is not None:
-        delivery, late = chaos.perturb(l7_batches)
         # late batches re-deliver at the end of the stream — past their
         # windows' watermarks, so they exercise the late-drop path
-        l7_batches = delivery + late
-    for batch in l7_batches:
-        agg.process_l7(batch, now_ns=int(batch["write_time_ns"][-1]))
+        delivery, late = chaos.perturb(deliveries)
+        deliveries = delivery + late
+    for d in deliveries:
+        replay_delivery(agg, d)
     agg.flush_retries(now_ns=_BASE_TIME_NS + int((n_windows + 10) * window_s * 1e9))
     store.flush()
 
     batches = store.batches
     for b in batches:
         label_fn(b, plan)
+        if traffic.label_pairs:
+            extra = incidents_mod.label_extra(
+                b, traffic.label_pairs, traffic.label_span_ms
+            )
+            b.edge_label = np.maximum(b.edge_label, extra)
 
     n_train = max(1, int(len(batches) * train_frac))
     return ScenarioData(
@@ -123,6 +155,7 @@ def _run_scenario(
         eval=batches[n_train:],
         interner=interner,
         plan=plan,
+        sampled_rows=store.builder.sampled_rows,
     )
 
 
@@ -135,11 +168,16 @@ def run_anomaly_scenario(
     fault_kinds: tuple = faults_mod.FAULT_KINDS,
     seed: int = 0,
     chaos=None,
+    incident=None,
+    degree_cap: int = 0,
 ) -> ScenarioData:
     """Replay ``n_windows`` of traffic with a persistent fault plan, label
     every closed window with the oracle, and split train/eval by time.
     ``chaos`` (optional BatchChaos) degrades the delivery plane — the
-    detection-under-chaos gate runs this with default intensities."""
+    detection-under-chaos gate runs this with default intensities.
+    ``incident`` (optional Incident(s), replay/incidents.py) reshapes
+    the traffic itself and ``degree_cap`` arms close-time sampling, so
+    "hot-key during a degraded delivery, capped" is one call."""
 
     def label(b, plan):
         b.edge_label = faults_mod.label_batch_edges(b, plan)
@@ -153,6 +191,8 @@ def run_anomaly_scenario(
         ),
         label_fn=label,
         chaos=chaos,
+        incident=incident,
+        degree_cap=degree_cap,
     )
 
 

@@ -227,6 +227,8 @@ class Service:
         self.graph_store = None
         self.sharded = None
         ingest_workers = max(1, int(getattr(self.config, "ingest_workers", 1)))
+        degree_cap = max(0, int(getattr(self.config, "degree_cap", 0)))
+        sample_seed = int(getattr(self.config, "sample_seed", 0))
         if use_native_ingest:
             from alaz_tpu.graph import native as native_mod
 
@@ -235,6 +237,16 @@ class Service:
                     log.warning(
                         "ingest_workers > 1 ignored with use_native_ingest: "
                         "the C++ window accumulator is its own ingest plane"
+                    )
+                if degree_cap:
+                    # the C++ accumulator assembles features in its own
+                    # close pass (alz_close_window_feats) — the cap rides
+                    # the GraphBuilder paths only; a silent no-op here
+                    # would let a hot key through a "capped" deployment
+                    log.warning(
+                        "degree_cap is not applied by the native window "
+                        "accumulator; use the sharded or numpy ingest "
+                        "plane for hot-key protection"
                     )
                 self.graph_store = native_mod.NativeWindowedStore(
                     window_s=self.config.window_s,
@@ -278,6 +290,8 @@ class Service:
                 ledger=self.ledger,
                 shed_block_s=self.config.shed_block_s,
                 fault_hook=fault_hook,
+                degree_cap=degree_cap,
+                sample_seed=sample_seed,
             )
             self.graph_store = self.sharded
         if self.graph_store is None:
@@ -287,6 +301,8 @@ class Service:
                 on_batch=self._enqueue_window,
                 renumber=renumber,
                 ledger=self.ledger,
+                degree_cap=degree_cap,
+                sample_seed=sample_seed,
             )
         if self.sharded is not None:
             self.datastore = None  # worker sinks fan out inside the pipeline
@@ -383,6 +399,19 @@ class Service:
             )
             self.metrics.gauge(
                 "ingest.last_wave_age_s", lambda: self.sharded.last_wave_age_s
+            )
+            # degree-cap activity (ISSUE 7): nonzero means a hot key is
+            # live RIGHT NOW and the sampler is what's absorbing it —
+            # rows cut ride the ledger.sampled gauge, this one counts
+            # aggregated edges so fan-in magnitude is readable directly
+            self.metrics.gauge(
+                "ingest.sampled_edges",
+                lambda: self.sharded.builder.sampled_edges,
+            )
+        elif isinstance(self.graph_store, WindowedGraphStore):
+            self.metrics.gauge(
+                "ingest.sampled_edges",
+                lambda: self.graph_store.builder.sampled_edges,
             )
         if export_backend is not None and hasattr(export_backend, "breaker"):
             # 0 closed / 1 half-open / 2 open — numeric for dashboards

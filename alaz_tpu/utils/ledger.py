@@ -11,7 +11,7 @@ the ledger centralizes it behind one contract:
 
         pushed == emitted + ledger.total (+ semantic aggregator drops)
 
-The four causes are closed-world on purpose — a new loss path must pick
+The five causes are closed-world on purpose — a new loss path must pick
 one (or grow the vocabulary here, updating the conservation gates):
 
 - ``dropped``      — infrastructure loss: a full bounded queue at the
@@ -24,6 +24,10 @@ one (or grow the vocabulary here, updating the conservation gates):
 - ``shed``         — deliberate backpressure: the pipeline chose to
                      drop under sustained overload rather than block
                      its producer past the shed window.
+- ``sampled``      — degree-capped reservoir sampling at window close
+                     (ISSUE 7): request rows on edges cut because their
+                     dst exceeded ``degree_cap`` fan-in. Deliberate and
+                     deterministic — the hot-key defense, not a fault.
 
 ``reason`` sub-attribution is free-form ("shard2", "worker_crash") and
 feeds debugging; the conservation math uses only the cause totals.
@@ -43,7 +47,7 @@ class DropLedger:
     conservation with one read instead of chasing per-stage counters.
     """
 
-    CAUSES = ("dropped", "late", "quarantined", "shed")
+    CAUSES = ("dropped", "late", "quarantined", "shed", "sampled")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

@@ -382,6 +382,27 @@ def bench_ingest(args) -> dict:
         print(f"# chaos suite crashed: {exc!r}", file=sys.stderr)
         chaos_report, chaos_findings = None, -1
 
+    # scenario gates ride along too (ISSUE 7): every round runs the
+    # host-plane leg of every incident scenario — deploy rollout, dns
+    # storm, hot key (degree-capped), retry storm, backpressure wave —
+    # at gate scale and reports the finding count (expected: 0), so a
+    # regression in the pathological-shape defenses is as loud as a
+    # perf cliff. Detection legs are the training-cost half and run in
+    # `make scenarios` / --scenario instead.
+    try:
+        from alaz_tpu.replay.incidents import run_scenario_suite
+
+        scenario_reports = run_scenario_suite(
+            seed=chaos_seed, n_workers=max(2, args.workers), detection=False
+        )
+        scenario_findings = sum(len(r.findings) for r in scenario_reports)
+        for r in scenario_reports:
+            for f in r.findings:
+                print(f"# scenario finding: {f}", file=sys.stderr)
+    except Exception as exc:  # a crashed suite is itself a finding
+        print(f"# scenario suite crashed: {exc!r}", file=sys.stderr)
+        scenario_findings = -1
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -393,6 +414,7 @@ def bench_ingest(args) -> dict:
         "jit_compile_count": compile_watcher.total if compile_watcher else 0,
         "abi_findings": abi_findings,
         "chaos_findings": chaos_findings,
+        "scenario_findings": scenario_findings,
     }
     if worker_scaling is not None:
         out["workers"] = args.workers
@@ -414,6 +436,52 @@ def bench_ingest(args) -> dict:
             "findings": chaos_report.findings,
         }
     return out
+
+
+def bench_scenario(args) -> dict:
+    """One incident scenario's full eval record (ISSUE 7): the host leg
+    at STRESS scale (hot_key runs the 500k-fan-in acceptance bound,
+    degree-capped) for rows/s + p99 close latency + the ledger
+    breakdown, and the detection leg for blended AUROC. One JSON line;
+    scenario_findings expected 0."""
+    from alaz_tpu.config import ScenarioConfig
+    from alaz_tpu.replay.incidents import HotKey, run_incident_scenario
+
+    scfg = ScenarioConfig.from_env()
+    incident = None
+    degree_cap = None
+    if args.scenario == "hot_key":
+        # SCENARIO_HOT_KEY_FANIN / SCENARIO_DEGREE_CAP re-scale the bound
+        incident = HotKey(args.seed, fan_in=scfg.hot_key_fanin)
+        degree_cap = scfg.degree_cap
+    rep = run_incident_scenario(
+        args.scenario,
+        seed=args.seed,
+        n_workers=max(2, args.workers),
+        scale="stress",
+        detection=True,
+        incident=incident,
+        degree_cap=degree_cap,
+    )
+    host = rep.host
+    for f in rep.findings:
+        print(f"# scenario finding: {f}", file=sys.stderr)
+    metric, unit = _metric_for(args)
+    return {
+        "metric": metric,
+        "value": host.get("rows_per_sec", 0),
+        "unit": unit,
+        "vs_baseline": round(host.get("rows_per_sec", 0) / 200_000, 3),
+        "seed": args.seed,
+        "degree_cap": host.get("degree_cap"),
+        "windows": host.get("windows"),
+        "p99_close_ms": round(host.get("close_p99_s", 0.0) * 1e3, 2),
+        "max_emitted_indegree": host.get("max_emitted_indegree"),
+        "drop_ledger": host.get("ledger", {}),
+        "blended_auroc": rep.detection.get("auroc"),
+        "auroc_gate": rep.detection.get("gate"),
+        "scenario_findings": len(rep.findings),
+    }
 
 
 def bench_e2e(args) -> dict:
@@ -544,6 +612,8 @@ def bench_probe(args) -> dict:
 def _metric_for(args) -> tuple[str, str]:
     """The single source of the (metric, unit) names the run will print —
     shared by the result payloads and the watchdog's error line."""
+    if getattr(args, "scenario", None):
+        return f"scenario_{args.scenario}_rows_per_sec", "rows/s"
     if getattr(args, "ingest", False):
         name = "ingest_rows_per_sec"
         if getattr(args, "ingest_scalar", False):
@@ -856,6 +926,13 @@ def main() -> None:
     p.add_argument("--ingest", action="store_true",
                    help="CPU-only host-ingest microbench (L7 trace → "
                         "process_l7 → window close); no accelerator needed")
+    p.add_argument("--scenario", default=None, metavar="NAME",
+                   help="run one incident scenario's eval record "
+                        "(replay/incidents.py) at stress scale: rows/s, "
+                        "p99 close latency, ledger breakdown, blended "
+                        "AUROC. hot_key runs the 500k-fan-in bound")
+    p.add_argument("--seed", type=int, default=0,
+                   help="with --scenario: the scenario seed")
     p.add_argument("--chaos", type=int, default=None, metavar="SEED",
                    help="with --ingest: run the chaos suite at this seed "
                         "and record degraded-mode throughput + the drop-"
@@ -888,7 +965,8 @@ def main() -> None:
 
     # modes the staged parent cannot represent run direct (old behavior);
     # the bare invocation — what the driver makes — is staged
-    if not (args.direct or args.e2e or args.ingest or args.profile or args.probe_only):
+    if not (args.direct or args.e2e or args.ingest or args.profile
+            or args.probe_only or args.scenario):
         # an explicit --watchdog-s tighter than the stage budget bounds
         # the whole staged run (the pre-rework meaning of the flag);
         # 0 still means "no watchdog", not "no budget"
@@ -908,6 +986,8 @@ def main() -> None:
 
     if args.probe_only:
         out = bench_probe(args)
+    elif args.scenario:
+        out = bench_scenario(args)
     elif args.ingest:
         out = bench_ingest(args)
     elif args.e2e:

@@ -538,3 +538,91 @@ class TestRetraceBudget:
         svc2 = Service(config=RuntimeConfig(**cfg), model_state=params)
         assert svc1._score_fn is svc2._score_fn
         assert svc1._score_many_fn is svc2._score_many_fn
+
+
+class TestTgnRetraceOverScenarioStream:
+    def test_tgn_budget_holds_over_capped_incident_window_stream(self):
+        """The ISSUE 6 carried-over follow-up, closed with ISSUE 7's
+        streams: the TGN serving budget proven over a REAL window
+        stream — hot_key + backpressure_wave shaped traffic through the
+        real aggregator/store with the degree cap armed — instead of
+        the synthetic bucket sweeps. This is exactly the bucket-churn
+        stress the sweeps missed: uncapped, the hot window mints a
+        fresh giant bucket (a compile per incident — the production
+        retrace storm); capped, the bucket set stays CLOSED, warmup
+        compiles once per bucket, and the steady-state replay of the
+        same degraded stream compiles nothing."""
+        import jax
+        import jax.numpy as jnp
+
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.aggregator.engine import Aggregator
+        from alaz_tpu.config import ModelConfig, SimulationConfig
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.graph.builder import WindowedGraphStore
+        from alaz_tpu.models import tgn
+        from alaz_tpu.models.registry import get_model
+        from alaz_tpu.replay.incidents import (
+            BackpressureWave,
+            HotKey,
+            base_traffic,
+            replay_delivery,
+        )
+        from alaz_tpu.replay.simulator import Simulator
+
+        interner = Interner()
+        sim = Simulator(
+            SimulationConfig(
+                pod_count=24, service_count=6, edge_count=48,
+                edge_rate=60, test_duration_s=6.0, chunk_size=2048, seed=11,
+            ),
+            interner=interner,
+        )
+        kube = sim.setup()
+        traffic = base_traffic(sim)
+        traffic = HotKey(seed=2, fan_in=500, hot_windows=(2, 3)).apply(sim, traffic)
+        traffic = BackpressureWave(seed=2, compress=2, jumbo=3).apply(sim, traffic)
+
+        cluster = ClusterInfo(interner)
+        for m in kube:
+            cluster.handle_msg(m)
+        closed: list = []
+        store = WindowedGraphStore(
+            interner, window_s=1.0, on_batch=closed.append,
+            degree_cap=64, sample_seed=2,
+        )
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        agg.process_tcp(traffic.tcp)
+        for d in traffic.deliveries:
+            replay_delivery(agg, d)
+        store.flush()
+        assert len(closed) >= 3
+        assert store.builder.sampled_rows > 0, "the cap never bit — vacuous"
+
+        # the capped stream's bucket set must be CLOSED and small — this
+        # is what bounds the compile budget below
+        shapes = sorted({(b.node_feats.shape[0], b.edge_feats.shape[0]) for b in closed})
+        assert len(shapes) <= 4, shapes
+        max_nodes = max(s[0] for s in shapes)
+
+        cfg = ModelConfig(
+            model="tgn", hidden_dim=24, use_pallas=False,
+            tgn_max_nodes=max_nodes,
+        )
+        tgn_init, _ = get_model("tgn")
+        params = tgn_init(jax.random.PRNGKey(3), cfg)
+        step = tgn.make_step_fn(cfg)
+
+        def serve(mem):
+            for b in closed:
+                g = {k: jnp.asarray(v) for k, v in b.device_arrays().items()}
+                out, mem = step(params, g, mem)
+                np.asarray(out["edge_logits"])
+            return mem
+
+        with CompileWatcher() as w:
+            memory = serve(tgn.init_memory(cfg, max_nodes=cfg.tgn_max_nodes))
+            assert w.count("tgn_step") == len(shapes), (w.counts, shapes)
+            with no_implicit_transfers():
+                with retrace_budget({"tgn_step": 0}, watcher=w):
+                    serve(memory)  # steady state: same stream, new data

@@ -375,9 +375,12 @@ def wire_layout_table() -> dict:
     """The generated half of resources/specs/wire_layouts.json: frame
     header contract (sources/ingest_server.py) + every wire dtype's
     layout string (events/schema.py + graph/native.py)."""
+    from alaz_tpu.config import RuntimeConfig
     from alaz_tpu.events import schema
+    from alaz_tpu.graph import builder as builder_mod
     from alaz_tpu.graph import native as gn
     from alaz_tpu.sources import ingest_server as srv
+    from alaz_tpu.utils.ledger import DropLedger
 
     dtypes = {
         name: schema.dtype_layout(dt, name)
@@ -412,6 +415,25 @@ def wire_layout_table() -> dict:
         "native_export_columns": {
             "alz_close_window": list(gn.CLOSE_WINDOW_COLUMNS),
             "alz_export_nodes": list(gn.EXPORT_NODES_COLUMNS),
+        },
+        # degree-capped sampling contract (ISSUE 7): the export's
+        # binding signature, the mix64 priority-hash constants BOTH
+        # backends must share (builder.py is the source; ingest.cc is
+        # cross-checked by check_sampling_constants — a drifted hash
+        # would make native/numpy select different samples silently),
+        # the config surface the cap rides, and the closed drop-cause
+        # vocabulary the sampler's `sampled` attribution extends.
+        "sampling": {
+            "export": "alz_sample_degree_cap",
+            "signature": gn.export_signatures()["alz_sample_degree_cap"],
+            "priority_mix": [
+                f"0x{builder_mod._MIX_C1:016X}",
+                f"0x{builder_mod._MIX_C2:016X}",
+            ],
+            "config_field": "degree_cap",
+            "env": "ALAZ_TPU_DEGREE_CAP",
+            "default": int(RuntimeConfig().degree_cap),
+            "ledger_causes": list(DropLedger.CAUSES),
         },
     }
 
@@ -468,6 +490,7 @@ def check_wire_layouts(
                 "native_export_columns",
                 REPO / "alaz_tpu" / "graph" / "native.py",
             ),
+            ("sampling", REPO / "alaz_tpu" / "graph" / "builder.py"),
         ):
             live_sec = live.get(section, {})
             gold_sec = golden.get(section)
@@ -767,6 +790,36 @@ def check_enums(cc_path: Path = INGEST_CC) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 
+def check_sampling_constants(cc_path: Path = INGEST_CC) -> List[Finding]:
+    """ALZ022-family: the degree-cap sampling priority hash must be the
+    SAME function on both sides — graph/builder.py's vectorized mix64
+    (the priority source) and native/ingest.cc's mix64 (the core's hash
+    family the selection comparator was verified against). A constant
+    edited on one side only would make the numpy fallback and the C++
+    path draw different samples with no error anywhere — the worst kind
+    of drift, so it fails tier-1 here instead."""
+    from alaz_tpu.graph import builder as builder_mod
+
+    text = cc_path.read_text().lower()
+    out: List[Finding] = []
+    for const in (builder_mod._MIX_C1, builder_mod._MIX_C2):
+        if f"0x{const:016x}" not in text:
+            out.append(
+                Finding(
+                    "ALZ022",
+                    f"sampling-priority mix64 constant 0x{const:016X} "
+                    "(graph/builder.py) not found in ingest.cc — the "
+                    "native and numpy degree-cap samplers would draw "
+                    "DIFFERENT samples; keep the constants identical on "
+                    "both sides",
+                    str(cc_path),
+                    1,
+                    0,
+                )
+            )
+    return out
+
+
 def check_abi(
     cc_path: Path = INGEST_CC, check_binary: bool = True
 ) -> List[Finding]:
@@ -777,6 +830,7 @@ def check_abi(
         + check_export_buffers(cc_path)
         + check_wire_layouts()
         + check_enums(cc_path)
+        + check_sampling_constants(cc_path)
     )
     if check_binary:
         findings += check_binary_stamps(cc_path.parent)
