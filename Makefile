@@ -7,7 +7,7 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint sanitize abi-check chaos scenarios specs image image-multiarch bench
+.PHONY: native test lint sanitize abi-check flow chaos scenarios specs image image-multiarch bench
 
 native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
@@ -16,8 +16,11 @@ native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent e
 # main run skips their test files so the (not-cheap) stress and
 # spec-regen work isn't paid twice per invocation (tier-1 CI runs plain
 # `pytest tests/` and still covers both)
-test: lint sanitize abi-check chaos scenarios
+test: lint sanitize abi-check flow chaos scenarios
 	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py --ignore=tests/test_alazspec.py
+
+flow:  ## alazflow: whole-program row-conservation + blocking-discipline dataflow (ALZ040-ALZ044), incl. cause-vocabulary/metric-registry triangulation
+	python -m tools.alazflow --json
 
 chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates + one composed scenario×chaos case (no accelerator needed)
 	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2 --composed hot_key
@@ -35,7 +38,7 @@ specs:  ## regenerate golden specfiles + wire layout table (resources/specs) —
 	env JAX_PLATFORMS=cpu python -m tools.alazspec --write-specs
 
 lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 and spec hygiene ALZ024 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
-	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec --json
+	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec tools/alazflow --json
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check alaz_tpu tools; \
 	else \

@@ -402,7 +402,13 @@ def cmd_serve(args) -> int:
     print(f"serving; debug http on :{debug.port}", file=sys.stderr)
     try:
         if src is not None:
-            src.join()
+            # bounded-join poll, not one unbounded join (alazflow
+            # ALZ042): same wait-for-replay semantics, but the serve
+            # thread re-enters Python once a second — signals stay
+            # deliverable and a wedged replay thread is observable
+            # instead of absorbing the process forever
+            while src.alive():
+                src.join(1.0)
             svc.drain(30)
             svc.flush_windows()
             svc.drain(30)

@@ -197,8 +197,20 @@ class Aggregator:
         config: Optional[RuntimeConfig] = None,
         cluster: Optional[ClusterInfo] = None,
         proc_root: str | None = None,
+        ledger=None,
     ):
         self.ds = ds
+        # unified loss accounting (ISSUE 8): the join/attribution stage's
+        # semantic drops (no socket after retries, non-pod source, rate
+        # limit) land in the shared ledger's `filtered` cause, so
+        # pushed == emitted + ledger.total holds with no side-channel
+        # "semantic" term. A private ledger when the caller has none —
+        # the stats counters remain the per-reason observability surface.
+        if ledger is None:
+            from alaz_tpu.utils.ledger import DropLedger
+
+            ledger = DropLedger()
+        self.ledger = ledger
         self.interner = interner if interner is not None else Interner()
         self.config = config if config is not None else RuntimeConfig()
         # where tracked pids live: /proc by default, /host/proc when the
@@ -265,7 +277,7 @@ class Aggregator:
         interesting = (events["type"] == TcpEventType.ESTABLISHED) | (
             events["type"] == TcpEventType.CLOSED
         )
-        events = events[interesting]
+        events = events[interesting]  # alazlint: disable=ALZ040 -- TCP state events are control plane, not request rows; conservation counts L7 rows only and non-ESTABLISHED/CLOSED types carry no join state
         if events.shape[0] == 0:
             return
         _, starts, inverse = np.unique(
@@ -274,7 +286,7 @@ class Aggregator:
         alive_rows = []
         closed_pairs: set[tuple[int, int]] = set()
         for g, start in enumerate(starts):
-            rows = events[inverse == g]
+            rows = events[inverse == g]  # alazlint: disable=ALZ040 -- per-connection grouping: every group is visited, no event leaves the loop unprocessed
             pid = int(rows["pid"][0])
             fd = int(rows["fd"][0])
             line = self.socket_lines.get_or_create(pid, fd)
@@ -442,6 +454,7 @@ class Aggregator:
         dropped = int((~keep).sum())
         if dropped:
             self.stats.l7_rate_limited += dropped
+            self.ledger.add("filtered", dropped, reason="rate_limit")
             events = events[keep]
         return events
 
@@ -519,7 +532,9 @@ class Aggregator:
                 self._retries.append((rows, attempts + 1, now_ns + backoff))
                 self.stats.l7_requeued += rows.shape[0]
             else:
-                self.stats.l7_dropped_no_socket += int(unmatched.sum())
+                lost = int(unmatched.sum())
+                self.stats.l7_dropped_no_socket += lost
+                self.ledger.add("filtered", lost, reason="no_socket")
             events = events[matched]
             saddr, sport = saddr[matched], sport[matched]
             daddr, dport = daddr[matched], dport[matched]
@@ -530,7 +545,9 @@ class Aggregator:
         from_type, from_uid = self.cluster.attribute(saddr)
         is_pod = from_type == EP_POD
         if not is_pod.all():
-            self.stats.l7_dropped_not_pod += int((~is_pod).sum())
+            lost = int((~is_pod).sum())
+            self.stats.l7_dropped_not_pod += lost
+            self.ledger.add("filtered", lost, reason="not_pod")
             events = events[is_pod]
             if events.shape[0] == 0:
                 return np.zeros(0, dtype=REQUEST_DTYPE)

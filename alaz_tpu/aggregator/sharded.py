@@ -372,6 +372,10 @@ class ShardedIngest:
                 interner=self.interner,
                 config=self.config,
                 cluster=self.cluster,
+                # semantic drops (filtered) join the SHARED ledger so the
+                # pipeline's conservation reads delivered == emitted +
+                # ledger.total with no per-worker side channel (ISSUE 8)
+                ledger=self.ledger,
             )
             for i in range(self.n)
         ]
@@ -582,8 +586,16 @@ class ShardedIngest:
         on a backlogged shard queue, then SHED the rows to the ledger —
         a stalled or dead worker must cost data (attributed), never
         wedge the submitting thread (the drop-not-block contract, one
-        hop deeper)."""
-        if self._queues[i].put(item, timeout=self.shed_block_s):
+        hop deeper). A queue closed by a racing stop() drops the item's
+        rows too — ATTRIBUTED (alazflow ALZ043 found the old bare
+        ``except QueueClosed: pass`` losing them untracked): per-item
+        here, so shards that enqueued before the close keep their exact
+        counts and only the rows that truly never landed are ledgered."""
+        try:
+            if self._queues[i].put(item, timeout=self.shed_block_s):
+                return
+        except QueueClosed:
+            self.ledger.add("dropped", len(item), reason="closed")
             return
         n = len(item)
         self.ledger.add("shed", n, reason=f"shard{i}_backlog")
@@ -607,8 +619,6 @@ class ShardedIngest:
                     # and doing it here would serialize N copies on the
                     # submitting thread
                     self._put_or_shed(i, _QItem(kind, (events, idx), now_ns))
-        except QueueClosed:
-            pass  # racing a stop(): drop, like every closed-edge submit
         finally:
             with self._wm_cond:
                 self._inflight -= 1
@@ -686,6 +696,14 @@ class ShardedIngest:
                     self.ledger.add("dropped", len(item), reason="worker_crash")
                 raise
             except Exception as exc:  # keep the shard alive; mirror service workers
+                # the failed batch's rows reach neither emit nor retry —
+                # attribute them (alazflow ALZ043) so conservation holds
+                # through a poison batch, not just through injected
+                # crashes. Attribution errs toward overcounting when the
+                # engine emitted part of the batch before raising; a
+                # negative gap is the loud failure mode, not a silent one.
+                if kind in ("l7", "tcp"):
+                    self.ledger.add("dropped", len(item), reason="batch_error")
                 log.warning(f"shard{i} {kind} batch failed: {exc}")
             finally:
                 q.task_done()
@@ -764,7 +782,7 @@ class ShardedIngest:
             None if timeout_s is None else time.monotonic() + timeout_s
         )
         if timeout_s is None:
-            self._merge_lock.acquire()  # alazlint: disable=ALZ012 -- paired with the finally below; the timeout branch needs acquire(timeout=...) and `with` can't express it
+            self._merge_lock.acquire()  # alazlint: disable=ALZ012,ALZ042 -- paired with the finally below; the timeout branch needs acquire(timeout=...) and `with` can't express it. Unbounded only when the CALLER passed timeout_s=None, an explicit opt-in (every entry-surface caller passes a budget)
         elif not self._merge_lock.acquire(timeout=timeout_s):  # alazlint: disable=ALZ012 -- bounded acquire (a stalled merge must not wedge flush); released in the finally
             log.error(
                 f"close wave: merge lock not free within {timeout_s}s "

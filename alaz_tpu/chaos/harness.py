@@ -10,7 +10,8 @@ Three legs, each wrapping production code with an injector from
    - *bounded*: ``flush``/``drain`` return within their timeouts with
      workers killed mid-run (the supervisor restarts them);
    - *conservation*: delivered rows == emitted rows + drop-ledger total
-     (+ aggregator semantic drops, zero on this trace) — EXACT;
+     — EXACT (semantic aggregator drops ride the ledger's ``filtered``
+     cause since ISSUE 8, and must equal the stats counters);
    - *monotonic*: emitted windows strictly ascend; duplicate delivery
      never re-emits a window;
    - *self-healing*: injected crashes imply observed restarts.
@@ -173,17 +174,25 @@ def _run_pipeline_leg(
     )
     emitted = emitted_rows(closed)
     stats = pipe.stats.as_dict()
+    # semantic drops are ledgered as `filtered` now (ISSUE 8): the gate
+    # is exactly delivered == emitted + ledger.total, and the stats
+    # counters must agree with the ledgered cause (both gates below)
     semantic = (
         stats["l7_dropped_no_socket"]
         + stats["l7_dropped_not_pod"]
         + stats["l7_rate_limited"]
     )
-    gap = ledger.conservation_gap(delivered, emitted + semantic)
+    gap = ledger.conservation_gap(delivered, emitted)
     if gap != 0:
         findings.append(
             f"pipeline: row conservation broken — delivered={delivered} "
             f"emitted={emitted} semantic={semantic} "
             f"ledger={ledger.snapshot()} gap={gap}"
+        )
+    if ledger.count("filtered") != semantic:
+        findings.append(
+            f"pipeline: filtered-ledger drift — stats say {semantic} "
+            f"semantic drops, ledger says {ledger.count('filtered')}"
         )
     starts = [b.window_start_ms for b in closed]
     if any(b <= a for a, b in zip(starts, starts[1:])):

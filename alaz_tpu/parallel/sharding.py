@@ -62,6 +62,46 @@ def param_pspec(params: Any, tp: int = 1, ep: int = 1) -> Any:
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def _path_keys(path: tuple) -> tuple:
+    """Normalize a tree_util key path to a tuple of strings — DictKey
+    carries .key, GetAttrKey .name, SequenceKey .idx."""
+    return tuple(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path
+    )
+
+
+def opt_state_pspec(opt_state: Any, params: Any, tp: int = 1, ep: int = 1) -> Any:
+    """PartitionSpecs for an optax state tree: moment leaves (mu/nu/...)
+    mirror their parameter and shard LIKE it; bookkeeping scalars
+    (step counts, empty states) replicate.
+
+    Matching is by trailing key path — optax nests the full param path
+    under each stat field (``0/mu/<param path>``), so the longest
+    suffix of an opt-state leaf path that names a param (with an equal
+    shape) carries that param's spec. This is the train-side half of
+    the golden contract: the serve-side shard_map specs were pinned in
+    ISSUE 4, the optimizer state was "inferred by jit" — unpinned, so a
+    resharding could ship silently. `make specs` now pins it
+    (resources/specs/<model>_train.json, ALZ023)."""
+    p_spec = param_pspec(params, tp=tp, ep=ep)
+    param_table: dict[tuple, tuple] = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(p_spec)[0]
+    for (path, leaf), (_, spec) in zip(flat_p, flat_s):
+        param_table[_path_keys(path)] = (tuple(leaf.shape), spec)
+
+    def rule(path: tuple, leaf) -> P:
+        parts = _path_keys(path)
+        for i in range(len(parts)):
+            hit = param_table.get(parts[i:])
+            if hit is not None and hit[0] == tuple(leaf.shape):
+                return hit[1]
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, opt_state)
+
+
 def graph_pspec(stacked: bool = True) -> dict:
     """Graph-batch pytree spec: leading G axis sharded over 'dp'."""
     lead = ("dp",) if stacked else ()
@@ -122,7 +162,17 @@ def make_sharded_train_step(
     g_spec = graph_pspec(stacked=True)
 
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), p_spec)
-    opt_sh = None  # inferred by jit from params closure
+    # optimizer state placed EXPLICITLY, not left for jit to infer: the
+    # moments must live where their params live or the first update
+    # resheds the whole state (and the contract is pinned — ALZ023)
+    opt_example = jax.eval_shape(optimizer.init, params_example)
+    o_spec = opt_state_pspec(
+        opt_example,
+        params_example,
+        tp=mesh.shape.get("tp", 1),
+        ep=mesh.shape.get("ep", 1),
+    )
+    opt_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), o_spec)
     graph_sh = {k: NamedSharding(mesh, s) for k, s in g_spec.items()}
     label_sh = NamedSharding(mesh, P("dp", None))
 
@@ -145,6 +195,7 @@ def make_sharded_train_step(
 
     def run(params, opt_state, stacked_graph_np, labels_np):
         params = jax.device_put(params, param_sh)
+        opt_state = jax.device_put(opt_state, opt_sh)
         graph = {
             k: jax.device_put(jnp.asarray(v), graph_sh[k])
             for k, v in stacked_graph_np.items()

@@ -833,17 +833,26 @@ def run_host_leg(
     delivered = sum(len(d) for d in deliveries) + sum(len(d) for d in late)
     emitted = emitted_rows(closed)
     stats = pipe.stats.as_dict()
+    # semantic drops ride the ledger's `filtered` cause now (ISSUE 8) —
+    # the gate is exactly delivered == emitted + ledger.total, no second
+    # bookkeeper. The stats counters stay as the per-reason breakdown
+    # and the cross-check below pins them to the ledgered total.
     semantic = (
         stats["l7_dropped_no_socket"]
         + stats["l7_dropped_not_pod"]
         + stats["l7_rate_limited"]
     )
-    gap = ledger.conservation_gap(delivered, emitted + semantic)
+    gap = ledger.conservation_gap(delivered, emitted)
     if gap != 0:
         findings.append(
             f"{name}: row conservation broken — delivered={delivered} "
             f"emitted={emitted} semantic={semantic} "
             f"ledger={ledger.snapshot()} gap={gap}"
+        )
+    if ledger.count("filtered") != semantic:
+        findings.append(
+            f"{name}: filtered-ledger drift — stats say {semantic} "
+            f"semantic drops, ledger says {ledger.count('filtered')}"
         )
     starts = [b.window_start_ms for b in closed]
     if any(b <= a for a, b in zip(starts, starts[1:])):

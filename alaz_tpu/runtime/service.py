@@ -313,7 +313,12 @@ class Service:
                 sinks.append(export_backend)
             self.datastore = FanoutDataStore(sinks)
             self.aggregator = Aggregator(
-                self.datastore, interner=self.interner, config=self.config
+                self.datastore,
+                interner=self.interner,
+                config=self.config,
+                # semantic (filtered) drops join the service ledger so
+                # conservation needs no side-channel term (ISSUE 8)
+                ledger=self.ledger,
             )
 
         self.score_sink = score_sink

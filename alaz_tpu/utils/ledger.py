@@ -9,10 +9,11 @@ the ledger centralizes it behind one contract:
     every row the pipeline loses is attributed to EXACTLY ONE cause,
     and row conservation becomes a checkable invariant:
 
-        pushed == emitted + ledger.total (+ semantic aggregator drops)
+        pushed == emitted + ledger.total
 
-The five causes are closed-world on purpose — a new loss path must pick
-one (or grow the vocabulary here, updating the conservation gates):
+The causes are closed-world on purpose — a new loss path must pick one
+(or grow the vocabulary here, `make specs` for the wire table, and the
+metric registry, in ONE move — alazflow's ALZ041 pins all three sides):
 
 - ``dropped``      — infrastructure loss: a full bounded queue at the
                      source boundary, or rows in flight on a worker
@@ -28,6 +29,14 @@ one (or grow the vocabulary here, updating the conservation gates):
                      (ISSUE 7): request rows on edges cut because their
                      dst exceeded ``degree_cap`` fan-in. Deliberate and
                      deterministic — the hot-key defense, not a fault.
+- ``filtered``     — semantic aggregator rejection (ISSUE 8): rows the
+                     join/attribution stage dropped by design — no
+                     socket line after the retry ladder, non-pod
+                     source, per-pid rate limit. Previously a separate
+                     "semantic" side-channel (stats counters) the
+                     conservation gates had to add back in; ledgering
+                     them makes ``pushed == emitted + ledger.total``
+                     exact with no second bookkeeper.
 
 ``reason`` sub-attribution is free-form ("shard2", "worker_crash") and
 feeds debugging; the conservation math uses only the cause totals.
@@ -47,7 +56,7 @@ class DropLedger:
     conservation with one read instead of chasing per-stage counters.
     """
 
-    CAUSES = ("dropped", "late", "quarantined", "shed", "sampled")
+    CAUSES = ("dropped", "late", "quarantined", "shed", "sampled", "filtered")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()

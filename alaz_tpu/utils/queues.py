@@ -106,7 +106,7 @@ class BatchQueue:
         with self._not_full:
             while not self._closed and self._events + n > self.capacity and self._events > 0:
                 if deadline is None:
-                    self._not_full.wait()
+                    self._not_full.wait()  # alazlint: disable=ALZ042 -- the timeout=None branch is the caller's explicit opt-in to block (interior stages where backpressure is safe); every ingest/flush/close-reachable call site passes a deadline, which ALZ042 checks AT those sites
                     continue
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

@@ -403,6 +403,17 @@ def bench_ingest(args) -> dict:
         print(f"# scenario suite crashed: {exc!r}", file=sys.stderr)
         scenario_findings = -1
 
+    # the static conservation contract rides along too (ISSUE 8): the
+    # alazflow pass over the tree (unledgered drops, off-vocabulary
+    # causes, unbounded blocking, rogue metric names) must report 0,
+    # or the measured pipeline is one whose drop accounting can drift
+    try:
+        from tools.alazflow.driver import DEFAULT_PATHS, flow_paths
+
+        flow_findings = len(flow_paths(list(DEFAULT_PATHS), tree_mode=True))
+    except Exception:  # repo layout unavailable (installed wheel): skip
+        flow_findings = -1
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -415,6 +426,7 @@ def bench_ingest(args) -> dict:
         "abi_findings": abi_findings,
         "chaos_findings": chaos_findings,
         "scenario_findings": scenario_findings,
+        "flow_findings": flow_findings,
     }
     if worker_scaling is not None:
         out["workers"] = args.workers

@@ -1,0 +1,45 @@
+"""ALZ040 clean fixture: every row discard is ledger-attributed —
+directly, through a helper, or is no discard at all (gathers and
+permutations move rows, they don't lose them)."""
+import numpy as np
+
+
+def attribute_cut(ledger, n, reason):
+    """A helper may ledger on the caller's behalf — the closure over the
+    call graph keeps the caller clean."""
+    ledger.add("shed", n, reason=reason)
+
+
+class Stage:
+    def __init__(self, ledger):
+        self.ledger = ledger
+
+    def process_l7(self, events):
+        # filter WITH direct attribution: conservation holds
+        keep = events["status"] < 500
+        cut = int((~keep).sum())
+        if cut:
+            self.ledger.add("dropped", cut, reason="bad_status")
+        events = events[keep]
+        return events
+
+    def process_tcp(self, rows, cap):
+        # attribution routed through the helper
+        cut = max(0, rows.shape[0] - 100)
+        if cut:
+            attribute_cut(self.ledger, cut, "cap")
+        rows = rows[:100]
+        return rows
+
+    def flush(self, batch):
+        # permutation + gather: every row survives, nothing to ledger
+        order = np.argsort(batch["start_time_ms"], kind="stable")
+        batch = batch[order]
+        idx = np.flatnonzero(batch["latency_ns"])
+        return batch[idx]
+
+    def drain(self, events):
+        # control-plane filter, deliberately out of the conservation
+        # equation: the justified-disable escape hatch
+        events = events[events["kind"] == 2]  # alazlint: disable=ALZ040 -- control events, not request rows; conservation counts L7 rows only
+        return events

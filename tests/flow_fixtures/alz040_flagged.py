@@ -1,0 +1,24 @@
+"""ALZ040 flagged fixture: row-bearing data discarded with no
+call-graph path to DropLedger.add. Bare-stem module = row-plane scope."""
+
+
+class Stage:
+    def __init__(self, ledger):
+        self.errors = 0
+
+    def process_l7(self, events):
+        # boolean-mask filter: the cut rows vanish from conservation
+        keep = events["status"] < 500
+        events = events[keep]  # alz-expect: ALZ040
+        return events
+
+    def process_tcp(self, rows, cap):
+        # truncating slice: rows past the cap are silently gone
+        rows = rows[:100]  # alz-expect: ALZ040
+        return rows
+
+    def flush(self, batch):
+        # inline comparison mask, no intermediate name
+        batch = batch[batch["latency_ns"] > 0]  # alz-expect: ALZ040
+        self.errors += 1
+        return batch

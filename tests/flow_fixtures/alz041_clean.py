@@ -1,0 +1,18 @@
+"""ALZ041 clean fixture: every cause literal is drawn from
+DropLedger.CAUSES; non-literal causes are runtime-checked by the ledger
+itself (add() raises on unknowns) and not the static rule's business."""
+
+
+class Mouth:
+    def __init__(self, ledger, queue_cls):
+        self.ledger = ledger
+        self.q = queue_cls(100, "q", drop_cause="dropped")
+
+    def on_overflow(self, n):
+        self.ledger.add("shed", n, reason="overflow")
+
+    def on_late(self, n):
+        self.ledger.add(cause="late", n=n)
+
+    def on_routed(self, cause, n):
+        self.ledger.add(cause, n)  # vocabulary enforced at runtime

@@ -1,0 +1,16 @@
+"""ALZ041 flagged fixture: drop causes outside the closed vocabulary.
+An off-CAUSES literal raises at runtime ON THE DROP PATH — under an
+incident, exactly when the ledger must not fail."""
+
+
+class Mouth:
+    def __init__(self, ledger, queue_cls):
+        self.ledger = ledger
+        # the queue-mouth routing kw is vocabulary too
+        self.q = queue_cls(100, "q", drop_cause="evaporated")  # alz-expect: ALZ041
+
+    def on_overflow(self, n):
+        self.ledger.add("mystery", n)  # alz-expect: ALZ041
+
+    def on_cut(self, n):
+        self.ledger.add(cause="vanished", n=n)  # alz-expect: ALZ041

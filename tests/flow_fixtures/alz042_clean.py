@@ -1,0 +1,43 @@
+"""ALZ042 clean fixture: the same primitives with deadlines — plus an
+offline tool that blocks on purpose OUTSIDE the entry surface, which
+reachability keeps legal."""
+import threading
+
+from alaz_tpu.utils.queues import BatchQueue
+
+
+class Pipeline:
+    def __init__(self):
+        self.q = BatchQueue(1 << 10, "stage")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread = threading.Thread(target=self._pump)
+
+    def submit_l7(self, batch):
+        if not self.q.put(batch, timeout=5.0):
+            return False  # shed upstream: drop-not-block
+        return True
+
+    def flush(self):
+        if not self._lock.acquire(timeout=10.0):  # alazlint: disable=ALZ012 -- bounded acquire; `with` can't express the timeout form
+            return False
+        try:
+            while not self._ready():
+                self._cond.wait(0.2)
+        finally:
+            self._lock.release()
+        return True
+
+    def stop(self):
+        self._thread.join(timeout=2)
+
+    def _ready(self):
+        return True
+
+    def _pump(self):
+        return self.q.get(timeout=0.1)
+
+    def offline_repl(self):
+        # not reachable from any entry point: blocking is this tool's
+        # contract, not a serving hazard
+        return self.q.get()

@@ -1,0 +1,34 @@
+"""ALZ042 flagged fixture: unbounded blocking primitives on paths
+reachable from the ingest/flush/close entry surface."""
+import threading
+
+from alaz_tpu.utils.queues import BatchQueue
+
+
+class Pipeline:
+    def __init__(self):
+        self.q = BatchQueue(1 << 10, "stage")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread = threading.Thread(target=self._pump)
+
+    def submit_l7(self, batch):
+        # the PR 6 bug shape: a full queue wedges the producer forever
+        self.q.put(batch)  # alz-expect: ALZ042
+
+    def flush(self):
+        self._lock.acquire()  # alz-expect: ALZ042
+        try:
+            while not self._ready():
+                self._cond.wait()  # alz-expect: ALZ042
+        finally:
+            self._lock.release()
+
+    def stop(self):
+        self._thread.join()  # alz-expect: ALZ042
+
+    def _ready(self):
+        return True
+
+    def _pump(self):
+        return self.q.get(timeout=0.1)

@@ -1,0 +1,41 @@
+"""ALZ043 clean fixture: every exception edge either attributes the
+rows, re-raises to the supervisor, or returns them onward."""
+from alaz_tpu.utils.queues import BatchQueue
+
+
+class Crash(BaseException):
+    pass
+
+
+def handle(batch):
+    pass
+
+
+class ShardWorker:
+    def __init__(self, ledger):
+        self.q = BatchQueue(1 << 12, "shard")
+        self.ledger = ledger
+
+    def _worker_loop(self):
+        while True:
+            batch = self.q.get(timeout=0.1)
+            if batch is None:
+                return
+            try:
+                handle(batch)
+            except Crash:
+                # attribute, THEN die: conservation survives the crash
+                self.ledger.add("dropped", len(batch), reason="crash")
+                raise
+            except Exception:
+                self.ledger.add("dropped", len(batch), reason="batch_error")
+
+    def _drain_loop(self):
+        while True:
+            rows = self.q.get(timeout=0.1)
+            if rows is None:
+                return
+            try:
+                handle(rows)
+            except ValueError:
+                return rows  # routed back to the caller, rows intact

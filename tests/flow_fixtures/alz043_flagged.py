@@ -1,0 +1,37 @@
+"""ALZ043 flagged fixture: exception edges that abandon in-flight rows.
+The worker stays alive — which is exactly why the loss is silent."""
+from alaz_tpu.utils.queues import BatchQueue
+
+
+def log(msg):
+    pass
+
+
+def handle(batch):
+    pass
+
+
+class ShardWorker:
+    def __init__(self, ledger):
+        self.q = BatchQueue(1 << 12, "shard")
+        self.ledger = ledger
+
+    def _worker_loop(self):
+        while True:
+            batch = self.q.get(timeout=0.1)
+            if batch is None:
+                return
+            try:
+                handle(batch)
+            except Exception as exc:  # alz-expect: ALZ043
+                log(f"batch failed: {exc}")  # routed — but the ROWS are gone
+
+    def _drain_loop(self):
+        while True:
+            rows = self.q.get(timeout=0.1)
+            if rows is None:
+                return
+            try:
+                handle(rows)
+            except ValueError:  # alz-expect: ALZ043
+                continue

@@ -1,0 +1,18 @@
+"""ALZ044 clean fixture: literal names from the golden registry, and an
+f-string whose constant skeleton matches a registered wildcard."""
+
+
+class Stage:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def register(self, metrics, ledger):
+        metrics.gauge("ledger.total", lambda: ledger.total)
+        self.metrics.counter("l7.in").inc()
+        for cause in ledger.CAUSES:
+            # constant skeleton "ledger.*" — a registered wildcard
+            metrics.gauge(f"ledger.{cause}", lambda c=cause: ledger.count(c))
+
+    def register_elsewhere(self, registry, name):
+        # not a metrics receiver: out of the rule's jurisdiction
+        registry.gauge(name)

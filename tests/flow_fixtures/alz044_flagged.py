@@ -1,0 +1,14 @@
+"""ALZ044 flagged fixture: metric names outside the golden registry —
+a dashboard keyed on the closed name set can never see these."""
+
+
+class Stage:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def register(self, metrics):
+        metrics.gauge("rogue.gauge")  # alz-expect: ALZ044
+        self.metrics.counter("sneaky.counter").inc()  # alz-expect: ALZ044
+
+    def register_dynamic(self, metrics, name):
+        metrics.gauge("stage." + name)  # alz-expect: ALZ044

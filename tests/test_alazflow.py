@@ -1,0 +1,365 @@
+"""alazflow: the row-conservation + blocking-discipline gate (ISSUE 8).
+
+Four halves:
+
+1. Fixture corpus — every ALZ04x rule proven by a flagged fixture
+   (``# alz-expect: ALZ04x`` markers, asserted by code AND line) and a
+   clean twin exercising the legal counterpart (ledgered filters,
+   helper attribution, deadlines, reachability scoping, registered
+   metric names, the justified-disable escape hatch).
+
+2. Whole-program — the cross-module half of ALZ040: a drop in module A
+   attributed by a helper in module B stays clean; remove the helper
+   call and the discard line is flagged.
+
+3. Golden triangulation — DropLedger.CAUSES ↔ the alazspec wire table ↔
+   the metric registry carry ONE vocabulary; injected drift on any side
+   is a finding; ``--write-metrics`` is a byte fixpoint on a clean tree.
+
+4. Self-enforcement + the fixes the analyzer forced: alaz_tpu/ and
+   tools/alazflow lint flow-clean in tier-1, and the ledger attribution
+   the true findings demanded (engine filtered drops, sharded poison
+   batches, closed-queue scatter) is regression-locked.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tools.alazflow import flow_paths, flow_source
+from tools.alazflow import vocabrules
+from tools.alazflow.driver import DEFAULT_PATHS, _parse, main as alazflow_main
+from tools.alazlint.rules import PROGRAM_RULES, RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "flow_fixtures"
+
+_EXPECT_RE = re.compile(r"alz-expect:\s*(ALZ\d{3})")
+
+PAIRED_CODES = ["ALZ040", "ALZ041", "ALZ042", "ALZ043", "ALZ044"]
+
+
+def _expected(path: Path) -> set:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _EXPECT_RE.finditer(line):
+            out.add((i, m.group(1)))
+    return out
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("code", PAIRED_CODES)
+    def test_flagged_fixture_findings_match_exactly(self, code):
+        path = FIXTURES / f"{code.lower()}_flagged.py"
+        expected = _expected(path)
+        assert expected, f"{path.name} carries no alz-expect markers"
+        got = {
+            (f.line, f.code) for f in flow_source(str(path), path.read_text())
+        }
+        assert got == expected
+
+    @pytest.mark.parametrize("code", PAIRED_CODES)
+    def test_clean_fixture_is_clean(self, code):
+        path = FIXTURES / f"{code.lower()}_clean.py"
+        findings = flow_source(str(path), path.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+    def test_rule_catalog_registers_the_alazflow_family(self):
+        catalog = {**RULES, **PROGRAM_RULES}
+        for code in PAIRED_CODES:
+            assert code in catalog, f"{code} missing from the registry"
+        # append-only discipline: the family summaries name their driver
+        assert "DropLedger" in RULES["ALZ040"].summary
+
+    def test_disable_requires_matching_code(self):
+        src = (
+            "def process_l7(events):\n"
+            "    keep = events['status'] < 500\n"
+            "    events = events[keep]  # alazlint: disable=ALZ042 -- wrong code\n"
+            "    return events\n"
+        )
+        codes = {f.code for f in flow_source("t.py", src)}
+        assert "ALZ040" in codes  # a disable for a DIFFERENT code keeps it
+
+
+_MOD_A = (
+    "from helpers import attribute_cut\n"
+    "class Stage:\n"
+    "    def __init__(self, ledger):\n"
+    "        self.ledger = ledger\n"
+    "    def process_l7(self, events):\n"
+    "        keep = events['status'] < 500\n"
+    "        cut = int((~keep).sum())\n"
+    "        attribute_cut(self.ledger, cut)\n"
+    "        events = events[keep]\n"
+    "        return events\n"
+)
+_MOD_B = (
+    "def attribute_cut(ledger, n):\n"
+    "    if n:\n"
+    "        ledger.add('dropped', n, reason='bad_status')\n"
+)
+
+
+class TestCrossModule:
+    """ISSUE 8 satellite: ALZ040 closed over the call graph ACROSS
+    modules — the analyzer must recognize a helper that ledgers on the
+    caller's behalf, and must flag the same drop when the call goes."""
+
+    def test_helper_in_other_module_keeps_caller_clean(self, tmp_path):
+        (tmp_path / "stage.py").write_text(_MOD_A)
+        (tmp_path / "helpers.py").write_text(_MOD_B)
+        findings = flow_paths([str(tmp_path)])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_removing_the_helper_call_flags_the_discard_line(self, tmp_path):
+        (tmp_path / "stage.py").write_text(
+            _MOD_A.replace("        attribute_cut(self.ledger, cut)\n", "")
+        )
+        (tmp_path / "helpers.py").write_text(_MOD_B)
+        findings = flow_paths([str(tmp_path)])
+        got = [(Path(f.path).name, f.line, f.code) for f in findings]
+        # the discard line moved up one after removing the helper call
+        assert got == [("stage.py", 8, "ALZ040")]
+
+
+class TestTriangulation:
+    def test_tree_vocabulary_triangulates(self):
+        # code CAUSES == wire-table causes, every cause gauged
+        findings = list(vocabrules.check_alz041([], triangulate=True))
+        assert findings == [], [f.render() for f in findings]
+
+    def test_wire_table_drift_is_flagged(self, tmp_path):
+        wire = json.loads(
+            (REPO / "resources" / "specs" / "wire_layouts.json").read_text()
+        )
+        wire["sampling"]["ledger_causes"] = wire["sampling"]["ledger_causes"][:-1]
+        doctored = tmp_path / "wire_layouts.json"
+        doctored.write_text(json.dumps(wire))
+        findings = list(
+            vocabrules.check_alz041([], triangulate=True, wire_table=doctored)
+        )
+        assert [f.code for f in findings] == ["ALZ041"]
+        assert "ledger_causes" in findings[0].message
+
+    def test_cause_without_gauge_is_flagged(self, tmp_path):
+        golden = json.loads(
+            (REPO / "resources" / "specs" / "metrics.json").read_text()
+        )
+        golden["names"] = [
+            n for n in golden["names"] if not n.startswith("ledger")
+        ]
+        doctored = tmp_path / "metrics.json"
+        doctored.write_text(json.dumps(golden))
+        findings = list(
+            vocabrules.check_alz041(
+                [], triangulate=True, metrics_golden=doctored
+            )
+        )
+        from alaz_tpu.utils.ledger import DropLedger
+
+        assert len(findings) == len(DropLedger.CAUSES)
+        assert all(f.code == "ALZ041" for f in findings)
+
+    def test_metrics_golden_is_a_regen_fixpoint(self, tmp_path):
+        ctxs, _ = _parse([str(REPO / "alaz_tpu")])
+        fresh = vocabrules.write_metrics_golden(ctxs, tmp_path / "metrics.json")
+        golden = REPO / "resources" / "specs" / "metrics.json"
+        assert fresh.read_bytes() == golden.read_bytes(), (
+            "metric registry drifted — regenerate with "
+            "`python -m tools.alazflow --write-metrics` and review"
+        )
+
+    def test_stale_golden_name_is_flagged(self, tmp_path):
+        golden = json.loads(
+            (REPO / "resources" / "specs" / "metrics.json").read_text()
+        )
+        golden["names"].append("zombie.gauge")
+        doctored = tmp_path / "metrics.json"
+        doctored.write_text(json.dumps(golden))
+        ctxs, _ = _parse([str(REPO / "alaz_tpu")])
+        findings = [
+            f
+            for f in vocabrules.check_alz044(
+                ctxs, completeness=True, metrics_golden=doctored
+            )
+            if "zombie.gauge" in f.message
+        ]
+        assert len(findings) == 1 and findings[0].code == "ALZ044"
+
+
+class TestSelfEnforcement:
+    def test_tree_is_flow_clean(self):
+        findings = flow_paths(list(DEFAULT_PATHS), tree_mode=True)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_json_mode_and_exit_codes(self, capsys):
+        rc = alazflow_main(["--json", str(REPO / "tools" / "alazflow")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["count"] == 0
+        rc = alazflow_main(["--json", str(FIXTURES / "alz040_flagged.py")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["count"] == len(out["findings"]) > 0
+        assert {"code", "message", "path", "line", "col"} <= set(
+            out["findings"][0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Regression locks for the true findings alazflow surfaced (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _engine(rate_limit=None):
+    from alaz_tpu.aggregator import Aggregator
+    from alaz_tpu.datastore.inmem import InMemDataStore
+    from alaz_tpu.events.intern import Interner
+    from tests.test_aggregator import _establish, make_cluster
+
+    interner = Interner()
+    agg = Aggregator(InMemDataStore(), interner=interner)
+    agg.cluster = make_cluster(interner)
+    if rate_limit is not None:
+        agg.rate_limit = rate_limit
+    _establish(agg)
+    return agg
+
+
+class TestLedgeredSemanticDrops:
+    """engine.process_l7's filter paths (rate limit / no-socket / not-pod)
+    used to count drops in stats only — alazflow's ALZ040 findings; they
+    now attribute to the ledger's `filtered` cause, so conservation is
+    pushed == emitted + ledger.total with no side-channel term."""
+
+    def test_rate_limited_rows_are_ledgered(self):
+        from tests.test_aggregator import _http_events
+
+        agg = _engine(rate_limit=(100.0, 1000.0))
+        agg.process_l7(_http_events(1500), now_ns=1_000_000_000)
+        assert agg.stats.l7_rate_limited == 500
+        assert agg.ledger.count("filtered") == 500
+        assert agg.ledger.snapshot()["reasons"]["filtered/rate_limit"] == 500
+
+    def test_no_socket_drops_are_ledgered(self):
+        from alaz_tpu.aggregator import Aggregator
+        from alaz_tpu.datastore.inmem import InMemDataStore
+        from alaz_tpu.events.intern import Interner
+        from tests.test_aggregator import _http_events, make_cluster
+
+        interner = Interner()
+        agg = Aggregator(InMemDataStore(), interner=interner)
+        agg.cluster = make_cluster(interner)
+        agg.process_l7(_http_events(4), now_ns=1_000_000)  # no socket line
+        agg.flush_retries(now_ns=10_000_000_000)
+        agg.flush_retries(now_ns=20_000_000_000)  # retry ladder exhausts
+        assert agg.stats.l7_dropped_no_socket == 4
+        assert agg.ledger.count("filtered") == 4
+        assert agg.ledger.snapshot()["reasons"]["filtered/no_socket"] == 4
+
+    def test_not_pod_drops_are_ledgered(self):
+        from alaz_tpu.events.net import ip_to_u32
+        from tests.test_aggregator import _establish, _http_events
+
+        agg = _engine()
+        # a second connection whose SOURCE is an outbound ip: From must
+        # be a pod, so attribution rejects every joined row
+        _establish(agg, pid=200, fd=9, saddr="8.8.4.4", daddr="10.0.0.2")
+        ev = _http_events(3, pid=200, fd=9)
+        out = agg.process_l7(ev, now_ns=10_000)
+        assert out.shape[0] == 0
+        assert agg.stats.l7_dropped_not_pod == 3
+        assert agg.ledger.count("filtered") == 3
+        assert agg.ledger.snapshot()["reasons"]["filtered/not_pod"] == 3
+        assert ip_to_u32("8.8.4.4") != 0  # guard: the ip really resolved
+
+    def test_service_shares_its_ledger_with_the_engine(self):
+        from alaz_tpu.runtime.service import Service
+
+        svc = Service()
+        assert svc.aggregator.ledger is svc.ledger
+
+
+class TestLedgeredShardedLosses:
+    """sharded.py's two unattributed loss paths (ALZ043 findings): a
+    poison batch swallowed by the per-item net, and a scatter racing a
+    stop() into closed queues."""
+
+    def _trace(self, n=4096):
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.replay.synth import make_ingest_trace
+
+        ev, msgs = make_ingest_trace(n, pods=20, svcs=4, windows=2, seed=0)
+        interner = Interner()
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        return ev, interner, cluster
+
+    def test_poison_batch_rows_are_ledgered(self):
+        from alaz_tpu.aggregator.sharded import ShardedIngest
+        from alaz_tpu.chaos.harness import emitted_rows
+        from alaz_tpu.utils.ledger import DropLedger
+
+        ev, interner, cluster = self._trace()
+        ledger = DropLedger()
+        closed = []
+        fired = []
+
+        def poison_once(i, kind):
+            if kind == "l7" and not fired:
+                fired.append(i)
+                raise ValueError("poison")
+
+        pipe = ShardedIngest(
+            2,
+            interner=interner,
+            cluster=cluster,
+            on_batch=closed.append,
+            ledger=ledger,
+            fault_hook=poison_once,
+        )
+        try:
+            half = ev.shape[0] // 2
+            pipe.process_l7(ev[:half], now_ns=10_000_000_000)
+            pipe.process_l7(ev[half:], now_ns=10_000_000_000)
+            assert pipe.drain(timeout_s=10.0)
+            assert pipe.flush(timeout_s=30.0)
+        finally:
+            pipe.stop()
+        assert fired, "fault hook never fired"
+        snap = ledger.snapshot()
+        lost = snap["reasons"].get("dropped/batch_error", 0)
+        assert lost > 0, snap
+        # conservation THROUGH the poison batch: nothing vanishes
+        assert emitted_rows(closed) + ledger.total == ev.shape[0], snap
+
+    def test_scatter_into_closed_queues_is_ledgered(self):
+        from alaz_tpu.aggregator.sharded import ShardedIngest
+        from alaz_tpu.utils.ledger import DropLedger
+
+        ev, interner, cluster = self._trace(512)
+        ledger = DropLedger()
+        pipe = ShardedIngest(
+            2, interner=interner, cluster=cluster, ledger=ledger
+        )
+        pipe.stop()
+        pipe.process_l7(ev, now_ns=10_000_000_000)  # racing submit
+        snap = ledger.snapshot()
+        assert snap["reasons"].get("dropped/closed", 0) == ev.shape[0], snap
+
+
+class TestBoundedServeJoin:
+    def test_replay_source_alive_probe(self):
+        """cmd_serve's unbounded src.join() (ALZ042) became a bounded
+        poll on alive(); the probe must go false once the thread ends."""
+        from alaz_tpu.sources.replay import ReplaySource
+
+        src = ReplaySource.__new__(ReplaySource)
+        src._thread = None
+        assert not src.alive()

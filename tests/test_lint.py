@@ -261,7 +261,11 @@ class TestSelfEnforcement:
     def test_tools_tree_is_lint_clean(self):
         # the analyzers must hold themselves to their own contract
         findings = lint_paths(
-            [str(REPO / "tools" / "alazlint"), str(REPO / "tools" / "alazspec")]
+            [
+                str(REPO / "tools" / "alazlint"),
+                str(REPO / "tools" / "alazspec"),
+                str(REPO / "tools" / "alazflow"),
+            ]
         )
         assert findings == [], "\n".join(f.render() for f in findings)
 

@@ -1,0 +1,33 @@
+"""alazflow — whole-program row-conservation + blocking-discipline
+static analyzer (ISSUE 8), the fourth tier-1-enforced analysis head.
+
+The chaos and scenario suites (ISSUES 6-7) prove the host plane's
+load-bearing invariant — exact row conservation through the drop ledger
+(``pushed == emitted + ledger.total``) — *dynamically*, on the seeds
+they happen to run. alazflow proves the same contract *statically*, so
+the refactors the ROADMAP names (process-mode ShardedIngest, native
+batch process_l7) cannot silently move a drop path out from under the
+ledger between chaos runs:
+
+- **ALZ040** unledgered row discard: a host-plane function that filters
+  or truncates row-bearing data with no path (closed over the call
+  graph) to ``DropLedger.add``.
+- **ALZ041** closed cause vocabulary: every ledgered cause literal must
+  be in ``DropLedger.CAUSES``, and CAUSES must triangulate with the
+  alazspec wire-table vocabulary and the golden metric registry.
+- **ALZ042** unbounded blocking: queue put/get, thread join, lock
+  acquire, condition wait without a timeout/deadline on a path
+  reachable from an ingest/flush/close-wave entry point.
+- **ALZ043** exception-safe handoff: an exception edge in a
+  row-handling function that abandons live rows (neither ledgers,
+  re-raises, nor returns them).
+- **ALZ044** closed metric registry: gauge/counter names must be
+  literals (or prefix-stable f-strings) drawn from the golden
+  ``resources/specs/metrics.json``.
+
+Codes live in the shared alazlint registry (append-only); disable
+comments (``# alazlint: disable=ALZ04x -- why``) parse uniformly.
+Driver: ``python -m tools.alazflow`` / ``make flow``.
+"""
+
+from tools.alazflow.driver import flow_paths, flow_source, main  # noqa: F401

@@ -1,0 +1,8 @@
+"""``python -m tools.alazflow [paths...] [--json] [--write-metrics]``"""
+
+import sys
+
+from tools.alazflow.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""ALZ042 — unbounded blocking on the ingest/flush/close-wave surface.
+
+The PR 6 war story this rule is the static twin of: ``BatchQueue.put``
+took a ``timeout`` that was a per-wakeup budget, not a deadline — under
+producer contention the shed bound was no bound at all, and a stalled
+shard wedged its producer forever. The dynamic fix landed; this rule
+pins the *discipline*: on any path reachable from an ingest / flush /
+close-wave entry point, every blocking primitive must carry a
+timeout or deadline —
+
+- ``BatchQueue.put(...)`` / ``.get(...)`` without a timeout argument
+  (the defaults block indefinitely); bounded stdlib ``queue.Queue`` too;
+- zero-argument ``.join()`` (a thread join that can outwait the world;
+  ``str.join``/``os.path.join`` always take an argument, so the
+  zero-arg shape IS the thread shape);
+- ``<lock>.acquire()`` without ``timeout=`` on a known lock attribute;
+- ``<condition>.wait()`` with no timeout on a known condition attribute.
+
+Reachability is closed over the call graph from the entry-name surface
+(``submit_*`` / ``process_*`` / ``flush*`` / ``drain`` / ``close*`` /
+``stop`` / ``serve`` / ``main`` / ``cmd_*``), so a blocking call buried
+three helpers under ``flush()`` is still caught, while an offline tool
+that blocks on purpose is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence
+
+from tools.alazlint.core import FileContext, Finding
+from tools.alazflow.flowmodel import FlowModel, FnFlow, walk_shallow
+
+
+def _has_timeoutish(call: ast.Call, extra_pos: int) -> bool:
+    """A timeout/deadline rides the call: positional at ``extra_pos``
+    (0-indexed past the payload args) or a timeout-ish keyword."""
+    if len(call.args) > extra_pos:
+        return True
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "timeout_s", "deadline", "deadline_s"):
+            return True
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+            if kw.value.value is False:
+                return True  # acquire(blocking=False) never blocks
+    return False
+
+
+def check_alz042(
+    ctxs: Sequence[FileContext], model: FlowModel | None = None
+) -> Iterable[Finding]:
+    model = model if model is not None else FlowModel(ctxs)
+    out: List[Finding] = []
+    for qn, fn in model.flows.items():
+        if not fn.mod.startswith("alaz_tpu") and "." in fn.mod:
+            continue  # tools/tests: blocking there is not a serving hazard
+        if qn not in model.reachable:
+            continue
+        local_queueish = model.local_queue_vars(fn)
+        for node in walk_shallow(fn.node):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            meth = node.func.attr
+            recv = node.func.value
+            msg = _site_message(model, fn, node, meth, recv, local_queueish)
+            if msg is not None:
+                out.append(
+                    Finding(
+                        "ALZ042",
+                        msg + " — reachable from the ingest/flush/close "
+                        "entry surface, so a stall here wedges the "
+                        "pipeline instead of degrading it; pass a "
+                        "timeout/deadline (drop-not-block, PR 6's "
+                        "put-deadline lesson)",
+                        fn.ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+    return out
+
+
+def _site_message(
+    model: FlowModel,
+    fn: FnFlow,
+    call: ast.Call,
+    meth: str,
+    recv: ast.AST,
+    local_queueish,
+) -> Optional[str]:
+    if meth == "join" and not call.args and not call.keywords:
+        return "unbounded `.join()` (no timeout)"
+    kind = model.receiver_kind(fn, recv, local_queueish)
+    if meth == "put" and kind == "queue" and not _has_timeoutish(call, 1):
+        return "bounded-queue `.put(...)` with no timeout blocks forever on a full queue"
+    if meth == "get" and kind == "queue" and not _has_timeoutish(call, 0):
+        return "queue `.get()` with no timeout blocks forever on an empty queue"
+    if meth == "acquire" and kind == "lock" and not _has_timeoutish(call, 0):
+        return "lock `.acquire()` with no timeout"
+    if meth == "wait" and kind == "condition" and not _has_timeoutish(call, 0):
+        return "condition `.wait()` with no timeout sleeps through a lost notify"
+    return None

@@ -1,0 +1,126 @@
+"""alazflow driver: parse → whole-program flow rules → suppression →
+report. Mirrors the alazlint core contract (same Finding type, same
+``# alazlint: disable=ALZ04x -- why`` escape hatch, same exit codes)
+so `make flow` and tier-1 read one uniform finding stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.alazlint.core import (
+    FileContext,
+    Finding,
+    iter_py_files,
+    parse_context,
+)
+from tools.alazflow import blockrules, droprules, vocabrules
+from tools.alazflow.flowmodel import FlowModel
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# what `make flow` / bench's flow_findings sweep: the host plane plus
+# the analyzer itself (self-enforcement, the alazlint precedent)
+DEFAULT_PATHS = (
+    str(REPO / "alaz_tpu"),
+    str(REPO / "tools" / "alazflow"),
+)
+
+
+def _parse(paths: Sequence[str]):
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        try:
+            source = f.read_text()
+        except (UnicodeDecodeError, OSError) as exc:
+            findings.append(
+                Finding("ALZ900", f"file is not readable: {exc}", str(f), 1, 0)
+            )
+            continue
+        ctx = parse_context(str(f), source)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        ctxs.append(ctx)
+    return ctxs, findings
+
+
+def _run_rules(
+    ctxs: List[FileContext], tree_mode: bool
+) -> List[Finding]:
+    """The five passes. ``tree_mode`` arms the cross-artifact checks
+    (cause triangulation, registry completeness) that only make sense
+    over the full tree — fixture/single-file runs skip them so a
+    fixture pair proves exactly its own rule."""
+    # one whole-program model shared by the three dataflow rules — the
+    # call-graph/ledger fixpoints are the expensive part of a run
+    model = FlowModel(ctxs)
+    raw: List[Finding] = []
+    raw.extend(droprules.check_alz040(ctxs, model=model))
+    raw.extend(vocabrules.check_alz041(ctxs, triangulate=tree_mode))
+    raw.extend(blockrules.check_alz042(ctxs, model=model))
+    raw.extend(droprules.check_alz043(ctxs, model=model))
+    raw.extend(vocabrules.check_alz044(ctxs, completeness=tree_mode))
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    out: List[Finding] = []
+    for f in raw:
+        ctx = by_path.get(f.path)
+        if ctx is not None and f.code in ctx.disables.get(f.line, set()):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def flow_paths(paths: Sequence[str], tree_mode: bool = False) -> List[Finding]:
+    ctxs, findings = _parse(paths)
+    findings.extend(_run_rules(ctxs, tree_mode))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def flow_source(path: str, source: str) -> List[Finding]:
+    """Analyze one file's source (fixture tests); whole-program rules run
+    scoped to this single file, artifact triangulation off."""
+    ctx = parse_context(path, source)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    return _run_rules([ctx], tree_mode=False)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--write-metrics" in argv:
+        argv = [a for a in argv if a != "--write-metrics"]
+        ctxs, _ = _parse(argv or [str(REPO / "alaz_tpu")])
+        path = vocabrules.write_metrics_golden(ctxs)
+        print(f"wrote {path}")
+        return 0
+    # the cross-artifact checks (vocabulary triangulation, registry
+    # completeness) are statements about the WHOLE tree — they run on
+    # the default invocation (`make flow`); explicit paths get the
+    # per-file rules only, so scanning a fixture doesn't re-litigate
+    # tree-global goldens
+    paths = argv or list(DEFAULT_PATHS)
+    findings = flow_paths(paths, tree_mode=not argv)
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"alazflow: {len(findings)} finding(s)")
+    return 1 if findings else 0

@@ -1,0 +1,156 @@
+"""Row-conservation rules: ALZ040 (unledgered discard) and ALZ043
+(exception-safe handoff).
+
+The conservation contract (utils/ledger.py): every row the pipeline
+loses is attributed to EXACTLY ONE ledger cause, so
+``pushed == emitted + ledger.total`` is checkable. These rules prove the
+attribution side statically:
+
+- **ALZ040** finds the places rows *leave* a row-plane function —
+  boolean-mask filters (``events = events[keep]``) and truncating
+  slices (``rows = rows[:cap]``) — in functions with no path to
+  ``DropLedger.add``, closed over the call graph: a helper that ledgers
+  on the caller's behalf keeps the caller clean, cross-module included.
+  Gathers and permutations (``rows[order]``, ``rows[np.flatnonzero(..)]``)
+  move rows without losing any and never match. The exemption is
+  deliberately FUNCTION-granular (one attribution exempts every discard
+  site in the function) — per-site dominance would need real dataflow;
+  a new unattributed filter inside an already-ledgering function is the
+  dynamic gates' job. See ARCHITECTURE §3l for the precision bound.
+
+- **ALZ043** checks the exception EDGES of row-handling code: a handler
+  that swallows (or merely logs) while row-bearing data is live loses
+  those rows with no attribution — the shard stays alive, conservation
+  silently breaks. A handler is safe when it re-raises, returns the
+  rows onward, or (transitively) reaches ``DropLedger.add`` itself —
+  handler-granular, because the FUNCTION ledgering on its happy path
+  says nothing about the exception path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence
+
+from tools.alazlint.core import FileContext, Finding
+from tools.alazflow.flowmodel import (
+    FlowModel,
+    FnFlow,
+    boolmask_expr,
+    in_row_plane,
+    walk_shallow,
+)
+
+
+def _discard_sites(fn: FnFlow) -> Iterable[ast.AST]:
+    """Subscript expressions in ``fn`` that shrink a row-bearing value:
+    boolean-mask indexing and upper-bounded slices of a row var."""
+    for node in walk_shallow(fn.node):
+        if not isinstance(node, ast.Subscript):
+            continue
+        base = node.value
+        if not (isinstance(base, ast.Name) and base.id in fn.row_vars):
+            continue
+        idx = node.slice
+        if isinstance(idx, ast.Slice):
+            # rows[:k] truncates; rows[k:] drops a prefix. rows[:] is a
+            # copy and full-range views with step keep every row.
+            if idx.upper is not None or idx.lower is not None:
+                yield node
+            continue
+        if boolmask_expr(idx, fn.bool_vars):
+            yield node
+
+
+def check_alz040(
+    ctxs: Sequence[FileContext], model: FlowModel | None = None
+) -> Iterable[Finding]:
+    model = model if model is not None else FlowModel(ctxs)
+    out: List[Finding] = []
+    for qn, fn in model.flows.items():
+        if not in_row_plane(fn.mod) or not fn.row_vars:
+            continue
+        if model.reaches_ledger(qn):
+            continue  # this function (or a helper it calls) attributes
+        for site in _discard_sites(fn):
+            out.append(
+                Finding(
+                    "ALZ040",
+                    f"`{qn.split(':')[-1]}` discards row-bearing "
+                    f"`{site.value.id}` here with no path to "
+                    "DropLedger.add — the cut rows vanish from the "
+                    "conservation equation (pushed == emitted + ledger); "
+                    "attribute them to a ledger cause, route them through "
+                    "a helper that does, or ledger-justify the filter",
+                    fn.ctx.path,
+                    site.lineno,
+                    site.col_offset,
+                )
+            )
+    return out
+
+
+def _handler_exits(handler: ast.ExceptHandler) -> bool:
+    """Does the handler re-raise or return a value (routing the failure
+    AND the rows to the caller)? A bare ``return`` abandons them."""
+    for stmt in ast.walk(handler):
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            return True
+    return False
+
+
+def check_alz043(
+    ctxs: Sequence[FileContext], model: FlowModel | None = None
+) -> Iterable[Finding]:
+    model = model if model is not None else FlowModel(ctxs)
+    out: List[Finding] = []
+    for qn, fn in model.flows.items():
+        if not in_row_plane(fn.mod) or not fn.row_vars:
+            continue
+        for node in walk_shallow(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            # only tries whose body handles rows in flight: the body
+            # references a row var, or the function is a dequeue loop
+            # (item popped before the try, processed inside it)
+            touches = fn.dequeues_rows or any(
+                isinstance(sub, ast.Name) and sub.id in fn.row_vars
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            )
+            if not touches:
+                continue
+            for handler in node.handlers:
+                if _handler_exits(handler):
+                    continue
+                if model.statement_reaches_ledger(fn, handler.body):
+                    continue
+                caught = _caught_names(handler)
+                out.append(
+                    Finding(
+                        "ALZ043",
+                        f"exception edge in `{qn.split(':')[-1]}` "
+                        f"(except {caught}) abandons in-flight rows: the "
+                        "handler neither ledgers them, re-raises, nor "
+                        "returns them — a failed batch vanishes while the "
+                        "worker lives on, silently breaking "
+                        "pushed == emitted + ledger; attribute the rows "
+                        "(ledger.add) before swallowing the failure",
+                        fn.ctx.path,
+                        handler.lineno,
+                        handler.col_offset,
+                    )
+                )
+    return out
+
+
+def _caught_names(handler: ast.ExceptHandler) -> str:
+    if handler.type is None:
+        return "<bare>"
+    t = handler.type
+    names = []
+    for n in t.elts if isinstance(t, ast.Tuple) else [t]:
+        names.append(getattr(n, "attr", getattr(n, "id", "?")))
+    return "/".join(names)

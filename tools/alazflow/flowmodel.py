@@ -1,0 +1,413 @@
+"""The flow model — alazsan's project model extended for dataflow.
+
+Reuses ``tools.alazlint.program.ProgramModel`` (function index, import
+maps, ``self.x = Cls(...)`` attr-type inference, ctor-arg resolution)
+and layers on what the conservation/blocking rules need:
+
+- **element types**: ``self.qs = [BatchQueue(...) for ...]`` records the
+  element class, so ``self.qs[i].put(...)`` resolves like a typed attr;
+- **local variable types** per function (``q = self._queues[i]``,
+  ``store = ShardPartialStore(...)``, annotated params);
+- **queue/lock/condition typing** for the blocking primitives the rules
+  reason about (``BatchQueue`` by project class OR constructor name —
+  fixtures parse standalone; stdlib ``queue.Queue`` only when bounded);
+- **reachability** from the ingest/flush/close-wave entry surface,
+  closed over the call graph;
+- **ledger closure**: which functions (transitively) reach
+  ``DropLedger.add`` — the "a helper may ledger on the caller's behalf"
+  half of ALZ040/ALZ043.
+
+Scope: the drop rules (ALZ040/ALZ043) run only over the ROW PLANE —
+the modules rows traverse between a source edge and window emission.
+The export leg (datastore/) accounts loss in ``stream.failed`` by
+design and the replay/chaos harnesses *deliberately* rewrite rows, so
+both stay out of row-plane scope; the blocking rule (ALZ042) covers all
+of ``alaz_tpu``. Bare-stem modules (fixtures, tmp-path tests) are
+always in scope — they exist to exercise the rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext, callee as _callee
+from tools.alazlint.program import ProgramModel, _self_attr, module_name
+
+# modules whose functions handle conservation-relevant rows (ALZ040/043)
+ROW_PLANE_PREFIXES = (
+    "alaz_tpu.aggregator",
+    "alaz_tpu.sources.ingest_server",
+    "alaz_tpu.utils.queues",
+    "alaz_tpu.utils.ledger",
+    "alaz_tpu.graph.builder",
+    "alaz_tpu.runtime.service",
+)
+
+# names that mark a value as row-bearing when they appear as parameters
+# or assignment targets in a row-plane function (the repo's own naming
+# convention for REQUEST/L7 row arrays; see engine.process_l7 and the
+# ShardedIngest scatter plane)
+ROW_NAMES = frozenset({"events", "batch", "batches", "rows", "chunk", "chunks"})
+
+# the ingest / flush / close-wave entry surface: reachability roots for
+# ALZ042 (names, matched against the unqualified function name)
+ENTRY_NAME_RE = re.compile(
+    r"^(submit_|process_|flush|drain$|close|stop$|serve$|main$|cmd_|_run_close_wave$)"
+)
+
+_QUEUE_CTORS = {"BatchQueue"}
+_QUEUE_MODULE = "alaz_tpu.utils.queues"
+
+
+def walk_shallow(fn_node: ast.AST):
+    """Walk a function body WITHOUT descending into nested
+    def/lambda bodies — those are indexed (and analyzed) under their own
+    qualnames, so attributing their facts to the enclosing function
+    would smear row/handler analysis across scopes."""
+    todo = list(ast.iter_child_nodes(fn_node))
+    while todo:
+        n = todo.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        todo.extend(ast.iter_child_nodes(n))
+
+
+def in_row_plane(mod: str) -> bool:
+    if "." not in mod:
+        return True  # fixture / tmp-path module
+    return any(mod == p or mod.startswith(p + ".") for p in ROW_PLANE_PREFIXES)
+
+
+def is_ledger_add(call: ast.Call) -> bool:
+    """``<something ledger-ish>.add(...)`` — the attribution sink. Name
+    keyed (``ledger`` / ``_ledger`` / ``self.ledger`` / ``store.ledger``)
+    so fixtures and duck-typed sinks resolve without the class index."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "add"):
+        return False
+    base = fn.value
+    name = None
+    if isinstance(base, ast.Name):
+        name = base.id
+    elif isinstance(base, ast.Attribute):
+        name = base.attr
+    return name is not None and "ledger" in name.lower()
+
+
+def boolmask_expr(node: ast.AST, bool_names: Set[str]) -> bool:
+    """Is this subscript index evidently a boolean row mask? Comparisons,
+    boolean algebra over them (&, |, ~), and names assigned from such
+    (including ``np.ones/zeros(..., dtype=bool)`` keep-masks). Index
+    arrays (argsort/flatnonzero products) deliberately do NOT match —
+    permutations and gathers move rows, masks drop them."""
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.Invert, ast.Not)):
+        return boolmask_expr(node.operand, bool_names)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitAnd, ast.BitOr)):
+        return boolmask_expr(node.left, bool_names) or boolmask_expr(
+            node.right, bool_names
+        )
+    if isinstance(node, ast.BoolOp):
+        return any(boolmask_expr(v, bool_names) for v in node.values)
+    if isinstance(node, ast.Name):
+        return node.id in bool_names
+    if isinstance(node, ast.Call):
+        _, name = _callee(node)
+        if name in ("ones", "zeros", "full"):
+            for kw in node.keywords:
+                if kw.arg == "dtype" and getattr(kw.value, "id", None) == "bool":
+                    return True
+    return False
+
+
+@dataclass
+class FnFlow:
+    """Per-function flow facts the rules consume."""
+
+    qualname: str
+    node: ast.AST
+    ctx: FileContext
+    mod: str
+    cls: Optional[ast.ClassDef]
+    row_vars: Set[str] = field(default_factory=set)  # row-bearing locals
+    bool_vars: Set[str] = field(default_factory=set)  # boolean-mask locals
+    calls: List[Tuple[str, int, int]] = field(default_factory=list)  # resolved callees
+    ledgers_directly: bool = False
+    dequeues_rows: bool = False  # pops row batches off a project queue
+
+
+class FlowModel:
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.model = ProgramModel(ctxs)
+        self.ctxs = list(ctxs)
+        self._mark_queue_attrs()
+        self.flows: Dict[str, FnFlow] = {}
+        for qn, info in self.model.functions.items():
+            self.flows[qn] = self._analyze_fn(qn, info)
+        self._reaches_ledger = self._close_ledger()
+        self.reachable = self._close_reachable()
+
+    # -- typing helpers ------------------------------------------------------
+
+    def _attr_is_queue(self, mod: str, cls: Optional[ast.ClassDef], attr: str) -> bool:
+        if cls is None:
+            return False
+        cinfo = self.model.classes.get(f"{mod}:{cls.name}")
+        if cinfo is None:
+            return False
+        t = cinfo.attr_types.get(attr)
+        if t is not None and t.endswith(":BatchQueue"):
+            return True
+        return attr in getattr(cinfo, "_alz_queue_attrs", ())
+
+    def _mark_queue_attrs(self) -> None:
+        """Record attrs assigned a BatchQueue — directly, or as the
+        element type of a list (``self.qs = [BatchQueue(..) for ..]``),
+        which the base model's Call-only inference cannot see."""
+        for cinfo in self.model.classes.values():
+            queue_attrs: Set[str] = set()
+            for node in ast.walk(cinfo.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = node.value
+                elems: List[ast.AST] = []
+                if isinstance(value, ast.ListComp):
+                    elems = [value.elt]
+                elif isinstance(value, ast.List):
+                    elems = value.elts
+                elif isinstance(value, ast.Call):
+                    elems = [value]
+                for e in elems:
+                    if isinstance(e, ast.Call):
+                        _, name = _callee(e)
+                        if name in _QUEUE_CTORS or (
+                            name == "Queue" and _bounded_queue_ctor(e)
+                        ):
+                            for t in node.targets:
+                                attr = _self_attr(t)
+                                if attr is not None:
+                                    queue_attrs.add(attr)
+            cinfo._alz_queue_attrs = queue_attrs  # type: ignore[attr-defined]
+
+    def receiver_kind(
+        self, fn: FnFlow, base: ast.AST, local_queueish: Set[str]
+    ) -> Optional[str]:
+        """'queue' / 'lock' / 'condition' for a method-call receiver,
+        None when untyped. Resolves self attrs (incl. subscripts of
+        queue-list attrs), annotated params, and locals assigned from
+        either."""
+        mod, cls = fn.mod, fn.cls
+        # self.<attr> / self.<attr>[i]
+        sub_base = base.value if isinstance(base, ast.Subscript) else base
+        attr = _self_attr(sub_base)
+        if attr is not None:
+            if self._attr_is_queue(mod, cls, attr):
+                return "queue"
+            if cls is not None:
+                cinfo = self.model.classes.get(f"{mod}:{cls.name}")
+                if cinfo is not None and attr in cinfo.lock_attrs:
+                    return cinfo.lock_attrs[attr]  # 'lock' | 'condition'
+            return None
+        if isinstance(base, ast.Name) and base.id in local_queueish:
+            return "queue"
+        return None
+
+    # -- per-function analysis ----------------------------------------------
+
+    def _analyze_fn(self, qn: str, info) -> FnFlow:
+        mod = self.model.module_of[id(info.ctx)]
+        fn = FnFlow(qn, info.node, info.ctx, mod, info.cls)
+        args = getattr(info.node, "args", None)
+        if args is not None:
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                if a.arg in ROW_NAMES:
+                    fn.row_vars.add(a.arg)
+        local_prefix = qn + "."
+        local_queueish = self.local_queue_vars(fn)
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    if boolmask_expr(node.value, fn.bool_vars):
+                        fn.bool_vars.add(t.id)
+                    if self._is_row_source(fn, node.value, local_queueish):
+                        fn.row_vars.add(t.id)
+                        if isinstance(node.value, ast.Call):
+                            fn.dequeues_rows = True
+            if isinstance(node, ast.Call):
+                if is_ledger_add(node):
+                    fn.ledgers_directly = True
+                target = self.model.resolve_call(node, mod, info.cls, local_prefix)
+                if target is None and isinstance(node.func, ast.Attribute):
+                    # typed-receiver fallback the base resolver can't do:
+                    # a method call on a queue-typed receiver (incl.
+                    # subscripted lists and loop vars) reaches the
+                    # BatchQueue method body — what makes the blocking
+                    # branches INSIDE put/get entry-reachable
+                    if self.receiver_kind(
+                        fn, node.func.value, local_queueish
+                    ) == "queue":
+                        qmeth = f"{_QUEUE_MODULE}:BatchQueue.{node.func.attr}"
+                        if qmeth in self.model.functions:
+                            target = qmeth
+                if target is not None and target != qn:
+                    fn.calls.append((target, node.lineno, node.col_offset))
+        return fn
+
+    def local_queue_vars(self, fn: FnFlow) -> Set[str]:
+        """Locals that evidently hold a project queue: annotated params
+        (``queue: BatchQueue``), ``q = BatchQueue(...)``, and
+        ``q = self.<queue attr>[i]`` / ``q = self.<queue attr>``."""
+        out: Set[str] = set()
+        args = getattr(fn.node, "args", None)
+        if args is not None:
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                ann = a.annotation
+                ann_name = getattr(ann, "id", getattr(ann, "attr", None))
+                if ann_name in _QUEUE_CTORS:
+                    out.add(a.arg)
+        for node in walk_shallow(fn.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                _, name = _callee(v)
+                if name in _QUEUE_CTORS or (
+                    name == "Queue" and _bounded_queue_ctor(v)
+                ):
+                    out.add(t.id)
+            sub = v.value if isinstance(v, ast.Subscript) else v
+            attr = _self_attr(sub)
+            if attr is not None and self._attr_is_queue(fn.mod, fn.cls, attr):
+                out.add(t.id)
+        # ``for q in self._queues`` / ``for i, q in enumerate(self._queues)``
+        for node in walk_shallow(fn.node):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            it = node.iter
+            if (
+                isinstance(it, ast.Call)
+                and getattr(it.func, "id", None) == "enumerate"
+                and it.args
+            ):
+                it = it.args[0]
+            attr = _self_attr(it)
+            if attr is None or not self._attr_is_queue(fn.mod, fn.cls, attr):
+                continue
+            targets = (
+                node.target.elts
+                if isinstance(node.target, ast.Tuple)
+                else [node.target]
+            )
+            last = targets[-1]
+            if isinstance(last, ast.Name):
+                out.add(last.id)
+        return out
+
+    def _is_row_source(
+        self, fn: FnFlow, value: ast.AST, local_queueish: Set[str]
+    ) -> bool:
+        """Does this assignment value evidently carry rows? ``x[...]`` /
+        ``x.copy()`` of a row var, concatenation of row vars, or a
+        ``.get(...)``/``.drain()`` pop off a project queue."""
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            return isinstance(base, ast.Name) and base.id in fn.row_vars
+        if isinstance(value, ast.Call):
+            f = value.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in ("get", "drain") and self.receiver_kind(
+                    fn, f.value, local_queueish
+                ) == "queue":
+                    return True
+                if f.attr == "copy" and isinstance(f.value, ast.Name):
+                    return f.value.id in fn.row_vars
+                if f.attr == "concatenate":
+                    for a in value.args:
+                        if isinstance(a, (ast.List, ast.Tuple)):
+                            if any(
+                                isinstance(e, ast.Name) and e.id in fn.row_vars
+                                for e in a.elts
+                            ):
+                                return True
+                        if isinstance(a, ast.Name) and a.id in fn.row_vars:
+                            return True
+        if isinstance(value, ast.IfExp):
+            return self._is_row_source(fn, value.body, local_queueish) or (
+                self._is_row_source(fn, value.orelse, local_queueish)
+            )
+        return False
+
+    # -- closures ------------------------------------------------------------
+
+    def _close_ledger(self) -> Set[str]:
+        reaches = {qn for qn, f in self.flows.items() if f.ledgers_directly}
+        changed = True
+        while changed:
+            changed = False
+            for qn, f in self.flows.items():
+                if qn in reaches:
+                    continue
+                if any(c in reaches for c, _, _ in f.calls):
+                    reaches.add(qn)
+                    changed = True
+        return reaches
+
+    def reaches_ledger(self, qn: str) -> bool:
+        return qn in self._reaches_ledger
+
+    def statement_reaches_ledger(self, fn: FnFlow, body: List[ast.stmt]) -> bool:
+        """Does any statement in this suite ledger — directly or through
+        a resolvable helper call? (Handler-granular half of the closure:
+        the exception EDGE must attribute, not merely the function.)"""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if is_ledger_add(node):
+                    return True
+                target = self.model.resolve_call(
+                    node, fn.mod, fn.cls, fn.qualname + "."
+                )
+                if target is not None and target in self._reaches_ledger:
+                    return True
+        return False
+
+    def _close_reachable(self) -> Set[str]:
+        roots = {
+            qn
+            for qn, f in self.flows.items()
+            if ENTRY_NAME_RE.search(qn.split(":", 1)[-1].rsplit(".", 1)[-1])
+        }
+        seen = set(roots)
+        work = list(roots)
+        while work:
+            qn = work.pop()
+            f = self.flows.get(qn)
+            if f is None:
+                continue
+            for c, _, _ in f.calls:
+                if c not in seen:
+                    seen.add(c)
+                    work.append(c)
+        return seen
+
+
+def _bounded_queue_ctor(call: ast.Call) -> bool:
+    """stdlib ``queue.Queue(maxsize)``: blocking only when bounded — a
+    default-unbounded Queue's put never blocks and never drops."""
+    for a in call.args[:1]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, int) and a.value > 0:
+            return True
+    for kw in call.keywords:
+        if kw.arg == "maxsize" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False

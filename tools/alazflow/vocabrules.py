@@ -1,0 +1,333 @@
+"""Closed-vocabulary rules: ALZ041 (ledger causes) and ALZ044 (metric
+registry).
+
+ALZ041 — the drop ledger's cause vocabulary is closed-world on purpose
+(utils/ledger.py): the conservation gates sum EXACTLY the causes they
+know. Three artifacts carry that vocabulary and all three must agree:
+
+1. every ``ledger.add(cause, ...)`` / ``drop_cause=`` literal in the
+   tree must be a member of ``DropLedger.CAUSES`` (a typo'd cause would
+   raise at runtime — on the drop path, under an incident);
+2. ``DropLedger.CAUSES`` must equal the alazspec wire-table vocabulary
+   (``resources/specs/wire_layouts.json`` → sampling.ledger_causes) —
+   a cause grown in code without ``make specs`` is drift;
+3. every cause must be covered by the golden metric registry
+   (``ledger.<cause>`` in resources/specs/metrics.json, wildcards
+   allowed) — a cause with no gauge is invisible in degraded mode.
+
+ALZ044 — metric names are a wire contract too: dashboards, the health
+payload and the Prometheus scrape all key on them. Every
+``metrics.gauge/counter/info`` name must be a literal (or an f-string
+whose constant skeleton matches a registered wildcard) drawn from the
+golden registry; golden names nothing registers anymore are flagged the
+other way. ``python -m tools.alazflow --write-metrics`` regenerates the
+golden from the tree — review and commit the diff, exactly the
+``make specs`` workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.alazlint.core import FileContext, Finding, parse_context
+
+REPO = Path(__file__).resolve().parent.parent.parent
+LEDGER_PY = REPO / "alaz_tpu" / "utils" / "ledger.py"
+WIRE_TABLE = REPO / "resources" / "specs" / "wire_layouts.json"
+METRICS_GOLDEN = REPO / "resources" / "specs" / "metrics.json"
+
+_METRIC_METHODS = ("gauge", "counter", "info")
+
+
+# ---------------------------------------------------------------------------
+# vocabulary extraction
+# ---------------------------------------------------------------------------
+
+
+def _causes_from_ctx(ctx: FileContext) -> Optional[Tuple[List[str], int]]:
+    """(CAUSES literal, line) from a DropLedger class body, if present."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == "DropLedger"):
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == "CAUSES":
+                    v = stmt.value
+                    if isinstance(v, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant) for e in v.elts
+                    ):
+                        return [e.value for e in v.elts], stmt.lineno
+    return None
+
+
+def ledger_causes(
+    ctxs: Sequence[FileContext], ledger_py: Path = LEDGER_PY
+) -> Tuple[List[str], str, int]:
+    """(causes, anchor path, anchor line) — from a scanned ctx when the
+    ledger module is in the invocation, else from disk."""
+    for ctx in ctxs:
+        got = _causes_from_ctx(ctx)
+        if got is not None:
+            return got[0], ctx.path, got[1]
+    ctx = parse_context(str(ledger_py), ledger_py.read_text())
+    if isinstance(ctx, Finding):  # pragma: no cover - ledger.py must parse
+        return [], str(ledger_py), 1
+    got = _causes_from_ctx(ctx)
+    if got is None:  # pragma: no cover - CAUSES is load-bearing
+        return [], str(ledger_py), 1
+    return got[0], str(ledger_py), got[1]
+
+
+def _cause_literal_sites(ctxs: Sequence[FileContext]):
+    """(ctx, node, literal) for every cause literal: first positional /
+    ``cause=`` of a ledger ``.add``, and ``drop_cause=`` anywhere (the
+    BatchQueue mouth-drop routing)."""
+    from tools.alazflow.flowmodel import is_ledger_add
+
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if is_ledger_add(node):
+                lit = None
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    lit = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg == "cause" and isinstance(kw.value, ast.Constant):
+                        lit = kw.value
+                if lit is not None and isinstance(lit.value, str):
+                    yield ctx, lit, lit.value
+            for kw in node.keywords:
+                if kw.arg == "drop_cause" and isinstance(kw.value, ast.Constant):
+                    if isinstance(kw.value.value, str):
+                        yield ctx, kw.value, kw.value.value
+
+
+def check_alz041(
+    ctxs: Sequence[FileContext],
+    triangulate: bool = False,
+    ledger_py: Path = LEDGER_PY,
+    wire_table: Path = WIRE_TABLE,
+    metrics_golden: Path = METRICS_GOLDEN,
+) -> Iterable[Finding]:
+    causes, anchor_path, anchor_line = ledger_causes(ctxs, ledger_py)
+    known = set(causes)
+    out: List[Finding] = []
+    for ctx, node, lit in _cause_literal_sites(ctxs):
+        if lit not in known:
+            out.append(
+                Finding(
+                    "ALZ041",
+                    f"drop cause {lit!r} is not in DropLedger.CAUSES "
+                    f"{tuple(causes)} — an off-vocabulary cause raises at "
+                    "runtime ON THE DROP PATH and the conservation gates "
+                    "would never sum it; pick a closed cause or grow the "
+                    "vocabulary (ledger.py + `make specs` + the metric "
+                    "registry) in one move",
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+            )
+    if not triangulate:
+        return out
+    # cross-artifact triangulation (driver/tree mode): code ↔ wire table
+    # ↔ metric registry must carry ONE vocabulary
+    try:
+        wire = json.loads(wire_table.read_text())
+        wire_causes = list(wire.get("sampling", {}).get("ledger_causes", []))
+    except (OSError, json.JSONDecodeError):
+        wire_causes = None
+    if wire_causes is None:
+        out.append(
+            Finding(
+                "ALZ041",
+                f"wire table {wire_table.name} unreadable — the golden "
+                "cause vocabulary cannot be triangulated (run `make specs`)",
+                str(wire_table),
+                1,
+                0,
+            )
+        )
+    elif wire_causes != causes:
+        out.append(
+            Finding(
+                "ALZ041",
+                f"DropLedger.CAUSES {tuple(causes)} != wire-table "
+                f"ledger_causes {tuple(wire_causes)} — the vocabulary "
+                "moved on one side only; `make specs` regenerates the "
+                "table from code (then review the conservation gates)",
+                anchor_path,
+                anchor_line,
+                0,
+            )
+        )
+    names = _golden_metric_names(metrics_golden)
+    if names is not None:
+        for cause in causes:
+            gauge = f"ledger.{cause}"
+            if not _name_registered(gauge, names):
+                out.append(
+                    Finding(
+                        "ALZ041",
+                        f"cause {cause!r} has no `{gauge}` entry in the "
+                        f"golden metric registry ({metrics_golden.name}) — "
+                        "a loss cause without a gauge is invisible in "
+                        "degraded mode; regenerate with --write-metrics",
+                        str(metrics_golden),
+                        1,
+                        0,
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ALZ044 — metric registry
+# ---------------------------------------------------------------------------
+
+
+def _is_metrics_recv(base: ast.AST) -> bool:
+    if isinstance(base, ast.Name):
+        return base.id == "metrics"
+    if isinstance(base, ast.Attribute):
+        return base.attr == "metrics"
+    return False
+
+
+def _fstring_skeleton(node: ast.JoinedStr) -> str:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def metric_sites(ctxs: Sequence[FileContext]):
+    """(ctx, node, name-or-skeleton, is_literal) for every
+    ``metrics.gauge/counter/info`` registration in the invocation.
+    ``None`` name = dynamic (non-literal, non-f-string) — always a
+    finding: the registry cannot close over it."""
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _METRIC_METHODS
+                and _is_metrics_recv(fn.value)
+            ):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                yield ctx, node, arg.value, True
+            elif isinstance(arg, ast.JoinedStr):
+                yield ctx, node, _fstring_skeleton(arg), False
+            else:
+                yield ctx, node, None, False
+
+
+def _golden_metric_names(path: Path = METRICS_GOLDEN) -> Optional[List[str]]:
+    try:
+        return list(json.loads(path.read_text())["names"])
+    except (OSError, json.JSONDecodeError, KeyError):
+        return None
+
+
+def _name_registered(name: str, golden: Sequence[str]) -> bool:
+    if name in golden:
+        return True
+    return any("*" in g and fnmatch.fnmatchcase(name, g) for g in golden)
+
+
+def check_alz044(
+    ctxs: Sequence[FileContext],
+    completeness: bool = False,
+    metrics_golden: Path = METRICS_GOLDEN,
+) -> Iterable[Finding]:
+    golden = _golden_metric_names(metrics_golden)
+    out: List[Finding] = []
+    if golden is None:
+        out.append(
+            Finding(
+                "ALZ044",
+                f"golden metric registry {metrics_golden} missing or "
+                "unreadable — regenerate with "
+                "`python -m tools.alazflow --write-metrics` and commit",
+                str(metrics_golden),
+                1,
+                0,
+            )
+        )
+        return out
+    seen: Dict[str, int] = {}
+    for ctx, node, name, is_literal in metric_sites(ctxs):
+        if name is None:
+            out.append(
+                Finding(
+                    "ALZ044",
+                    "metric registered under a computed name — the closed "
+                    "registry (and every dashboard keyed on it) cannot "
+                    "see it; use a literal or a constant-skeleton "
+                    "f-string matching a registered wildcard",
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+            )
+            continue
+        seen[name] = seen.get(name, 0) + 1
+        if not _name_registered(name, golden):
+            kind = "name" if is_literal else "f-string pattern"
+            out.append(
+                Finding(
+                    "ALZ044",
+                    f"metric {kind} {name!r} is not in the golden "
+                    f"registry ({metrics_golden.name}) — health payloads "
+                    "and dashboards key on a closed name set; if the "
+                    "metric is intentional, regenerate the registry "
+                    "(--write-metrics) and review the diff",
+                    ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+            )
+    if completeness:
+        for g in golden:
+            if g not in seen:
+                out.append(
+                    Finding(
+                        "ALZ044",
+                        f"golden metric {g!r} is registered by nothing in "
+                        "the tree — a dashboard keyed on it reads a hole; "
+                        "remove it from the registry (--write-metrics) or "
+                        "restore the gauge",
+                        str(metrics_golden),
+                        1,
+                        0,
+                    )
+                )
+    return out
+
+
+def write_metrics_golden(
+    ctxs: Sequence[FileContext], path: Path = METRICS_GOLDEN
+) -> Path:
+    """Regenerate the golden registry from the tree (sorted, stable —
+    the `make specs` fixpoint discipline)."""
+    names = sorted(
+        {name for _, _, name, _ in metric_sites(ctxs) if name is not None}
+    )
+    path.write_text(json.dumps({"names": names}, indent=2) + "\n")
+    return path

@@ -133,6 +133,43 @@ _ALL = [
         "(failures must route to the supervisor, not pass)",
         thread_rules.check_alz030,
     ),
+    # -- alazflow family (tools/alazflow): whole-program row-conservation
+    # + blocking-discipline dataflow. Emitted by the alazflow driver
+    # (`python -m tools.alazflow`, `make flow`) — the passes need the
+    # full project model plus golden JSON artifacts, not a single file —
+    # and registered here so codes stay append-only, `--list-rules`
+    # shows the whole catalog, and disable comments parse uniformly.
+    Rule(
+        "ALZ040",
+        "row-bearing data discarded (mask filter / truncating slice) "
+        "with no call-graph path to DropLedger.add",
+        lambda ctx: (),  # emitted by tools.alazflow.droprules
+    ),
+    Rule(
+        "ALZ041",
+        "drop-cause vocabulary broken: off-CAUSES literal, or CAUSES "
+        "drifted from the wire table / metric registry",
+        lambda ctx: (),  # emitted by tools.alazflow.vocabrules
+    ),
+    Rule(
+        "ALZ042",
+        "unbounded blocking (queue put/get, join, acquire, condition "
+        "wait without timeout) reachable from the ingest/flush/close "
+        "entry surface",
+        lambda ctx: (),  # emitted by tools.alazflow.blockrules
+    ),
+    Rule(
+        "ALZ043",
+        "exception edge abandons in-flight rows (handler neither "
+        "ledgers, re-raises, nor returns them)",
+        lambda ctx: (),  # emitted by tools.alazflow.droprules
+    ),
+    Rule(
+        "ALZ044",
+        "metric name outside the golden registry "
+        "(resources/specs/metrics.json; --write-metrics regenerates)",
+        lambda ctx: (),  # emitted by tools.alazflow.vocabrules
+    ),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -200,6 +200,46 @@ def _sharded_spec(name: str, cfg, n_pad: int, e_pad: int) -> dict:
     }
 
 
+def _train_spec(name: str, cfg) -> dict:
+    """The sharded TRAIN step's contract (ISSUE 8 carried-over
+    satellite): optimizer-state shapes/dtypes with the PartitionSpec
+    each leaf gets from ``sharding.opt_state_pspec`` — moments shard
+    like their params, bookkeeping scalars replicate. Bucket-free: the
+    optimizer state depends on params only, so one specfile per model
+    pins the whole train-side placement (the serve-side shard_map
+    contract was pinned in ISSUE 4; this closes the train half)."""
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from alaz_tpu.models.registry import get_model
+    from alaz_tpu.parallel.sharding import opt_state_pspec
+
+    init, _ = get_model(name)
+    params = jax.eval_shape(lambda k: init(k, cfg), jax.random.PRNGKey(0))
+    # canonical optimizer (train_on_batches / make_sharded_train_step):
+    # hyperparameters don't move shapes, adamw's STRUCTURE is the contract
+    optimizer = optax.adamw(3e-3, weight_decay=1e-4)
+    opt_state = jax.eval_shape(optimizer.init, params)
+    o_spec = opt_state_pspec(opt_state, params, tp=SPEC_TP, ep=SPEC_EP)
+    flat_o = jax.tree_util.tree_flatten_with_path(opt_state)[0]
+    flat_s = jax.tree_util.tree_flatten_with_path(o_spec)[0]
+    table = {}
+    for (path, leaf), (_, spec) in zip(flat_o, flat_s):
+        table[_leaf_path(path)] = dict(
+            _sds(leaf.shape, leaf.dtype), pspec=str(spec)
+        )
+    return {
+        "model": name,
+        "kind": "sharded_train_step",
+        "optimizer": "adamw",
+        "param_sharding": {"tp": SPEC_TP, "ep": SPEC_EP},
+        "config": _cfg_dict(cfg),
+        "labels_pspec": str(P("dp", None)),
+        "opt_state": table,
+    }
+
+
 def _cfg_dict(cfg) -> dict:
     import dataclasses
 
@@ -229,6 +269,7 @@ def generate_specs() -> Dict[str, str]:
             out[_spec_name(name, n_pad, e_pad)] = _render(
                 _model_spec(name, cfg, n_pad, e_pad)
             )
+        out[f"{name}_train.json"] = _render(_train_spec(name, cfg))
     for name in NODE_SHARDED_TWINS:
         cfg = ModelConfig(model=name)
         for n_pad, e_pad in SPEC_BUCKETS:
@@ -318,6 +359,9 @@ def check_specs(specs_dir: Optional[Path] = None) -> List[Finding]:
             )
         )
     for stray in sorted(specs_dir.glob("*.json")):
+        if stray.name == "metrics.json":
+            continue  # alazflow's golden metric registry (ALZ044) lives
+            # beside the spec set but is owned by `--write-metrics`
         if stray.name not in live:
             out.append(
                 Finding(
