@@ -198,8 +198,14 @@ class Aggregator:
         cluster: Optional[ClusterInfo] = None,
         proc_root: str | None = None,
         ledger=None,
+        recorder=None,
     ):
         self.ds = ds
+        # optional flight recorder (ISSUE 9, alaz_tpu/obs): rare
+        # structural events — zombie-reap sweeps tearing down join
+        # state — become ring events a post-incident dump replays.
+        # Per-sweep, never per row.
+        self.recorder = recorder
         # unified loss accounting (ISSUE 8): the join/attribution stage's
         # semantic drops (no socket after retries, non-pod source, rate
         # limit) land in the shared ledger's `filtered` cause, so
@@ -382,6 +388,14 @@ class Aggregator:
             ev["pid"] = dead
             ev["type"] = ProcEventType.EXIT
             self.process_proc(ev)
+            if self.recorder is not None:
+                # a reap tears down join state for every dead pid — the
+                # kind of rare structural event a flight-recorder dump
+                # needs to explain "why did attribution drop at t"
+                self.recorder.record(
+                    "zombie_reap", pids=len(dead),
+                    live_pids=len(self.live_pids),
+                )
         return dead
 
     # ------------------------------------------------------------------

@@ -86,6 +86,8 @@ from alaz_tpu.graph.builder import (
 )
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.spans import SpanTracer
 from alaz_tpu.utils.ledger import DropLedger
 from alaz_tpu.utils.queues import BatchQueue, QueueClosed
 
@@ -151,9 +153,13 @@ class ShardPartialStore(BaseDataStore):
         label_fn=None,
         aggregate: bool = True,
         ledger: Optional[DropLedger] = None,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.window_ms = int(window_ms)
         self.label_fn = label_fn
+        # shared span tracer (ISSUE 9): first-row marks + per-shard
+        # close timings; per window×stage, never per row
+        self.tracer = tracer
         # False (the N==1 pool): deposit raw rows; the merge stage then
         # runs the serial GraphBuilder.build verbatim — no partial pass
         self.aggregate = aggregate
@@ -205,6 +211,10 @@ class ShardPartialStore(BaseDataStore):
                     continue
                 rows = batch.copy() if wmin == wmax else batch[wids == w]
                 self._pending.setdefault(w, []).append(rows)
+                # span origin (idempotent — first shard to see the
+                # window wins; lock order: store lock → tracer lock)
+                if self.tracer is not None:
+                    self.tracer.first_row(w * self.window_ms)
                 if self._watermark is None or w > self._watermark:
                     self._watermark = w
 
@@ -241,13 +251,24 @@ class ShardPartialStore(BaseDataStore):
         # the grouped reduction runs OUTSIDE the lock: it is the heavy
         # stage, and it must overlap across worker threads
         done: List[tuple] = []
+        tr = self.tracer
         for w, parts in sorted(popped.items()):
+            ws_ms = w * self.window_ms
+            if tr is not None:
+                # the close wave reached this window: residency since
+                # first_row becomes `scatter` (first shard to close wins)
+                tr.close_start(ws_ms)
+            tc0 = time.perf_counter()
             rows = np.concatenate(parts) if len(parts) > 1 else parts[0]
             if self.aggregate:
                 labels = self.label_fn(rows) if self.label_fn is not None else None
                 done.append((w, partial_from_rows(rows, self._local_nodes, labels)))
             else:
                 done.append((w, rows))
+            if tr is not None:
+                # per-shard parallel closes all report; the span keeps
+                # the max — the critical-path shard
+                tr.observe(ws_ms, "shard_close", time.perf_counter() - tc0)
         if done:
             with self._lock:
                 for w, item in done:
@@ -316,6 +337,8 @@ class ShardedIngest:
         shed_block_s: float = 5.0,
         degree_cap: int = 0,
         sample_seed: int = 0,
+        tracer: Optional[SpanTracer] = None,
+        recorder: Optional[FlightRecorder] = None,
     ):
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
@@ -324,6 +347,19 @@ class ShardedIngest:
         # loses lands in exactly one ledger cause — the conservation
         # invariant the chaos suite checks
         self.ledger = ledger if ledger is not None else DropLedger()
+        # span plane (ISSUE 9): ON by default. A standalone pipeline
+        # (bench, chaos harness) gets a private tracer whose spans
+        # complete at emit; the service passes its metrics-registered
+        # tracer, which stays open through score/export.
+        if tracer is None:
+            tracer = SpanTracer(complete_at_emit=True, recorder=recorder)
+        self.tracer = tracer
+        # flight recorder (ISSUE 9): worker crashes/restarts and every
+        # ledger decision become structured ring events; a dying worker
+        # dumps the tail to the log automatically
+        self.recorder = recorder
+        if recorder is not None and self.ledger.recorder is None:
+            self.ledger.recorder = recorder
         # chaos seam: called as fault_hook(worker_idx, kind) at item
         # boundaries on the worker thread; may raise WorkerCrash or stall
         self.fault_hook = fault_hook
@@ -349,7 +385,7 @@ class ShardedIngest:
         self.builder = GraphBuilder(
             window_s=window_s, renumber=renumber,
             degree_cap=degree_cap, sample_seed=sample_seed,
-            ledger=self.ledger,
+            ledger=self.ledger, tracer=self.tracer,
         )
         self.label_fn = label_fn
         self.tee = tee
@@ -363,6 +399,7 @@ class ShardedIngest:
                 label_fn=label_fn if self.n > 1 else None,
                 aggregate=self.n > 1,
                 ledger=self.ledger,
+                tracer=self.tracer,
             )
             for _ in range(self.n)
         ]
@@ -376,6 +413,7 @@ class ShardedIngest:
                 # pipeline's conservation reads delivered == emitted +
                 # ledger.total with no per-worker side channel (ISSUE 8)
                 ledger=self.ledger,
+                recorder=recorder,
             )
             for i in range(self.n)
         ]
@@ -495,8 +533,23 @@ class ShardedIngest:
             return  # clean shutdown path (stop/close)
         except WorkerCrash:
             log.warning(f"shard{i} worker killed (injected crash)")
+            reason = "injected_crash"
         except BaseException as exc:
             log.error(f"shard{i} worker died: {exc!r}")
+            reason = repr(exc)
+        if self.recorder is not None:
+            # the crash trail ships WITH the crash: the event lands in
+            # the ring and the ring's tail lands in the log, so a chaos
+            # failure (or a real one) reads as a story, not a bare mark.
+            # Best-effort: a recorder/logging failure here must never
+            # swallow the dead-mark below — that would permanently
+            # disable supervision of this worker (no restart, every
+            # future close wave timing out)
+            try:
+                self.recorder.record("worker_crash", worker=i, reason=reason)
+                self.recorder.crash_dump(log, f"shard{i} worker died: {reason}")
+            except Exception as exc:
+                log.error(f"flight-recorder crash dump failed: {exc!r}")
         with self._wm_cond:
             self._worker_dead[i] = True
             self._wm_cond.notify_all()
@@ -529,6 +582,11 @@ class ShardedIngest:
                 self._worker_threads[i] = nt
                 nt.start()
                 restarted.append(i)
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "worker_restart", worker=i,
+                        restart=self._worker_restarts,
+                    )
                 log.warning(
                     f"shard{i} worker restarted "
                     f"(restart #{self._worker_restarts})"
@@ -834,6 +892,9 @@ class ShardedIngest:
                     self.on_batch(batch)
                 else:
                     self.batches.append(batch)
+                # completes the span here when no scorer follows
+                # (complete_at_emit); the service's tracer keeps it open
+                self.tracer.emit(w * self.window_ms)
             self.merge_s += time.perf_counter() - t0  # alazlint: disable=ALZ010 -- _merge_lock IS held here via the bounded acquire above (the lint only models `with` blocks)
             self.windows_merged += len(windows)  # alazlint: disable=ALZ010 -- held via the bounded acquire above, see merge_s
             self._last_wave_monotonic = time.monotonic()

@@ -50,10 +50,12 @@ from alaz_tpu.chaos.injectors import (
     FlakyTransport,
     FrameChaos,
     WorkerChaos,
+    WorkerCrash,
 )
 from alaz_tpu.config import BackendConfig, ChaosConfig
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.logging import get_logger
+from alaz_tpu.obs.recorder import FlightRecorder
 from alaz_tpu.replay.synth import make_ingest_trace
 from alaz_tpu.utils.ledger import DropLedger
 
@@ -68,13 +70,18 @@ class ChaosReport:
     pipeline: dict = field(default_factory=dict)
     frames: dict = field(default_factory=dict)
     backend: dict = field(default_factory=dict)
+    # flight-recorder trail (ISSUE 9): attached by run_chaos_suite when
+    # any gate failed — the last-N structured events (chaos injections,
+    # worker crashes/restarts, ledger decisions, window spans) so the
+    # failure replays as a story instead of a bare assertion
+    recorder_dump: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
         return not self.findings
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "seed": self.seed,
             "n_workers": self.n_workers,
             "chaos_findings": len(self.findings),
@@ -83,6 +90,9 @@ class ChaosReport:
             "frames": self.frames,
             "backend": self.backend,
         }
+        if self.recorder_dump is not None:
+            out["recorder_dump"] = self.recorder_dump
+        return out
 
 
 def emitted_rows(batches) -> int:
@@ -101,6 +111,7 @@ def _run_pipeline_leg(
     n_rows: int,
     n_windows: int,
     findings: List[str],
+    recorder: Optional[FlightRecorder] = None,
 ) -> dict:
     ev, msgs = make_ingest_trace(
         n_rows, pods=60, svcs=10, windows=n_windows, seed=cfg.seed
@@ -129,6 +140,36 @@ def _run_pipeline_leg(
     chunk = max(2048, n_rows // 32)
     chunks = [ev[i : i + chunk] for i in range(0, n_rows, chunk)]
     delivery, late = bchaos.perturb(chunks)
+    fault_hook = wchaos
+    if recorder is not None:
+        # delivery-seam injections land in the trail once, as a summary
+        recorder.record(
+            "chaos_inject", seam="batch",
+            duplicated=bchaos.duplicated, reordered=bchaos.reordered,
+            late=bchaos.delayed,
+        )
+
+        def fault_hook(i: int, kind: str) -> None:
+            # worker-seam injections: record only when the injector
+            # actually fired ON THIS CALL (the hook runs at every item
+            # boundary). Attribution comes from the raise/return, never
+            # from diffing wchaos's shared totals — concurrent workers
+            # racing between a peer's read and its increment would
+            # record phantom/duplicate injections
+            try:
+                effect = wchaos(i, kind)
+            except WorkerCrash:
+                recorder.record(
+                    "chaos_inject", seam="worker", worker=i,
+                    item_kind=kind, effect="crash",
+                )
+                raise
+            if effect is not None:
+                recorder.record(
+                    "chaos_inject", seam="worker", worker=i,
+                    item_kind=kind, effect=effect,
+                )
+
     pipe = ShardedIngest(
         n_workers,
         interner=interner,
@@ -136,8 +177,9 @@ def _run_pipeline_leg(
         window_s=1.0,
         on_batch=closed.append,
         ledger=ledger,
-        fault_hook=wchaos,
+        fault_hook=fault_hook,
         shed_block_s=0.5,
+        recorder=recorder,
     )
     t0 = time.perf_counter()
     try:
@@ -246,7 +288,11 @@ class _CountingSink:
         return True
 
 
-def _run_frame_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
+def _run_frame_leg(
+    cfg: ChaosConfig,
+    findings: List[str],
+    recorder: Optional[FlightRecorder] = None,
+) -> dict:
     from alaz_tpu.sources.ingest_server import KIND_L7, IngestServer, pack_frame
 
     n_frames, rows_per_frame = 48, 256
@@ -262,6 +308,9 @@ def _run_frame_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
         expect_frames=n_frames,
     )
     ledger = DropLedger()
+    # quarantine decisions land in the suite ring (the ledger hook):
+    # a failing frame gate ships the per-frame drop trail with it
+    ledger.recorder = recorder
     sink = _CountingSink(ledger)
     server = IngestServer(sink, port=0)
     server.start()
@@ -325,7 +374,11 @@ def _run_frame_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
     }
 
 
-def _run_backend_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
+def _run_backend_leg(
+    cfg: ChaosConfig,
+    findings: List[str],
+    recorder: Optional[FlightRecorder] = None,
+) -> dict:
     from alaz_tpu.datastore.backend import BatchingBackend
     from alaz_tpu.datastore.dto import make_requests
 
@@ -363,6 +416,9 @@ def _run_backend_leg(cfg: ChaosConfig, findings: List[str]) -> dict:
         time_fn=time_fn,
         sleep_fn=sleep_fn,
     )
+    # breaker open/close flips land in the suite ring, so a failing
+    # backend gate replays WHEN the export leg went dark
+    be.breaker.recorder = recorder
     appended = 0
     # phase 1 — DEGRADED: cfg-intensity flapping (some sends fail, some
     # land; the breaker may or may not trip — either is legal here)
@@ -447,14 +503,25 @@ def run_chaos_suite(
             backend_error_prob=0.0, backend_timeout_prob=0.0,
         )
     report = ChaosReport(seed=cfg.seed, n_workers=n_workers)
+    # the suite's flight recorder (ISSUE 9): chaos injections, worker
+    # crashes/restarts, ledger decisions and window spans all land in
+    # one ring; a failing gate ships the trail WITH the report
+    recorder = FlightRecorder(capacity=1024)
     if "pipeline" in legs:
         report.pipeline = _run_pipeline_leg(
-            cfg, n_workers, n_rows, n_windows, report.findings
+            cfg, n_workers, n_rows, n_windows, report.findings,
+            recorder=recorder,
         )
     if "frames" in legs:
-        report.frames = _run_frame_leg(cfg, report.findings)
+        report.frames = _run_frame_leg(cfg, report.findings, recorder=recorder)
     if "backend" in legs:
-        report.backend = _run_backend_leg(cfg, report.findings)
+        report.backend = _run_backend_leg(cfg, report.findings, recorder=recorder)
+    if report.findings:
+        report.recorder_dump = recorder.dump()
+        log.warning(
+            "chaos gates failed — flight recorder trail: "
+            f"{recorder.tail_summary(last=64)}"
+        )
     for f in report.findings:
         log.warning(f"chaos finding: {f}")
     return report

@@ -91,9 +91,13 @@ class WorkerChaos:
                 self._rngs[worker] = rng
             return float(rng.random()), float(rng.random())
 
-    def __call__(self, worker: int, kind: str) -> None:
+    def __call__(self, worker: int, kind: str) -> Optional[str]:
+        """Returns ``"stall"`` when THIS call stalled, ``None`` otherwise;
+        a crash raises :class:`WorkerCrash`. Per-call attribution lives in
+        the return/raise — callers must not diff the shared ``crashes``/
+        ``stalls`` totals, which race across concurrent workers."""
         if kind not in self.kinds:
-            return
+            return None
         r_crash, r_stall = self._draw(worker)
         crash = r_crash < self.crash_prob
         if not crash and self.ensure_crash and kind == "close":
@@ -115,6 +119,8 @@ class WorkerChaos:
             with self._lock:
                 self.stalls += 1
             time.sleep(self.stall_s)
+            return "stall"
+        return None
 
 
 class BatchChaos:

@@ -235,6 +235,32 @@ class ChaosConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Window-lifecycle span plane + flight recorder (ISSUE 9,
+    alaz_tpu/obs). Tracing is ON by default — the measured cost is per
+    window×stage, bounded ≤2% rows/s on the 1M-row ingest bench (the
+    ``trace_overhead_pct`` A/B re-measures it every round)."""
+
+    enabled: bool = True
+    # live-span map bound: windows that never complete (scoring disabled
+    # mid-run, shed window queue) evict LRU with a counter, never leak
+    max_live: int = 4096
+    # flight-recorder ring size (structured events, not rows)
+    recorder_capacity: int = 512
+    # dump the recorder tail to the log when a shard worker dies
+    recorder_dump_on_crash: bool = True
+
+    @classmethod
+    def from_env(cls) -> "TraceConfig":
+        return cls(
+            enabled=env_bool("TRACE_ENABLED", True),
+            max_live=env_int("TRACE_MAX_LIVE", 4096),
+            recorder_capacity=env_int("RECORDER_CAPACITY", 512),
+            recorder_dump_on_crash=env_bool("RECORDER_DUMP_ON_CRASH", True),
+        )
+
+
+@dataclass
 class ScenarioConfig:
     """Incident-scenario suite knobs (alaz_tpu/replay/incidents.py).
 
@@ -422,6 +448,9 @@ class RuntimeConfig:
     # deterministic fault injection (alaz_tpu/chaos) — off unless the
     # chaos harness / bench / env flips it
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # window-lifecycle tracing + flight recorder (ISSUE 9, alaz_tpu/obs)
+    # — ON by default; the bench overhead A/B keeps it honest
+    trace: TraceConfig = field(default_factory=TraceConfig)
     # scorer backlog micro-batching: when >1 and the model is
     # window-independent (not tgn), up to this many ALREADY-QUEUED
     # same-bucket windows are stacked and scored through one vmapped
@@ -453,5 +482,6 @@ class RuntimeConfig:
             degree_cap=env_int("DEGREE_CAP", 0),
             sample_seed=env_int("SAMPLE_SEED", 0),
             chaos=ChaosConfig.from_env(),
+            trace=TraceConfig.from_env(),
             score_batch_windows=env_int("SCORE_BATCH_WINDOWS", 1),
         )

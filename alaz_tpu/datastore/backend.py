@@ -84,6 +84,10 @@ class CircuitBreaker:
         self._probe_out = False  # a half-open probe is in flight  # guarded-by: self._lock
         self.opens = 0  # guarded-by: self._lock
         self.shorted = 0  # sends skipped while open  # guarded-by: self._lock
+        # optional flight recorder (ISSUE 9): open/close flips become
+        # structured ring events, so a post-incident dump shows WHEN the
+        # export leg went dark relative to the windows it was shedding
+        self.recorder = None
 
     def allow(self) -> bool:
         """May a send go to the wire right now?"""
@@ -98,27 +102,37 @@ class CircuitBreaker:
             return False
 
     def record(self, ok: bool) -> None:
+        flip: Optional[str] = None
         with self._lock:
             probe = self._probe_out
             self._probe_out = False
             if ok:
                 self._failures = 0
+                if self._opened_at is not None:
+                    flip = "closed"
                 self._opened_at = None
-                return
-            if self._opened_at is not None:
+            elif self._opened_at is not None:
                 if probe:
                     # failed half-open probe: restart the cooldown window
                     self._opened_at = self.time_fn()
                     self.opens += 1
+                    flip = "reopened"
                 # else: a STRAGGLER failure — a send that departed before
                 # the circuit opened (concurrent pump threads). The
                 # outage is already accounted; re-counting it would
                 # inflate `opens` and push recovery out a full cooldown.
-                return
-            self._failures += 1
-            if self._failures >= self.threshold:
-                self._opened_at = self.time_fn()
-                self.opens += 1
+            else:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    self._opened_at = self.time_fn()
+                    self.opens += 1
+                    flip = "opened"
+            opens = self.opens
+        rec = self.recorder
+        if flip is not None and rec is not None:
+            # outside the breaker lock: the recorder has its own ring
+            # lock and never calls back in
+            rec.record("breaker_flip", state=flip, opens=opens)
 
     @property
     def state(self) -> str:

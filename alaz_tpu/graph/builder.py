@@ -25,6 +25,7 @@ from alaz_tpu.datastore.interface import BaseDataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import EventType, ResourceType
 from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.obs.spans import SpanTracer
 
 NODE_FEATURE_DIM = 32
 EDGE_FEATURE_DIM = 16
@@ -639,6 +640,7 @@ class GraphBuilder:
         degree_cap: int = 0,
         sample_seed: int = 0,
         ledger=None,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.nodes = nodes if nodes is not None else NodeTable()
         self.window_s = window_s
@@ -649,6 +651,12 @@ class GraphBuilder:
         self.degree_cap = int(degree_cap)
         self.sample_seed = int(sample_seed)
         self.ledger = ledger
+        # span plane (ISSUE 9): the builder owns three stages of the
+        # window lifecycle — `merge` (grouped reduction/recombine),
+        # `assemble` (feature matrices + pad/bucket) and `sample` (the
+        # degree-cap decision + selection). None = untraced (training,
+        # standalone builds) at zero cost.
+        self.tracer = tracer
         self.sampled_rows = 0  # request rows cut by the cap (cumulative)
         self.sampled_edges = 0  # aggregated edges cut by the cap
 
@@ -665,12 +673,16 @@ class GraphBuilder:
         ``edge_label`` is per-request labels (fault injection ground truth);
         an aggregated edge is labeled 1 if any of its requests were faulty.
         """
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         src_slot = self.nodes.bulk_map(rows["from_uid"], rows["from_type"])
         dst_slot = self.nodes.bulk_map(rows["to_uid"], rows["to_type"])
         # DST-MAJOR key → grouped reduction (C++ when loaded, numpy
         # argsort+reduceat otherwise): the aggregated edge list arrives
         # already dst-sorted, so assembly skips the per-window stable sort
         agg, _ = aggregate_rows(rows, src_slot, dst_slot, edge_label)
+        if tr is not None:
+            tr.observe(window_start_ms, "merge", time.perf_counter() - t0)
         return self._assemble(agg, window_start_ms, window_end_ms)
 
     def build_from_partials(
@@ -687,6 +699,8 @@ class GraphBuilder:
         count/lat/err/tls/label, max for lat_max). Bit-identical to
         ``build`` over the concatenated rows while per-window latency
         sums stay integer-exact in float64 (< 2^53 ns ≈ 104 days)."""
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         from_uid = np.concatenate([p.from_uid for p in partials])
         to_uid = np.concatenate([p.to_uid for p in partials])
         from_type = np.concatenate([p.from_type for p in partials])
@@ -722,6 +736,8 @@ class GraphBuilder:
             tls_sum=sums[4],
             label_sum=sums[5] if has_label else None,
         )
+        if tr is not None:
+            tr.observe(window_start_ms, "merge", time.perf_counter() - t0)
         return self._assemble(agg, window_start_ms, window_end_ms)
 
     def _assemble(
@@ -731,6 +747,8 @@ class GraphBuilder:
         optional locality renumber, pad/bucket. The ONE feature-assembly
         definition the direct and sharded-merge paths share — two copies
         could drift."""
+        tr = self.tracer
+        t0 = time.perf_counter() if tr is not None else 0.0
         n_edges = agg.n_edges
         e_src, e_dst, e_type = agg.e_src, agg.e_dst, agg.e_type
         count = agg.count
@@ -772,7 +790,11 @@ class GraphBuilder:
         # n_edges <= cap is a free sufficient no-op check; past it, one
         # O(E) bincount decides whether any dst actually exceeds the cap
         # (the steady-state service map never does — this path costs one
-        # bincount until the day a hot key shows up).
+        # bincount until the day a hot key shows up). The `sample` span
+        # stage times this whole block — with no cap it measures the
+        # decision branch, so the stage is nonzero in EVERY pipeline and
+        # the span-completeness gate needs no cap conditional.
+        ts0 = time.perf_counter() if tr is not None else 0.0
         if 0 < self.degree_cap < n_edges and int(in_deg.max()) > self.degree_cap:
             uids = self.nodes.uids_array()
             prio = sample_priorities(
@@ -796,6 +818,7 @@ class GraphBuilder:
                 self.sampled_rows += cut_rows
                 if self.ledger is not None:
                     self.ledger.add("sampled", cut_rows, reason="degree_cap")
+        sample_s = (time.perf_counter() - ts0) if tr is not None else 0.0
 
         window_s = max(self.window_s, 1e-6)
         mean_lat = lat_sum / np.maximum(count, 1.0)
@@ -828,7 +851,7 @@ class GraphBuilder:
                 perm, e_src, e_dst, nf, node_type, node_uids
             )
 
-        return GraphBatch.build(
+        batch = GraphBatch.build(
             node_feats=nf,
             node_type=node_type,
             edge_src=e_src,
@@ -843,6 +866,13 @@ class GraphBuilder:
             # renumber path remaps endpoints, so its edges must re-sort)
             sort_by_dst=self.renumber and n_edges > 0,
         )
+        if tr is not None:
+            tr.observe(window_start_ms, "sample", sample_s)
+            tr.observe(
+                window_start_ms, "assemble",
+                (time.perf_counter() - t0) - sample_s,
+            )
+        return batch
 
 
 class WindowedGraphStore(BaseDataStore):
@@ -861,6 +891,7 @@ class WindowedGraphStore(BaseDataStore):
         ledger=None,
         degree_cap: int = 0,
         sample_seed: int = 0,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.interner = interner
         self.window_s = window_s
@@ -871,9 +902,18 @@ class WindowedGraphStore(BaseDataStore):
         # addition to the store-local counter; degree-cap cuts (ISSUE 7)
         # attribute through the builder as `sampled`
         self.ledger = ledger
+        # window-lifecycle span plane (ISSUE 9): ON by default — a store
+        # with no caller-supplied tracer keeps a private one whose spans
+        # complete at emit (no scorer behind it). The service passes its
+        # metrics-registered tracer instead, which stays open through
+        # score/export. Cost is per window×stage, never per row.
+        if tracer is None:
+            tracer = SpanTracer(complete_at_emit=True)
+        self.tracer = tracer
         self.builder = GraphBuilder(
             window_s=window_s, renumber=renumber,
             degree_cap=degree_cap, sample_seed=sample_seed, ledger=ledger,
+            tracer=tracer,
         )
         self.batches: List[GraphBatch] = []
         self.request_count = 0
@@ -919,6 +959,9 @@ class WindowedGraphStore(BaseDataStore):
                     continue
                 rows = batch.copy() if wmin == wmax else batch[wids == w]
                 self._pending.setdefault(w, []).append(rows)
+                # span origin: idempotent, first call per window wins
+                # (lock order: store lock → tracer lock, one direction)
+                self.tracer.first_row(w * self.window_ms)
                 if w > self._watermark:
                     self._watermark = w
             self._close_upto(self._watermark - 1)
@@ -939,12 +982,20 @@ class WindowedGraphStore(BaseDataStore):
         if done:
             self._closed_upto = max(self._closed_upto, max(done))
         for w in sorted(done):
+            ws_ms = w * self.window_ms
+            # the close reached this window: open-window residency since
+            # first_row becomes the `scatter` stage
+            self.tracer.close_start(ws_ms)
+            tc0 = time.perf_counter()
             parts = self._pending.pop(w)
             rows = np.concatenate(parts) if len(parts) > 1 else parts[0]
             labels = self.label_fn(rows) if self.label_fn is not None else None
+            # serial path: the pop+concat+label step is this window's
+            # whole per-shard close (one shard — the store itself)
+            self.tracer.observe(ws_ms, "shard_close", time.perf_counter() - tc0)
             batch = self.builder.build(
                 rows,
-                window_start_ms=w * self.window_ms,
+                window_start_ms=ws_ms,
                 window_end_ms=(w + 1) * self.window_ms,
                 edge_label=labels,
             )
@@ -952,6 +1003,7 @@ class WindowedGraphStore(BaseDataStore):
                 self.on_batch(batch)
             else:
                 self.batches.append(batch)
+            self.tracer.emit(ws_ms)
 
     def flush(self) -> None:
         with self._lock:

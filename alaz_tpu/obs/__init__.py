@@ -1,0 +1,31 @@
+"""The observability plane (ISSUE 9): window-lifecycle span tracing,
+lock-striped log-bucket latency histograms, and a crash-safe flight
+recorder.
+
+- :mod:`alaz_tpu.obs.histogram` — ``Histogram``: mergeable, lock-striped
+  log-bucket distribution with p50/p95/p99 and Prometheus histogram
+  exposition (registered via ``Metrics.histogram``).
+- :mod:`alaz_tpu.obs.spans` — ``SpanTracer``: per-window spans through
+  the named lifecycle stages (first-row → scatter → shard close → merge
+  → assemble → sample → host→device stage → device score → export ack).
+- :mod:`alaz_tpu.obs.recorder` — ``FlightRecorder``: bounded ring of
+  structured events, dumped automatically on worker crash and attached
+  to chaos-gate failures.
+
+Config: ``TRACE_*`` / ``RECORDER_*`` env vars (CONFIG.md, TraceConfig).
+Design notes: ARCHITECTURE §3m.
+"""
+
+from alaz_tpu.obs.histogram import DEFAULT_BOUNDS, Histogram
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.spans import HOST_STAGES, STAGES, SpanTracer, WindowSpan
+
+__all__ = [
+    "DEFAULT_BOUNDS",
+    "Histogram",
+    "FlightRecorder",
+    "HOST_STAGES",
+    "STAGES",
+    "SpanTracer",
+    "WindowSpan",
+]

@@ -2,9 +2,13 @@
 node-exporter-on-:8182 analog (main.go:25,160; backend.go:1038-1105).
 
 Endpoints:
-- ``/metrics``          Prometheus text (service counters/gauges + devices)
+- ``/metrics``          Prometheus text (service counters/gauges + devices
+                        + ``latency.*`` stage histograms, ISSUE 9)
 - ``/healthz``          liveness
-- ``/stats``            JSON snapshot (queue lag, aggregator stats)
+- ``/stats``            JSON snapshot (queue lag, aggregator stats,
+                        per-stage latency percentiles, recorder counters)
+- ``/recorder``         flight-recorder dump (alaz_tpu/obs): the last-N
+                        structured runtime events, oldest→newest
 - ``/stack``            all-thread stack dump (goroutine-profile analog)
 - ``/profiler/start``   begin a JAX profiler trace (``/profiler/stop`` ends;
                         trace dir served back in the response)
@@ -64,7 +68,34 @@ class DebugServer:
                         "scored_batches": svc.scored_batches,
                         "scored_edges": svc.scored_edges,
                     }
+                    tracer = getattr(svc, "tracer", None)
+                    if tracer is not None:
+                        # per-stage latency percentiles (ISSUE 9): the
+                        # "where did window W spend its 0.6s" answer
+                        stats["stage_latency"] = tracer.stage_snapshot()
+                        stats["spans"] = {
+                            "live": tracer.live_count,
+                            "completed": tracer.completed,
+                            "evicted": tracer.evicted,
+                        }
+                    recorder = getattr(svc, "recorder", None)
+                    if recorder is not None:
+                        stats["recorder"] = {
+                            "recorded": recorder.recorded,
+                            "overwritten": recorder.overwritten,
+                            "capacity": recorder.capacity,
+                        }
                     self._send(200, json.dumps(stats, indent=2), "application/json")
+                elif self.path == "/recorder":
+                    recorder = getattr(svc, "recorder", None)
+                    if recorder is None:
+                        self._send(404, "no flight recorder attached")
+                    else:
+                        self._send(
+                            200,
+                            json.dumps(recorder.dump(), indent=2),
+                            "application/json",
+                        )
                 elif self.path == "/stack":
                     buf = io.StringIO()
                     frames = getattr(threading, "_current_frames", lambda: {})()

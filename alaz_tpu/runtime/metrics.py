@@ -9,11 +9,14 @@ snapshot dict for the health/metrics push path.
 
 from __future__ import annotations
 
+import math
 import os
 import re
 import threading
 import time
 from typing import Callable, Dict
+
+from alaz_tpu.obs.histogram import Histogram
 
 
 class Counter:
@@ -34,24 +37,45 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "_fn", "_value")
+    __slots__ = ("name", "_fn", "_value", "_on_error")
 
     def __init__(self, name: str, fn: Callable[[], float] | None = None):
         self.name = name
         self._fn = fn
         self._value = 0.0
+        # wired by the registry: a raising callback used to render `nan`
+        # into the Prometheus text SILENTLY — now every failed read bumps
+        # metrics.gauge_errors and the exposition skips the NaN sample
+        # (ISSUE 9 satellite; scrapers reject NaN-bearing series anyway)
+        self._on_error: Callable[[], None] | None = None
 
     def set(self, v: float) -> None:
         self._value = float(v)
 
+    def _count_error(self) -> None:
+        if self._on_error is not None:
+            try:
+                self._on_error()
+            except Exception:
+                pass
+
     @property
     def value(self) -> float:
+        # NaN is an error signal however it arrives — a raising
+        # callback, a callback computing 0/0, or a direct set(nan) —
+        # and every read of one bumps metrics.gauge_errors, so the
+        # sample's disappearance from snapshot/exposition is never silent
         if self._fn is not None:
             try:
-                return float(self._fn())
+                v = float(self._fn())
             except Exception:
+                self._count_error()
                 return float("nan")
-        return self._value
+        else:
+            v = self._value
+        if math.isnan(v):
+            self._count_error()
+        return v
 
 
 class Metrics:
@@ -59,8 +83,13 @@ class Metrics:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self.started_at = time.time()
+        # registered through the public surface so the golden registry
+        # carries the name like any other metric (ALZ044's scanner
+        # recognizes self-registrations inside this class)
+        self._gauge_errors = self.counter("metrics.gauge_errors")
 
     def info(self, name: str, **labels: str) -> None:
         """Static labeled info metric (the gpu_info/gpu_driver pattern,
@@ -85,25 +114,68 @@ class Metrics:
             g = self._gauges.get(name)
             if g is None:
                 g = Gauge(name, fn)
+                g._on_error = self._gauge_errors.inc
                 self._gauges[name] = g
             elif fn is not None:
                 g._fn = fn
             return g
 
-    def snapshot(self) -> dict:
+    def histogram(self, name: str) -> Histogram:
+        """Lock-striped log-bucket latency histogram (obs/histogram.py):
+        p50/p95/p99 land in the snapshot, the full cumulative-bucket
+        exposition in the Prometheus text."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name)
+                self._histograms[name] = h
+            return h
+
+    def histograms(self) -> Dict[str, Histogram]:
+        with self._lock:
+            return dict(self._histograms)
+
+    def snapshot(self, histograms: bool = True) -> dict:
         with self._lock:
             out = {n: c.value for n, c in self._counters.items()}
-            out.update({n: g.value for n, g in self._gauges.items()})
+            for n, g in self._gauges.items():
+                v = g.value
+                if isinstance(v, float) and math.isnan(v):
+                    # already counted into metrics.gauge_errors by the
+                    # value read (raising OR NaN-computing callbacks,
+                    # and set(nan)): skip the sample — a bare NaN token
+                    # in the health-push JSON would make a strict RFC
+                    # 8259 consumer reject the whole payload
+                    continue
+                out[n] = v
+            hists = list(self._histograms.items()) if histograms else ()
             out["uptime_s"] = time.time() - self.started_at
-            return out
+        # histogram percentile walks happen outside the registry lock
+        # (they take the stripe locks; the registry lock stays cheap)
+        for n, h in hists:
+            snap = h.snapshot()
+            out[f"{n}.count"] = snap["count"]
+            out[f"{n}.p50"] = snap["p50"]
+            out[f"{n}.p95"] = snap["p95"]
+            out[f"{n}.p99"] = snap["p99"]
+        return out
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition (the :8182/inner/metrics analog)."""
+        """Prometheus text exposition (the :8182/inner/metrics analog).
+        Histograms render as real histogram series (cumulative buckets +
+        sum + count); NaN gauge samples are SKIPPED, not emitted — a
+        raising gauge callback already counted into metrics.gauge_errors
+        when its value was read."""
         lines = []
-        for name, value in sorted(self.snapshot().items()):
+        # snapshot() already skips NaN gauge samples (shared with the
+        # health-push JSON path, which must stay strict-RFC-parseable)
+        for name, value in sorted(self.snapshot(histograms=False).items()):
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
+        for name, h in sorted(self.histograms().items()):
+            metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
+            lines.extend(h.render_prometheus(metric))
         def esc(v) -> str:
             # exposition format: backslash, double-quote and newline must
             # be escaped inside label values or the scrape line is invalid

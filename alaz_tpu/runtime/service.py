@@ -28,6 +28,8 @@ from alaz_tpu.events.intern import Interner
 from alaz_tpu.graph.builder import WindowedGraphStore, src_locality_gauges
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.spans import SpanTracer
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges, ledger_gauges
 from alaz_tpu.utils.ledger import DropLedger
 from alaz_tpu.utils.queues import BatchQueue
@@ -197,11 +199,38 @@ class Service:
         self.metrics = Metrics()
         device_gauges(self.metrics)
         host_gauges(self.metrics)
+        # observability plane (ISSUE 9, alaz_tpu/obs): a bounded ring of
+        # structured runtime events (window spans, worker restarts,
+        # breaker flips, every ledger decision) + the per-window span
+        # tracer whose stage durations feed the latency.* histograms.
+        # Tracing is ON by default (TraceConfig / TRACE_ENABLED=0 to
+        # kill); the bench's trace_overhead_pct A/B bounds its cost.
+        tcfg = getattr(self.config, "trace", None)
+        if tcfg is None:
+            from alaz_tpu.config import TraceConfig
+
+            tcfg = TraceConfig()
+        self.recorder = FlightRecorder(
+            capacity=tcfg.recorder_capacity,
+            metrics=self.metrics,
+            dump_on_crash=tcfg.recorder_dump_on_crash,
+        )
         # unified loss accounting (ISSUE 6): every row this service
         # loses — queue-mouth drop, late straggler, quarantined frame,
-        # deliberate shed — lands in exactly one ledger cause
+        # deliberate shed — lands in exactly one ledger cause (and, via
+        # the recorder hook, in the flight-recorder trail)
         self.ledger = DropLedger()
+        self.ledger.recorder = self.recorder
         ledger_gauges(self.metrics, self.ledger)
+        # spans complete at emit when no scorer runs behind the store;
+        # with a model they stay open through stage/score/export
+        self.tracer = SpanTracer(
+            metrics=self.metrics,
+            recorder=self.recorder,
+            enabled=tcfg.enabled,
+            max_live=tcfg.max_live,
+            complete_at_emit=model_state is None,
+        )
         self._export_backend = export_backend
 
         q = self.config.queues
@@ -292,6 +321,8 @@ class Service:
                 fault_hook=fault_hook,
                 degree_cap=degree_cap,
                 sample_seed=sample_seed,
+                tracer=self.tracer,
+                recorder=self.recorder,
             )
             self.graph_store = self.sharded
         if self.graph_store is None:
@@ -303,6 +334,7 @@ class Service:
                 ledger=self.ledger,
                 degree_cap=degree_cap,
                 sample_seed=sample_seed,
+                tracer=self.tracer,
             )
         if self.sharded is not None:
             self.datastore = None  # worker sinks fan out inside the pipeline
@@ -319,6 +351,7 @@ class Service:
                 # semantic (filtered) drops join the service ledger so
                 # conservation needs no side-channel term (ISSUE 8)
                 ledger=self.ledger,
+                recorder=self.recorder,
             )
 
         self.score_sink = score_sink
@@ -426,6 +459,8 @@ class Service:
                     export_backend.breaker.state
                 ],
             )
+            # breaker flips land in the flight-recorder trail (ISSUE 9)
+            export_backend.breaker.recorder = self.recorder
         # the TPU analog of the NVML gpu_utz gauge: fraction of wall time
         # the scorer spends in device compute (includes host→device feed)
         self._scorer_busy_s = 0.0
@@ -474,6 +509,9 @@ class Service:
                 np.rint(np.expm1(batch.edge_feats[: batch.n_edges, 0])).sum()
             )
             self.ledger.add("shed", rows, reason="windows")
+            # a shed window never reaches the scorer: drop its live span
+            # (an eviction tick, not a leak) instead of leaving it open
+            self.tracer.discard(batch.window_start_ms)
         self.metrics.counter("windows.closed").inc()
         # the banded src-gather's cost models on live traffic: lets an
         # operator read off whether SRC_GATHER=banded would pay here.
@@ -597,14 +635,23 @@ class Service:
         def record_window(batch, logits) -> None:
             """Per-window accounting + export — the ONE definition both
             the serial and batched paths share (their score parity is a
-            tested invariant; two copies of this block could drift)."""
+            tested invariant; two copies of this block could drift).
+            Times the export-ack leg and COMPLETES the window's span —
+            the last lifecycle stage, so completion lives here and only
+            here."""
             self.scored_batches += 1
             self.scored_edges += batch.n_edges
             self.metrics.counter("scored.edges").inc(batch.n_edges)
+            te0 = time_module.perf_counter()
             if self.score_sink is not None:
                 annotated = self._annotate(batch, logits)
                 if len(annotated):
                     self.score_sink(annotated)
+            self.tracer.observe(
+                batch.window_start_ms, "export",
+                time_module.perf_counter() - te0,
+            )
+            self.tracer.complete(batch.window_start_ms)
 
         def score_one(batch, graph) -> None:
             """Score one window; always settles its task_done."""
@@ -620,7 +667,9 @@ class Service:
                     self.metrics.gauge("model.attn_clamp_saturation").set(
                         float(out["attn_clamp_saturation"])
                     )
-                self._scorer_busy_s += time_module.perf_counter() - t0
+                dt = time_module.perf_counter() - t0
+                self._scorer_busy_s += dt
+                self.tracer.observe(batch.window_start_ms, "score", dt)
                 record_window(batch, logits)
             finally:
                 self.window_queue.task_done()
@@ -660,8 +709,14 @@ class Service:
                     (batches[0].n_pad, batches[0].e_pad), cols
                 )
                 stacked = {k: jnp.asarray(v) for k, v in arena.items()}
+                stage_s = time_module.perf_counter() - t0
                 out = self._score_many_fn(self.model_state, stacked)
                 self._scorer_busy_s += time_module.perf_counter() - t0
+                # the whole group staged in one arena fill + transfer:
+                # each member's span carries the shared staging time
+                # (critical-path semantics — observe keeps the max)
+                for b in batches:
+                    self.tracer.observe(b.window_start_ms, "stage", stage_s)
                 return ("group", batches, out)
             except BaseException:
                 for _ in batches:
@@ -678,8 +733,12 @@ class Service:
                     self.metrics.gauge("model.attn_clamp_saturation").set(
                         float(np.max(np.asarray(out["attn_clamp_saturation"])))
                     )
-                self._scorer_busy_s += time_module.perf_counter() - t0
+                dt = time_module.perf_counter() - t0
+                self._scorer_busy_s += dt
                 for i, batch in enumerate(batches):
+                    # shared device time for the vmapped group — each
+                    # window's `score` stage carries the group dispatch
+                    self.tracer.observe(batch.window_start_ms, "score", dt)
                     record_window(batch, logits[i])
             finally:
                 for _ in batches:
@@ -709,6 +768,12 @@ class Service:
                         continue
                     (batch,) = item
                 if self._score_fn is None or self.model_state is None:
+                    # scoring disabled ⟺ no model_state ⟺ the tracer
+                    # completes spans at emit, on the CLOSING thread —
+                    # which may still be between on_batch and emit for
+                    # this very window. Do NOT discard here: the drive
+                    # test caught that racing it destroys the span
+                    # before emit can complete it.
                     self.window_queue.task_done()
                     continue
                 # backlog micro-batching (config.score_batch_windows):
@@ -746,7 +811,9 @@ class Service:
                     graph = {
                         k: jnp.asarray(v) for k, v in batch.device_arrays().items()
                     }
-                    self._scorer_busy_s += time_module.perf_counter() - t0
+                    dt = time_module.perf_counter() - t0
+                    self._scorer_busy_s += dt
+                    self.tracer.observe(batch.window_start_ms, "stage", dt)
                 except Exception:
                     # the popped window still owes its accounting
                     self.window_queue.task_done()

@@ -62,6 +62,11 @@ class DropLedger:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {c: 0 for c in self.CAUSES}  # guarded-by: self._lock
         self._reasons: Dict[Tuple[str, str], int] = {}  # guarded-by: self._lock
+        # optional flight recorder (ISSUE 9, alaz_tpu/obs): when attached,
+        # every ledger decision becomes a structured ring event — the
+        # drop trail a post-incident dump replays. Attach-once at wiring
+        # time (service / harness); adds are per-chunk, never per row.
+        self.recorder = None
 
     def add(self, cause: str, n: int, reason: Optional[str] = None) -> None:
         """Attribute ``n`` lost rows to ``cause``. Unknown causes raise —
@@ -78,6 +83,11 @@ class DropLedger:
             if reason is not None:
                 key = (cause, reason)
                 self._reasons[key] = self._reasons.get(key, 0) + int(n)
+        rec = self.recorder
+        if rec is not None:
+            # outside the ledger lock: the recorder has its own ring
+            # lock and never calls back into the ledger
+            rec.record("ledger", cause=cause, n=int(n), reason=reason)
 
     def count(self, cause: str) -> int:
         with self._lock:

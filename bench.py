@@ -242,10 +242,18 @@ def bench_ingest(args) -> dict:
     ev, msgs = make_ingest_trace(n_rows, windows=windows)
     chunk = 1 << 16
 
-    def run_once() -> tuple[float, int, int]:
+    def run_once(trace: bool = True):
+        """One serial pass. ``trace`` arms the span plane (the default,
+        as in production); ``trace=False`` is the A/B arm that bounds
+        its cost. Returns (dt, windows, edges, tracer)."""
+        from alaz_tpu.obs.spans import SpanTracer
+
         interner = Interner()
         closed = []
-        store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+        tracer = SpanTracer(enabled=trace, complete_at_emit=True)
+        store = WindowedGraphStore(
+            interner, window_s=1.0, on_batch=closed.append, tracer=tracer
+        )
         cluster = ClusterInfo(interner)
         for m in msgs:
             cluster.handle_msg(m)
@@ -256,13 +264,17 @@ def bench_ingest(args) -> dict:
         store.flush()
         dt = time.perf_counter() - t0
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges
+        return dt, len(closed), edges, tracer
 
-    def run_once_sharded(n: int) -> tuple[float, int, int, float]:
+    def run_once_sharded(n: int, trace: bool = True):
         """One sharded-pipeline pass (aggregator/sharded.py): same trace,
-        same chunking, N shard workers + merge thread. Returns
-        (wall, windows, edges, merge-stage share of wall)."""
+        same chunking, N shard workers + merge thread. ``trace=False`` is
+        the A/B arm bounding the span plane's cost on THIS pipeline —
+        the headline arm under --workers, where N workers share one
+        SpanTracer lock. Returns (wall, windows, edges, merge-stage
+        share of wall, tracer)."""
         from alaz_tpu.aggregator.sharded import ShardedIngest
+        from alaz_tpu.obs.spans import SpanTracer
 
         interner = Interner()
         closed = []
@@ -272,6 +284,7 @@ def bench_ingest(args) -> dict:
         pipe = ShardedIngest(
             n, interner=interner, cluster=cluster, window_s=1.0,
             on_batch=closed.append, queue_events=1 << 20,
+            tracer=SpanTracer(enabled=trace, complete_at_emit=True),
         )
         t0 = time.perf_counter()
         for i in range(0, n_rows, chunk):
@@ -285,7 +298,7 @@ def bench_ingest(args) -> dict:
         merge_share = pipe.merge_s / dt if dt > 0 else 0.0
         pipe.stop()
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges, merge_share
+        return dt, len(closed), edges, merge_share, pipe.tracer
 
     # the host path must never touch XLA: any compile during ingest is a
     # retrace regression (a jit leaking into the hot loop), so the
@@ -303,20 +316,53 @@ def bench_ingest(args) -> dict:
     # no warm-up run: every run_once builds fresh state, and best-of-N
     # already absorbs cold-start effects
     def measure():
-        """(dt, windows, edges[, merge_share]) best-of-repeats for the
-        serial path and, with --workers, for each pool width up to it —
-        the worker_scaling curve the acceptance protocol records."""
+        """(best traced run, best untraced run, scaling) — each arm is
+        best-of-repeats, arms alternate so machine drift hits both. The
+        traced arm is the HEADLINE (tracing ships on by default); the
+        untraced arm exists to re-measure trace_overhead_pct every
+        round, keeping the ≤2% span-plane bound honest."""
         repeats = max(1, args.repeats)
-        best = min((run_once() for _ in range(repeats)), key=lambda r: r[0])
+        on_runs, off_runs = [], []
+        for i in range(repeats):
+            # alternate which arm goes first: the process's first pass
+            # pays one-time warmup (allocator, import, branch caches)
+            # and must not land on the same arm every round. Under
+            # --workers the serial untraced arm is skipped entirely —
+            # its overhead number is superseded by the sharded A/B
+            # below, so it would be R wasted full-trace passes
+            if args.workers >= 1:
+                on_runs.append(run_once(trace=True))
+            elif i % 2 == 0:
+                on_runs.append(run_once(trace=True))
+                off_runs.append(run_once(trace=False))
+            else:
+                off_runs.append(run_once(trace=False))
+                on_runs.append(run_once(trace=True))
+        best = min(on_runs, key=lambda r: r[0])
+        best_off = min(off_runs, key=lambda r: r[0]) if off_runs else None
         scaling = None
+        sharded_off = None
         if args.workers >= 1:
             widths = sorted({1, min(2, args.workers), args.workers})
             per_n = {}
             for n in widths:
-                b = min(
-                    (run_once_sharded(n) for _ in range(repeats)),
-                    key=lambda r: r[0],
-                )
+                runs_on, runs_off = [], []
+                for i in range(repeats):
+                    # headline width: alternate a spans-off arm too, so
+                    # the published overhead bound covers the SHARDED
+                    # tracer path (N workers on one SpanTracer lock) —
+                    # the arm the headline rows/s is measured on
+                    if n == args.workers and i % 2 == 1:
+                        runs_off.append(run_once_sharded(n, trace=False))
+                        runs_on.append(run_once_sharded(n))
+                    elif n == args.workers:
+                        runs_on.append(run_once_sharded(n))
+                        runs_off.append(run_once_sharded(n, trace=False))
+                    else:
+                        runs_on.append(run_once_sharded(n))
+                b = min(runs_on, key=lambda r: r[0])
+                if runs_off:
+                    sharded_off = min(runs_off, key=lambda r: r[0])
                 per_n[n] = b
                 print(
                     f"# ingest workers={n} rows={n_rows} windows_closed={b[1]} "
@@ -325,16 +371,29 @@ def bench_ingest(args) -> dict:
                     file=sys.stderr,
                 )
             scaling = per_n
-        return best, scaling
+        return best, best_off, scaling, sharded_off
 
     if compile_watcher is not None:
         with compile_watcher:
-            best, scaling = measure()
+            best, best_off, scaling, sharded_off = measure()
     else:
-        best, scaling = measure()
-    dt, n_windows, n_edges = best
+        best, best_off, scaling, sharded_off = measure()
+    dt, n_windows, n_edges, tracer = best
     serial_rows_per_s = n_rows / dt
     rows_per_s = serial_rows_per_s
+    # spans-on vs spans-off A/B (ISSUE 9): positive = tracing costs
+    # rows/s. The acceptance bound is ≤ 2 on the 1M-row trace. Under
+    # --workers the serial arm was skipped (best_off None) and the
+    # sharded A/B below supplies the published number instead.
+    trace_overhead_pct = 0.0
+    if best_off is not None:
+        trace_overhead_pct = (1.0 - best_off[0] / dt) * 100.0 if dt > 0 else 0.0
+        print(
+            f"# ingest trace A/B: on={n_rows/dt:,.0f} rows/s "
+            f"off={n_rows/best_off[0]:,.0f} rows/s "
+            f"overhead={trace_overhead_pct:.2f}%",
+            file=sys.stderr,
+        )
     worker_scaling = None
     if scaling is not None:
         # the headline number is the requested pool width; the sub-dict
@@ -342,6 +401,18 @@ def bench_ingest(args) -> dict:
         head = scaling[args.workers]
         rows_per_s = n_rows / head[0]
         dt, n_windows, n_edges = head[0], head[1], head[2]
+        tracer = head[4]  # the sharded pipeline's span plane
+        # the published overhead must describe the HEADLINE arm: under
+        # --workers that is the sharded pipeline, so the serial A/B
+        # above is superseded by the sharded on/off pair
+        trace_overhead_pct = (1.0 - sharded_off[0] / dt) * 100.0 if dt > 0 else 0.0
+        print(
+            f"# ingest trace A/B [workers{args.workers}]: "
+            f"on={n_rows/dt:,.0f} rows/s "
+            f"off={n_rows/sharded_off[0]:,.0f} rows/s "
+            f"overhead={trace_overhead_pct:.2f}%",
+            file=sys.stderr,
+        )
         worker_scaling = {
             "serial_rows_per_sec": round(serial_rows_per_s),
             "per_n_rows_per_sec": {
@@ -349,6 +420,19 @@ def bench_ingest(args) -> dict:
             },
             "merge_share": round(head[3], 4),
         }
+    # per-stage latency breakdown (ISSUE 9): where a window's wall time
+    # went, p50/p99 per lifecycle stage, from the HEADLINE pipeline's
+    # span plane. Host-only pipeline → the host stage prefix; every
+    # published stage must be nonzero (the acceptance gate).
+    snap = tracer.stage_snapshot()
+    stage_latency = {
+        s: {
+            "count": snap[s]["count"],
+            "p50_ms": snap[s]["p50_ms"],
+            "p99_ms": snap[s]["p99_ms"],
+        }
+        for s in tracer.expected_stages
+    }
     print(
         f"# ingest rows={n_rows} windows_closed={n_windows} agg_edges={n_edges} "
         f"wall={dt*1e3:.1f}ms",
@@ -427,6 +511,8 @@ def bench_ingest(args) -> dict:
         "chaos_findings": chaos_findings,
         "scenario_findings": scenario_findings,
         "flow_findings": flow_findings,
+        "stage_latency": stage_latency,
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
     }
     if worker_scaling is not None:
         out["workers"] = args.workers

@@ -175,6 +175,24 @@ class TestTriangulation:
             "`python -m tools.alazflow --write-metrics` and review"
         )
 
+    def test_self_registration_inside_metrics_class_is_seen(self, tmp_path):
+        # the registry must not depend on a local being NAMED `metrics`:
+        # self.counter(...) inside the Metrics class IS a registration
+        # (a rename of camouflage aliases must not blind the scanner)
+        src = (
+            "class Metrics:\n"
+            "    def __init__(self):\n"
+            "        self._e = self.counter('metrics.gauge_errors')\n"
+            "class Other:\n"
+            "    def __init__(self):\n"
+            "        self.c = self.counter('not.a.registration')\n"
+        )
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        ctxs, _ = _parse([str(p)])
+        names = [n for _, _, n, _ in vocabrules.metric_sites(ctxs)]
+        assert names == ["metrics.gauge_errors"]
+
     def test_stale_golden_name_is_flagged(self, tmp_path):
         golden = json.loads(
             (REPO / "resources" / "specs" / "metrics.json").read_text()

@@ -31,6 +31,7 @@ from alaz_tpu.chaos import (
     DropLedger,
     FrameChaos,
     WorkerChaos,
+    WorkerCrash,
     emitted_rows,
     run_chaos_suite,
 )
@@ -93,6 +94,22 @@ class TestBatchChaos:
         assert bc.duplicated >= 1 and bc.reordered >= 1 and bc.delayed >= 1
         assert len(late) == bc.delayed
         assert len(delivery) == 10 - len(late) + bc.duplicated
+
+
+class TestWorkerChaosAttribution:
+    def test_call_reports_its_own_effect(self):
+        """Per-call attribution rides the raise/return — NOT the shared
+        crashes/stalls totals, which race across concurrent workers (a
+        peer's increment between one worker's read and its check used to
+        record phantom chaos_inject events in the recorder trail)."""
+        wc = WorkerChaos(seed=0, crash_prob=1.0, max_crashes=1, kinds=("l7",))
+        with pytest.raises(WorkerCrash):
+            wc(0, "l7")
+        assert wc(0, "tcp") is None  # kind not at risk: no effect
+        assert wc(0, "l7") is None  # crash budget spent: no effect
+        ws = WorkerChaos(seed=0, stall_prob=1.0, stall_s=0.0, kinds=("l7",))
+        assert ws(0, "l7") == "stall"
+        assert ws.stalls == 1
 
 
 def _mk_pipe(ev_msgs, n_workers, **kw):

@@ -1,0 +1,590 @@
+"""The observability plane (ISSUE 9): lock-striped log-bucket
+histograms, the window-lifecycle span tracer, and the flight recorder.
+
+Covers the satellite checklist: histogram merge associativity +
+percentile accuracy bounds, the Prometheus histogram exposition golden,
+flight-recorder ring wraparound + crash-dump-on-``WorkerCrash``, the
+gauge-error NaN-skip regression, and the end-to-end gate that every
+emitted window carries a COMPLETE span (no stage missing) under
+``ShardedIngest`` N ∈ {1, 2, 4} and the serial store.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.aggregator.sharded import ShardedIngest, WorkerCrash
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.obs.histogram import DEFAULT_BOUNDS, Histogram
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.spans import HOST_STAGES, STAGES, SpanTracer
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.runtime.metrics import Metrics
+
+
+# ---------------------------------------------------------------------------
+# Histogram
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_count_and_sum(self):
+        h = Histogram("t")
+        for v in (0.001, 0.002, 0.004):
+            h.observe(v)
+        assert h.total_count == 3
+        assert math.isclose(h.total_sum, 0.007)
+
+    def test_negative_values_clamp_to_zero(self):
+        h = Histogram("t")
+        h.observe(-1.0)  # clock skew must not throw or corrupt
+        assert h.total_count == 1
+        assert h.percentile(0.5) >= 0.0
+
+    def test_percentile_factor_two_accuracy_bound(self):
+        # the documented contract: buckets grow 2x, so any reported
+        # quantile sits within [true/2, true*2] of the order statistic
+        rng = np.random.default_rng(7)
+        samples = np.exp(rng.normal(-5.0, 1.5, size=5000))  # ~ms scale
+        h = Histogram("t")
+        for v in samples:
+            h.observe(float(v))
+        for q in (0.50, 0.95, 0.99):
+            true = float(np.quantile(samples, q))
+            got = h.percentile(q)
+            assert true / 2.0 <= got <= true * 2.0, (q, true, got)
+
+    def test_merge_is_associative_and_order_invisible(self):
+        rng = np.random.default_rng(3)
+        parts = []
+        for k in range(3):
+            h = Histogram(f"p{k}")
+            for v in rng.uniform(1e-5, 10.0, size=200):
+                h.observe(float(v))
+            parts.append(h)
+        a, b, c = parts
+        left = a.copy().merge(b).merge(c)  # (a + b) + c
+        right = a.copy().merge(b.copy().merge(c))  # a + (b + c)
+        swapped = c.copy().merge(a).merge(b)  # commuted
+        assert left.bucket_counts() == right.bucket_counts()
+        assert left.bucket_counts() == swapped.bucket_counts()
+        assert left.total_count == right.total_count == swapped.total_count
+        assert math.isclose(left.total_sum, right.total_sum)
+        for q in (0.5, 0.95, 0.99):
+            assert left.percentile(q) == right.percentile(q) == swapped.percentile(q)
+
+    def test_merge_rejects_mismatched_ladder(self):
+        with pytest.raises(ValueError):
+            Histogram("a").merge(Histogram("b", bounds=(0.1, 1.0)))
+
+    def test_concurrent_observe_loses_nothing(self):
+        # the lock-striped hot path: N threads hammering one histogram
+        # must account every sample exactly (no off-lock increments)
+        h = Histogram("t")
+        n_threads, per = 8, 5000
+
+        def work(i):
+            for _ in range(per):
+                h.observe(0.001 * (i + 1))
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.total_count == n_threads * per
+        assert sum(h.bucket_counts()) == n_threads * per
+
+    def test_stripes_actually_distribute_across_threads(self):
+        # regression: `get_ident() % N` maps every Linux thread to
+        # stripe 0 (idents are stack addresses aligned to MB
+        # boundaries) — the striping must be round-robin per thread,
+        # or N workers contend on ONE lock and the design is a lie
+        from alaz_tpu.obs.histogram import N_STRIPES
+
+        h = Histogram("t")
+        n_threads = N_STRIPES
+
+        def work():
+            h.observe(0.001)
+
+        ts = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        touched = sum(1 for s in h._stripes if s.count > 0)
+        # N fresh threads get N consecutive round-robin indices →
+        # every stripe sees exactly one observation
+        assert touched == N_STRIPES, f"only {touched}/{N_STRIPES} stripes used"
+
+    def test_prometheus_exposition_golden(self):
+        # compact custom ladder so the golden is readable: cumulative
+        # le buckets, +Inf == count, sum, count (node_exporter shape)
+        h = Histogram("t", bounds=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.005, 0.05, 0.5):
+            h.observe(v)
+        lines = h.render_prometheus("alaz_test_latency")
+        assert lines[0] == "# TYPE alaz_test_latency histogram"
+        assert lines[1] == 'alaz_test_latency_bucket{le="0.001"} 1'
+        assert lines[2] == 'alaz_test_latency_bucket{le="0.01"} 2'
+        assert lines[3] == 'alaz_test_latency_bucket{le="0.1"} 3'
+        assert lines[4] == 'alaz_test_latency_bucket{le="+Inf"} 4'
+        assert lines[5].startswith("alaz_test_latency_sum ")
+        assert math.isclose(float(lines[5].split()[1]), 0.5555)
+        assert lines[6] == "alaz_test_latency_count 4"
+
+    def test_default_ladder_spans_microseconds_to_minutes(self):
+        assert DEFAULT_BOUNDS[0] == pytest.approx(1e-6)
+        assert DEFAULT_BOUNDS[-1] > 300.0  # a wedged close wave still lands
+
+    def test_snapshot_merges_stripes_exactly_once(self):
+        # count and p50/p95/p99 must come from ONE merged instant — a
+        # per-percentile re-merge quadruples read-side lock traffic and
+        # lets count disagree with the percentile basis under writes
+        h = Histogram("t")
+        for v in (0.001, 0.002, 0.004, 0.008):
+            h.observe(v)
+        merges = []
+        orig = Histogram._merged
+
+        def counting(self):
+            merges.append(1)
+            return orig(self)
+
+        Histogram._merged = counting
+        try:
+            snap = h.snapshot()
+        finally:
+            Histogram._merged = orig
+        assert len(merges) == 1
+        assert snap["count"] == 4
+        assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry integration (histogram + the gauge NaN regression)
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsIntegration:
+    def test_histogram_registry_and_snapshot_percentiles(self):
+        m = Metrics()
+        h = m.histogram("latency.test_s")
+        assert m.histogram("latency.test_s") is h  # stable registration
+        h.observe(0.01)
+        snap = m.snapshot()
+        assert snap["latency.test_s.count"] == 1
+        assert snap["latency.test_s.p50"] > 0.0
+        assert snap["latency.test_s.p99"] > 0.0
+
+    def test_histogram_renders_into_prometheus_text(self):
+        m = Metrics()
+        m.histogram("latency.test_s").observe(0.01)
+        text = m.render_prometheus()
+        assert "# TYPE alaz_tpu_latency_test_s histogram" in text
+        assert 'alaz_tpu_latency_test_s_bucket{le="+Inf"} 1' in text
+        assert "alaz_tpu_latency_test_s_count 1" in text
+
+    def test_raising_gauge_skips_nan_and_counts_error(self):
+        # regression (ISSUE 9 satellite): a raising callback used to
+        # render `nan` into the Prometheus text silently
+        m = Metrics()
+        m.gauge("bad.gauge", lambda: 1 / 0)
+        m.gauge("good.gauge").set(3.0)
+        text = m.render_prometheus()
+        assert "nan" not in text.lower().replace("alaz_tpu_", "")
+        assert "bad_gauge" not in text  # skipped, not emitted as 0/nan
+        assert "alaz_tpu_good_gauge 3.0" in text
+        # every failed read counted — render reads the gauge once
+        assert m.counter("metrics.gauge_errors").value >= 1
+
+    def test_raising_gauge_skipped_from_snapshot_json(self):
+        # the health push serializes snapshot() with json.dumps — a NaN
+        # sample would emit a bare `NaN` token and make a strict RFC
+        # 8259 consumer reject the whole payload, exactly when a gauge
+        # is already erroring
+        import json
+
+        m = Metrics()
+        m.gauge("bad.gauge", lambda: 1 / 0)
+        m.gauge("good.gauge").set(3.0)
+        snap = m.snapshot()
+        assert "bad.gauge" not in snap
+        assert snap["good.gauge"] == 3.0
+        json.dumps(snap, allow_nan=False)  # must not raise
+        assert m.counter("metrics.gauge_errors").value >= 1
+
+    def test_nonraising_nan_gauge_also_skipped_and_counted(self):
+        # NaN is an error signal however it arrives: a callback that
+        # COMPUTES NaN (0/0 ratio) or a direct set(nan) must not vanish
+        # from the exposition with gauge_errors still at 0
+        m = Metrics()
+        m.gauge("ratio.gauge", lambda: float("nan"))
+        m.gauge("set.gauge").set(float("nan"))
+        snap = m.snapshot()
+        assert "ratio.gauge" not in snap
+        assert "set.gauge" not in snap
+        assert m.counter("metrics.gauge_errors").value >= 2
+
+    def test_healthy_gauges_unaffected_by_error_counter(self):
+        m = Metrics()
+        m.gauge("ok.gauge", lambda: 7.0)
+        m.render_prometheus()
+        assert m.counter("metrics.gauge_errors").value == 0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class _StubLog:
+    def __init__(self):
+        self.errors = []
+
+    def error(self, msg):
+        self.errors.append(msg)
+
+
+class TestFlightRecorder:
+    def test_ring_wraparound_keeps_last_n_in_order(self):
+        r = FlightRecorder(capacity=8)
+        for i in range(20):
+            r.record("tick", i=i)
+        evs = r.events()
+        assert len(evs) == 8
+        assert [e["seq"] for e in evs] == list(range(12, 20))  # oldest→newest
+        assert [e["i"] for e in evs] == list(range(12, 20))
+        assert r.recorded == 20
+        assert r.overwritten == 12
+
+    def test_dump_and_dump_text(self):
+        r = FlightRecorder(capacity=4)
+        r.record("breaker_flip", state="opened")
+        d = r.dump()
+        assert d["capacity"] == 4 and d["recorded"] == 1
+        assert d["events"][0]["kind"] == "breaker_flip"
+        txt = r.dump_text()
+        assert "breaker_flip" in txt and "state=opened" in txt
+
+    def test_crash_dump_writes_tail_to_logger(self):
+        r = FlightRecorder(capacity=8)
+        r.record("worker_crash", worker=1)
+        log = _StubLog()
+        r.crash_dump(log, "shard1 died")
+        assert len(log.errors) == 1
+        assert "shard1 died" in log.errors[0]
+        assert "worker_crash" in log.errors[0]
+
+    def test_crash_dump_gated_by_dump_on_crash(self):
+        r = FlightRecorder(capacity=8, dump_on_crash=False)
+        r.record("worker_crash", worker=1)
+        log = _StubLog()
+        r.crash_dump(log, "shard1 died")
+        assert log.errors == []
+
+    def test_reserved_field_names_never_collide(self):
+        # a caller field named `kind` used to TypeError (and get
+        # swallowed by worker poison nets); `t`/`seq` silently corrupted
+        # the envelope. Reserved names now land under a field_ prefix.
+        r = FlightRecorder(capacity=4)
+        r.record("ledger", kind="l7", t=123.0, seq=99, cause="dropped")
+        (ev,) = r.events()
+        assert ev["kind"] == "ledger"
+        assert ev["seq"] == 0
+        assert ev["t"] != 123.0
+        assert ev["field_kind"] == "l7"
+        assert ev["field_t"] == 123.0
+        assert ev["field_seq"] == 99
+        assert ev["cause"] == "dropped"
+
+    def test_recorder_gauges_register(self):
+        m = Metrics()
+        r = FlightRecorder(capacity=4, metrics=m)
+        r.record("tick")
+        snap = m.snapshot()
+        assert snap["recorder.recorded"] == 1
+        assert snap["recorder.overwritten"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Span tracer units
+# ---------------------------------------------------------------------------
+
+
+class TestSpanTracer:
+    def test_disabled_tracer_is_inert(self):
+        tr = SpanTracer(enabled=False)
+        tr.first_row(1000)
+        tr.close_start(1000)
+        tr.observe(1000, "merge", 0.1)
+        assert tr.complete(1000) is None
+        assert tr.live_count == 0
+
+    def test_complete_feeds_histograms_once_per_stage(self):
+        tr = SpanTracer()
+        tr.first_row(1000)
+        tr.close_start(1000)
+        tr.observe(1000, "merge", 0.25)
+        span = tr.complete(1000)
+        assert span is not None and "merge" in span.stages
+        assert tr.hists["merge"].total_count == 1
+        assert tr.complete(1000) is None  # already popped
+
+    def test_observe_keeps_critical_path_max(self):
+        # per-shard parallel closes all report; the span carries the max
+        tr = SpanTracer()
+        tr.observe(1000, "shard_close", 0.5)
+        tr.observe(1000, "shard_close", 0.2)
+        span = tr.complete(1000)
+        assert span.stages["shard_close"] == 0.5
+
+    def test_live_map_bounded_lru_eviction(self):
+        tr = SpanTracer(max_live=16)
+        for w in range(20):
+            tr.first_row(w * 1000)
+        assert tr.live_count == 16
+        assert tr.evicted == 4
+
+    def test_eviction_is_lru_not_fifo(self):
+        # an actively-observed straggler (oldest window, mid-score) must
+        # NOT be the eviction victim while idle newer spans survive
+        tr = SpanTracer(max_live=16)
+        for w in range(16):
+            tr.first_row(w * 1000)
+        tr.observe(0, "score", 0.5)  # touch the oldest
+        tr.first_row(16 * 1000)  # overflow: evicts window 1000, not 0
+        span = tr.complete(0)
+        assert span is not None and span.stages["score"] == 0.5
+        assert tr.complete(1000) is None  # the untouched one was evicted
+
+    def test_emit_completes_only_in_emit_mode(self):
+        tr = SpanTracer(complete_at_emit=True)
+        tr.first_row(1000)
+        tr.emit(1000)
+        assert tr.live_count == 0 and tr.completed == 1
+        tr2 = SpanTracer(complete_at_emit=False)
+        tr2.first_row(1000)
+        tr2.emit(1000)
+        assert tr2.live_count == 1 and tr2.completed == 0
+
+    def test_expected_stages_follow_pipeline_shape(self):
+        assert SpanTracer(complete_at_emit=True).expected_stages == HOST_STAGES
+        assert SpanTracer().expected_stages == STAGES
+
+    def test_completed_span_lands_in_recorder(self):
+        rec = FlightRecorder(capacity=8)
+        tr = SpanTracer(recorder=rec, complete_at_emit=True)
+        tr.first_row(1000)
+        tr.close_start(1000)
+        tr.observe(1000, "merge", 0.01)
+        tr.emit(1000)
+        evs = [e for e in rec.events() if e["kind"] == "window_span"]
+        assert len(evs) == 1
+        assert evs[0]["window_start_ms"] == 1000
+        assert "merge" in evs[0]["stages"]
+
+
+# ---------------------------------------------------------------------------
+# End to end: every emitted window carries a complete span
+# ---------------------------------------------------------------------------
+
+
+def _span_events(rec):
+    return {
+        e["window_start_ms"]: e["stages"]
+        for e in rec.events()
+        if e["kind"] == "window_span"
+    }
+
+
+class TestEndToEndSpans:
+    N_ROWS = 32768
+
+    def test_serial_store_emits_complete_spans(self):
+        ev, msgs = make_ingest_trace(self.N_ROWS, windows=4, seed=1)
+        interner = Interner()
+        closed = []
+        rec = FlightRecorder(capacity=64)
+        tracer = SpanTracer(recorder=rec, complete_at_emit=True)
+        store = WindowedGraphStore(
+            interner, window_s=1.0, on_batch=closed.append, tracer=tracer
+        )
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        for i in range(0, self.N_ROWS, 1 << 13):
+            agg.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+        store.flush()
+        assert closed
+        spans = _span_events(rec)
+        for b in closed:
+            assert b.window_start_ms in spans
+            missing = [s for s in HOST_STAGES if s not in spans[b.window_start_ms]]
+            assert not missing, f"window {b.window_start_ms} missing {missing}"
+        assert tracer.live_count == 0  # nothing leaked
+        assert tracer.completed == len(closed)
+
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_sharded_emits_complete_spans(self, n_workers):
+        ev, msgs = make_ingest_trace(self.N_ROWS, windows=4, seed=2)
+        interner = Interner()
+        closed = []
+        rec = FlightRecorder(capacity=64)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            n_workers, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, recorder=rec,
+        )
+        try:
+            for i in range(0, self.N_ROWS, 1 << 13):
+                pipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert closed
+        spans = _span_events(rec)
+        for b in closed:
+            assert b.window_start_ms in spans
+            missing = [s for s in HOST_STAGES if s not in spans[b.window_start_ms]]
+            assert not missing, f"window {b.window_start_ms} missing {missing}"
+        # per-stage histograms saw one sample per window per stage
+        for s in HOST_STAGES:
+            assert pipe.tracer.hists[s].total_count == len(closed), s
+        assert pipe.tracer.live_count == 0
+
+    def test_worker_crash_dumps_flight_recorder(self):
+        """An injected WorkerCrash must (a) land in the ring as a
+        worker_crash event, (b) trigger the automatic crash dump, and
+        (c) be followed by a worker_restart event from the supervisor."""
+        ev, msgs = make_ingest_trace(self.N_ROWS, windows=4, seed=3)
+
+        dumps = []
+
+        class _SpyRecorder(FlightRecorder):
+            def crash_dump(self, logger, reason, last=64):
+                dumps.append(reason)
+                super().crash_dump(logger, reason, last=last)
+
+        fired = threading.Event()
+
+        def crash_once(i, kind):
+            if kind == "l7" and not fired.is_set():
+                fired.set()
+                raise WorkerCrash("test kill")
+
+        interner = Interner()
+        closed = []
+        rec = _SpyRecorder(capacity=128)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            2, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, recorder=rec, fault_hook=crash_once,
+        )
+        try:
+            for i in range(0, self.N_ROWS, 1 << 13):
+                pipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert fired.is_set()
+        kinds = [e["kind"] for e in rec.events()]
+        assert "worker_crash" in kinds
+        assert "worker_restart" in kinds
+        assert dumps and "injected_crash" in dumps[0]
+        assert pipe.worker_restarts >= 1
+        # ledger decisions rode the ring too (the crash dropped rows)
+        assert any(e["kind"] == "ledger" for e in rec.events())
+
+    def test_raising_recorder_never_disables_supervision(self):
+        """A recorder/logging failure during the crash dump must not
+        swallow the dead-mark: the worker still restarts and the close
+        wave still completes (a wedged-forever pipeline otherwise)."""
+        ev, msgs = make_ingest_trace(self.N_ROWS, windows=4, seed=5)
+
+        class _ExplodingRecorder(FlightRecorder):
+            def crash_dump(self, logger, reason, last=64):
+                raise RuntimeError("recorder formatting blew up")
+
+        fired = threading.Event()
+
+        def crash_once(i, kind):
+            if kind == "l7" and not fired.is_set():
+                fired.set()
+                raise WorkerCrash("test kill")
+
+        interner = Interner()
+        closed = []
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            2, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, recorder=_ExplodingRecorder(capacity=64),
+            fault_hook=crash_once,
+        )
+        try:
+            for i in range(0, self.N_ROWS, 1 << 13):
+                pipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            ok = pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert fired.is_set()
+        assert ok, "flush wedged: supervision disabled by raising recorder"
+        assert pipe.worker_restarts >= 1
+        assert closed
+
+
+# ---------------------------------------------------------------------------
+# Debug HTTP surfaces (/stats stage_latency + /recorder)
+# ---------------------------------------------------------------------------
+
+
+class TestDebugSurfaces:
+    def test_stats_and_recorder_endpoints(self):
+        import json as json_mod
+        import urllib.request
+
+        from alaz_tpu.runtime.debug_http import DebugServer
+        from alaz_tpu.runtime.service import Service
+
+        svc = Service(interner=Interner())
+        svc.recorder.record("breaker_flip", state="opened")
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as r:
+                    return r.status, r.read().decode()
+
+            code, stats = get("/stats")
+            assert code == 200
+            parsed = json_mod.loads(stats)
+            assert set(STAGES) <= set(parsed["stage_latency"])
+            assert parsed["spans"]["live"] == 0
+            assert parsed["recorder"]["recorded"] >= 1
+            code, rec = get("/recorder")
+            assert code == 200
+            dump = json_mod.loads(rec)
+            assert any(e["kind"] == "breaker_flip" for e in dump["events"])
+            # latency histograms render as real Prometheus histograms
+            code, metrics = get("/metrics")
+            assert code == 200
+            assert "# TYPE alaz_tpu_latency_merge_s histogram" in metrics
+        finally:
+            server.stop()

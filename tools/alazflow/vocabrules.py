@@ -17,7 +17,7 @@ know. Three artifacts carry that vocabulary and all three must agree:
 
 ALZ044 — metric names are a wire contract too: dashboards, the health
 payload and the Prometheus scrape all key on them. Every
-``metrics.gauge/counter/info`` name must be a literal (or an f-string
+``metrics.gauge/counter/info/histogram`` name must be a literal (or an f-string
 whose constant skeleton matches a registered wildcard) drawn from the
 golden registry; golden names nothing registers anymore are flagged the
 other way. ``python -m tools.alazflow --write-metrics`` regenerates the
@@ -40,7 +40,7 @@ LEDGER_PY = REPO / "alaz_tpu" / "utils" / "ledger.py"
 WIRE_TABLE = REPO / "resources" / "specs" / "wire_layouts.json"
 METRICS_GOLDEN = REPO / "resources" / "specs" / "metrics.json"
 
-_METRIC_METHODS = ("gauge", "counter", "info")
+_METRIC_METHODS = ("gauge", "counter", "info", "histogram")
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +217,14 @@ def metric_sites(ctxs: Sequence[FileContext]):
     ``None`` name = dynamic (non-literal, non-f-string) — always a
     finding: the registry cannot close over it."""
     for ctx in ctxs:
+        # self-registrations inside the Metrics class itself count too:
+        # the registry must not depend on a local being NAMED `metrics`
+        # (naming-convention camouflage a rename would silently defeat)
+        self_spans = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.ClassDef) and n.name == "Metrics"
+        ]
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -224,7 +232,16 @@ def metric_sites(ctxs: Sequence[FileContext]):
             if not (
                 isinstance(fn, ast.Attribute)
                 and fn.attr in _METRIC_METHODS
-                and _is_metrics_recv(fn.value)
+                and (
+                    _is_metrics_recv(fn.value)
+                    or (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self"
+                        and any(
+                            lo <= node.lineno <= hi for lo, hi in self_spans
+                        )
+                    )
+                )
             ):
                 continue
             if not node.args:
