@@ -55,6 +55,7 @@ from alaz_tpu.chaos.injectors import (
 from alaz_tpu.config import BackendConfig, ChaosConfig
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.logging import get_logger
+from alaz_tpu.obs.device import batch_pad_waste_pct
 from alaz_tpu.obs.recorder import FlightRecorder
 from alaz_tpu.replay.synth import make_ingest_trace
 from alaz_tpu.utils.ledger import DropLedger
@@ -256,6 +257,11 @@ def _run_pipeline_leg(
         "windows": len(closed),
         "rows_per_sec": round(delivered / wall) if wall > 0 else 0,
         "flush_wall_s": round(flush_wall, 3),
+        # bucket-padding waste over the degraded run (ISSUE 11): chaos
+        # fragments windows (dup/reorder/late redelivery), which shows
+        # up here as occupancy loss — the number rides the report so a
+        # defense that "passes" by emitting near-empty buckets is visible
+        "pad_waste_pct": round(batch_pad_waste_pct(closed), 2),
         "ledger": ledger.snapshot(),
         "worker_restarts": pipe.worker_restarts,
         "crashes": wchaos.crashes,

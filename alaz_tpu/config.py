@@ -249,6 +249,16 @@ class TraceConfig:
     recorder_capacity: int = 512
     # dump the recorder tail to the log when a shard worker dies
     recorder_dump_on_crash: bool = True
+    # device-side telemetry plane (ISSUE 11, obs/device.py): per-bucket
+    # score latency + occupancy histograms, the stage arena/transfer
+    # decomposition with its byte ledger, pad-waste accounting, and the
+    # always-on compile event hookup. ON by default — cost is per
+    # window×dispatch, inside the same ≤2% bench bound as the spans.
+    device_enabled: bool = True
+    # /profile endpoint bound (runtime/debug_http.py): a requested trace
+    # longer than this is clamped — the endpoint must never wedge a
+    # debug-port thread (or fill a disk) for an unbounded stretch
+    profile_max_s: float = 30.0
 
     @classmethod
     def from_env(cls) -> "TraceConfig":
@@ -257,6 +267,8 @@ class TraceConfig:
             max_live=env_int("TRACE_MAX_LIVE", 4096),
             recorder_capacity=env_int("RECORDER_CAPACITY", 512),
             recorder_dump_on_crash=env_bool("RECORDER_DUMP_ON_CRASH", True),
+            device_enabled=env_bool("DEVICE_TRACE_ENABLED", True),
+            profile_max_s=env_float("PROFILE_MAX_SECONDS", 30.0),
         )
 
 

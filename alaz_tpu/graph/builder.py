@@ -25,6 +25,7 @@ from alaz_tpu.datastore.interface import BaseDataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import EventType, ResourceType
 from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.obs.device import pad_waste_pct_from
 from alaz_tpu.obs.spans import SpanTracer
 
 NODE_FEATURE_DIM = 32
@@ -659,6 +660,24 @@ class GraphBuilder:
         self.tracer = tracer
         self.sampled_rows = 0  # request rows cut by the cap (cumulative)
         self.sampled_edges = 0  # aggregated edges cut by the cap
+        # bucket capacity accounting (ISSUE 11): every assembled batch
+        # splits its edge bucket into real vs pad slots, so host-only
+        # pipelines (bench --ingest, the chaos harness) publish the same
+        # pad_waste_pct the service's staging-side device plane gauges —
+        # assembly IS the host's staging decision, the device just pays
+        # for it
+        self.assembled_edge_rows = 0  # real (masked-in) edge slots
+        self.assembled_pad_slots = 0  # pad-tail slots shipped anyway
+
+    @property
+    def pad_waste_pct(self) -> float:
+        """Percentage of assembled edge slots that were pad, cumulative
+        over every batch this builder emitted — the host-side twin of
+        the device plane's gauge, computed through the ONE shared
+        definition (obs/device.py pad_waste_pct_from)."""
+        return pad_waste_pct_from(
+            self.assembled_edge_rows, self.assembled_pad_slots
+        )
 
     def build(
         self,
@@ -866,6 +885,8 @@ class GraphBuilder:
             # renumber path remaps endpoints, so its edges must re-sort)
             sort_by_dst=self.renumber and n_edges > 0,
         )
+        self.assembled_edge_rows += batch.n_edges
+        self.assembled_pad_slots += batch.pad_edge_slots
         if tr is not None:
             tr.observe(window_start_ms, "sample", sample_s)
             tr.observe(

@@ -76,6 +76,27 @@ class GraphBatch:
     def e_pad(self) -> int:
         return self.edge_src.shape[0]
 
+    # -- bucket capacity surface (ISSUE 11) ----------------------------------
+    # the device plane's accounting vocabulary: one bucket label per
+    # compiled program shape, pad tail = the FLOPs the padding policy is
+    # spending to avoid a recompile
+
+    @property
+    def bucket_key(self) -> str:
+        """The (node, edge) capacity label this batch scores under —
+        exactly the pair keying the jit cache."""
+        return f"n{self.n_pad}xe{self.e_pad}"
+
+    @property
+    def pad_edge_slots(self) -> int:
+        """Edge slots in the bucket that carry padding, not data."""
+        return self.e_pad - self.n_edges
+
+    @property
+    def edge_occupancy(self) -> float:
+        """Real-edge fraction of the edge bucket (0..1)."""
+        return self.n_edges / self.e_pad if self.e_pad else 0.0
+
     def device_arrays(self) -> dict:
         """The pytree the jit'd model consumes (static shapes only)."""
         if self.node_deg is None:

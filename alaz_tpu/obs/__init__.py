@@ -1,6 +1,6 @@
-"""The observability plane (ISSUE 9): window-lifecycle span tracing,
-lock-striped log-bucket latency histograms, and a crash-safe flight
-recorder.
+"""The observability plane (ISSUES 9 + 11): window-lifecycle span
+tracing, lock-striped log-bucket latency histograms, a crash-safe
+flight recorder, and the device-side telemetry plane.
 
 - :mod:`alaz_tpu.obs.histogram` — ``Histogram``: mergeable, lock-striped
   log-bucket distribution with p50/p95/p99 and Prometheus histogram
@@ -11,11 +11,22 @@ recorder.
 - :mod:`alaz_tpu.obs.recorder` — ``FlightRecorder``: bounded ring of
   structured events, dumped automatically on worker crash and attached
   to chaos-gate failures.
+- :mod:`alaz_tpu.obs.device` — ``DeviceTelemetry`` +
+  ``CompileEventPlane``: per-bucket score latency/occupancy, the
+  stage arena/transfer decomposition with a byte ledger, pad-waste
+  accounting, and the always-on XLA compile event hookup.
 
-Config: ``TRACE_*`` / ``RECORDER_*`` env vars (CONFIG.md, TraceConfig).
-Design notes: ARCHITECTURE §3m.
+Config: ``TRACE_*`` / ``RECORDER_*`` / ``DEVICE_TRACE_*`` /
+``PROFILE_*`` env vars (CONFIG.md, TraceConfig).
+Design notes: ARCHITECTURE §3m (host plane) and §3n (device plane).
 """
 
+from alaz_tpu.obs.device import (
+    CompileEventPlane,
+    DeviceTelemetry,
+    batch_pad_waste_pct,
+    bucket_key,
+)
 from alaz_tpu.obs.histogram import DEFAULT_BOUNDS, Histogram
 from alaz_tpu.obs.recorder import FlightRecorder
 from alaz_tpu.obs.spans import HOST_STAGES, STAGES, SpanTracer, WindowSpan
@@ -28,4 +39,8 @@ __all__ = [
     "STAGES",
     "SpanTracer",
     "WindowSpan",
+    "CompileEventPlane",
+    "DeviceTelemetry",
+    "batch_pad_waste_pct",
+    "bucket_key",
 ]

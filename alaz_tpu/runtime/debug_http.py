@@ -3,15 +3,26 @@ node-exporter-on-:8182 analog (main.go:25,160; backend.go:1038-1105).
 
 Endpoints:
 - ``/metrics``          Prometheus text (service counters/gauges + devices
-                        + ``latency.*`` stage histograms, ISSUE 9)
+                        + ``latency.*`` stage histograms, ISSUE 9; the
+                        per-bucket ``latency.score_s.*`` /
+                        ``device.occupancy.*`` series and ``compile.*``
+                        counters, ISSUE 11)
 - ``/healthz``          liveness
 - ``/stats``            JSON snapshot (queue lag, aggregator stats,
-                        per-stage latency percentiles, recorder counters)
+                        per-stage latency percentiles, recorder counters,
+                        and the per-bucket device breakdown:
+                        score percentiles, occupancy, pad waste,
+                        stage arena/transfer split, compile events)
 - ``/recorder``         flight-recorder dump (alaz_tpu/obs): the last-N
                         structured runtime events, oldest→newest
 - ``/stack``            all-thread stack dump (goroutine-profile analog)
-- ``/profiler/start``   begin a JAX profiler trace (``/profiler/stop`` ends;
-                        trace dir served back in the response)
+- ``/profile?seconds=N``  on-demand bounded ``jax.profiler.trace`` deep
+                        dive (ISSUE 11): single-flight (409 on overlap),
+                        clamped to ``PROFILE_MAX_SECONDS``, CPU-safe;
+                        the trace dir comes back in the JSON response
+- ``/profiler/start``   begin an unbounded JAX profiler trace
+                        (``/profiler/stop`` ends; the manual twin of
+                        ``/profile`` for attach-and-watch sessions)
 """
 
 from __future__ import annotations
@@ -36,6 +47,11 @@ class DebugServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._trace_dir: Optional[str] = None
+        # /profile single-flight guard: jax's profiler is process-global
+        # (start_trace raises on nesting), so overlapping requests must
+        # 409, not crash the handler thread mid-trace
+        self._profile_mu = threading.Lock()
+        self._profiling = False  # guarded-by: self._profile_mu
 
     def start(self) -> int:
         svc = self.service
@@ -78,6 +94,15 @@ class DebugServer:
                             "completed": tracer.completed,
                             "evicted": tracer.evicted,
                         }
+                    device = getattr(svc, "device", None)
+                    if device is not None and hasattr(device, "snapshot"):
+                        # per-bucket breakdown (ISSUE 11) next to
+                        # stage_latency: score percentiles, occupancy,
+                        # pad waste, arena/transfer split
+                        stats["device"] = device.snapshot()
+                    plane = getattr(svc, "compile_plane", None)
+                    if plane is not None:
+                        stats["compile"] = plane.snapshot()
                     recorder = getattr(svc, "recorder", None)
                     if recorder is not None:
                         stats["recorder"] = {
@@ -109,6 +134,24 @@ class DebugServer:
                     self._send(200, outer._profiler_start())
                 elif self.path == "/profiler/stop":
                     self._send(200, outer._profiler_stop())
+                elif self.path == "/profile" or self.path.startswith("/profile?"):
+                    import math
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        seconds = float(qs.get("seconds", ["1.0"])[0])
+                    except ValueError:
+                        seconds = float("nan")
+                    # nan slips through float() AND the min/max clamp
+                    # (NaN comparisons are all False, so min/max keep
+                    # it) — reject anything non-finite up front
+                    if not math.isfinite(seconds):
+                        self._send(400, '{"error": "seconds must be a finite number"}',
+                                   "application/json")
+                        return
+                    code, body = outer._profile(seconds)
+                    self._send(code, body, "application/json")
                 else:
                     self._send(404, "not found")
 
@@ -119,25 +162,135 @@ class DebugServer:
         log.info(f"debug http on {self.host}:{self.port}")
         return self.port
 
+    def _profile(self, seconds: float) -> tuple:
+        """On-demand bounded deep dive (ISSUE 11): one
+        ``jax.profiler.trace`` of ``seconds`` (clamped to the
+        ``PROFILE_MAX_SECONDS`` bound — the endpoint must never wedge a
+        debug thread or fill a disk indefinitely), single-flight against
+        itself AND the manual ``/profiler/start`` session. Returns
+        ``(http status, json body)``."""
+        import json as json_mod
+        import tempfile
+        import time
+
+        try:
+            import jax
+        except ImportError:
+            return 501, json_mod.dumps({"error": "jax unavailable on this image"})
+        cfg = getattr(self.service, "config", None)
+        max_s = float(getattr(getattr(cfg, "trace", None), "profile_max_s", 30.0))
+        requested = seconds
+        seconds = min(max(seconds, 0.05), max_s)
+        with self._profile_mu:
+            if self._profiling or self._trace_dir is not None:
+                return 409, json_mod.dumps(
+                    {"error": "a profiler trace is already running; "
+                              "retry when it completes"}
+                )
+            self._profiling = True
+        try:
+            # retention: a polled endpoint must not grow /tmp without
+            # bound — PROFILE_MAX_SECONDS bounds one request, this
+            # bounds the fleet of them. Oldest dirs beyond the newest
+            # few are pruned before each new trace. Pid-scoped prefix:
+            # the single-flight lock is per-process, so pruning must
+            # never touch a sibling process's still-being-written trace.
+            self._prune_profile_dirs(keep=4)
+            out_dir = tempfile.mkdtemp(prefix=self._profile_prefix())
+            recorder = getattr(self.service, "recorder", None)
+            if recorder is not None:
+                # deep dives leave a trail: an operator reading the
+                # flight recorder sees WHEN the profiler perturbed things
+                recorder.record("profile", seconds=seconds, trace_dir=out_dir)
+            t0 = time.perf_counter()
+            with jax.profiler.trace(out_dir):
+                time.sleep(seconds)
+            wall = time.perf_counter() - t0
+            return 200, json_mod.dumps(
+                {
+                    "trace_dir": out_dir,
+                    "seconds": seconds,
+                    "requested_seconds": requested,
+                    "wall_s": round(wall, 3),
+                }
+            )
+        except Exception as exc:  # noqa: BLE001 - surface, don't kill the server
+            return 500, json_mod.dumps({"error": repr(exc)})
+        finally:
+            with self._profile_mu:
+                self._profiling = False
+
+    @staticmethod
+    def _profile_prefix() -> str:
+        """Pid-scoped /profile trace-dir prefix: pruning is guarded by
+        a per-process lock, so it may only ever see THIS process's
+        dirs — a sibling service's in-flight trace is untouchable."""
+        import os
+
+        return f"alaz-profile-{os.getpid()}-"
+
+    @classmethod
+    def _prune_profile_dirs(cls, keep: int) -> None:
+        """Delete all but the ``keep`` newest completed /profile trace
+        dirs of THIS process (incl. empty dirs a failed trace left)."""
+        import glob
+        import os
+        import shutil
+        import tempfile
+
+        dirs = glob.glob(
+            os.path.join(tempfile.gettempdir(), cls._profile_prefix() + "*")
+        )
+        dirs.sort(key=lambda d: os.path.getmtime(d) if os.path.exists(d) else 0)
+        for d in dirs[: max(0, len(dirs) - keep)]:
+            shutil.rmtree(d, ignore_errors=True)
+
     def _profiler_start(self) -> str:
+        import os
         import tempfile
 
         import jax
 
-        if self._trace_dir is not None:
-            return f"already tracing to {self._trace_dir}"
-        self._trace_dir = tempfile.mkdtemp(prefix="alaz-jax-trace-")
-        jax.profiler.start_trace(self._trace_dir)
-        return f"tracing to {self._trace_dir}"
+        # reserve-then-start: the guard check and the _trace_dir claim
+        # happen in ONE critical section (a check-then-act split let two
+        # concurrent starts both pass and the loser's start_trace raise
+        # uncaught through the handler); the profiler call itself runs
+        # outside the lock, and a failure releases the reservation
+        d = tempfile.mkdtemp(prefix="alaz-jax-trace-")
+        with self._profile_mu:
+            if self._trace_dir is not None:
+                os.rmdir(d)
+                return f"already tracing to {self._trace_dir}"
+            if self._profiling:
+                os.rmdir(d)
+                return "a /profile deep dive is running; retry when it completes"
+            self._trace_dir = d
+        try:
+            jax.profiler.start_trace(d)
+        except Exception as exc:  # noqa: BLE001 - report, don't kill the handler
+            with self._profile_mu:
+                self._trace_dir = None
+            return f"profiler start failed: {exc!r}"
+        return f"tracing to {d}"
 
     def _profiler_stop(self) -> str:
         import jax
 
-        if self._trace_dir is None:
-            return "not tracing"
-        jax.profiler.stop_trace()
-        out = self._trace_dir
-        self._trace_dir = None
+        # the claim is released only AFTER a successful stop: a failed
+        # stop_trace leaves the process-global profiler RUNNING, so the
+        # guard must keep saying "tracing" or no later request could
+        # ever stop it (review finding — the old clear-then-stop wedged
+        # the profiler until process restart). stop_trace under the
+        # mutex also keeps a racing /profile or /profiler/start from
+        # claiming the slot mid-stop.
+        with self._profile_mu:
+            if self._trace_dir is None:
+                return "not tracing"
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001 - report, keep retryable
+                return f"profiler stop failed (still tracing, retry): {exc!r}"
+            out, self._trace_dir = self._trace_dir, None
         return f"trace written to {out}"
 
     def stop(self) -> None:

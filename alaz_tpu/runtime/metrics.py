@@ -84,6 +84,12 @@ class Metrics:
         self._gauges: Dict[str, Gauge] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # sparse histograms (ISSUE 11, the per-bucket labeled series):
+        # registered lazily per shape bucket, OMITTED from snapshot and
+        # exposition while their count is zero — the same discipline the
+        # gauge-error path applies to NaN samples: a series that has
+        # nothing to say is absent, never an empty/nan render
+        self._sparse: set = set()
         self._lock = threading.Lock()
         self.started_at = time.time()
         # registered through the public surface so the golden registry
@@ -120,20 +126,36 @@ class Metrics:
                 g._fn = fn
             return g
 
-    def histogram(self, name: str) -> Histogram:
+    def histogram(self, name: str, sparse: bool = False, bounds=None) -> Histogram:
         """Lock-striped log-bucket latency histogram (obs/histogram.py):
         p50/p95/p99 land in the snapshot, the full cumulative-bucket
-        exposition in the Prometheus text."""
+        exposition in the Prometheus text. ``sparse=True`` (the
+        per-bucket labeled series — ``latency.score_s.<bucket>``,
+        ``device.occupancy.<bucket>``) omits the series everywhere while
+        it has zero observations; fixed-name histograms stay rendered so
+        dashboards can key on their presence. ``bounds`` overrides the
+        geometric latency ladder at FIRST registration (linear ratios
+        like occupancy misread on a 2x ladder); later lookups return the
+        existing instance unchanged."""
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
-                h = Histogram(name)
+                h = Histogram(name, bounds=bounds)
                 self._histograms[name] = h
+            if sparse:
+                self._sparse.add(name)
             return h
 
-    def histograms(self) -> Dict[str, Histogram]:
+    def histograms(self, include_empty_sparse: bool = True) -> Dict[str, Histogram]:
         with self._lock:
-            return dict(self._histograms)
+            out = dict(self._histograms)
+            sparse = set(self._sparse)
+        if not include_empty_sparse:
+            # total_count takes the stripe locks — outside the registry lock
+            for n in sparse:
+                if n in out and out[n].total_count == 0:
+                    del out[n]
+        return out
 
     def snapshot(self, histograms: bool = True) -> dict:
         with self._lock:
@@ -149,11 +171,15 @@ class Metrics:
                     continue
                 out[n] = v
             hists = list(self._histograms.items()) if histograms else ()
+            sparse = set(self._sparse)
             out["uptime_s"] = time.time() - self.started_at
         # histogram percentile walks happen outside the registry lock
         # (they take the stripe locks; the registry lock stays cheap)
         for n, h in hists:
             snap = h.snapshot()
+            if snap["count"] == 0 and n in sparse:
+                # empty per-bucket series: absent, not zero-rendered
+                continue
             out[f"{n}.count"] = snap["count"]
             out[f"{n}.p50"] = snap["p50"]
             out[f"{n}.p95"] = snap["p95"]
@@ -173,7 +199,10 @@ class Metrics:
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value}")
-        for name, h in sorted(self.histograms().items()):
+        # empty sparse (per-bucket) series stay out of the scrape — the
+        # fixed-name histograms render even at zero so dashboards can
+        # key on their presence
+        for name, h in sorted(self.histograms(include_empty_sparse=False).items()):
             metric = "alaz_tpu_" + name.replace(".", "_").replace("-", "_")
             lines.extend(h.render_prometheus(metric))
         def esc(v) -> str:

@@ -13,6 +13,7 @@ Pause/resume hooks match the health checker's stop/resume protocol.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import time as time_module
@@ -28,6 +29,7 @@ from alaz_tpu.events.intern import Interner
 from alaz_tpu.graph.builder import WindowedGraphStore, src_locality_gauges
 from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
+from alaz_tpu.obs.device import CompileEventPlane, DeviceTelemetry, bucket_key
 from alaz_tpu.obs.recorder import FlightRecorder
 from alaz_tpu.obs.spans import SpanTracer
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges, ledger_gauges
@@ -231,6 +233,28 @@ class Service:
             max_live=tcfg.max_live,
             complete_at_emit=model_state is None,
         )
+        # device-side telemetry (ISSUE 11, obs/device.py): per-bucket
+        # score latency + occupancy at staging time, the stage
+        # arena/transfer decomposition (+ byte ledger), pad-waste — the
+        # numbers the Pallas/mixed-precision/multi-tenant work will be
+        # judged by. DEVICE_TRACE_ENABLED=0 kills it independently.
+        self.device = DeviceTelemetry(
+            metrics=self.metrics,
+            recorder=self.recorder,
+            enabled=tcfg.enabled and tcfg.device_enabled,
+        )
+        # always-on compile event plane (ISSUE 11): sanitize's
+        # CompileWatcher promoted to production — a steady-state retrace
+        # shows up in compile.* on /metrics and in crash dumps, not only
+        # under `make sanitize`. Only a scoring service compiles device
+        # programs, so the hookup rides model_state.
+        self.compile_plane: Optional[CompileEventPlane] = None
+        # same gate as DeviceTelemetry: TRACE_ENABLED=0 is the master
+        # obs kill switch and must silence the compile capture too
+        if model_state is not None and tcfg.enabled and tcfg.device_enabled:
+            self.compile_plane = CompileEventPlane(
+                metrics=self.metrics, recorder=self.recorder
+            ).start()
         self._export_backend = export_backend
 
         q = self.config.queues
@@ -657,8 +681,9 @@ class Service:
             """Score one window; always settles its task_done."""
             try:
                 t0 = time_module.perf_counter()
-                out = self._score_fn(self.model_state, graph)
-                logits = np.asarray(out["edge_logits"])
+                with self._bucket_ctx(batch):
+                    out = self._score_fn(self.model_state, graph)
+                    logits = np.asarray(out["edge_logits"])
                 if "attn_clamp_saturation" in out:
                     # GAT logit-clamp saturation (models/gat.py layer_fn):
                     # nonzero means trained logits are hitting ±30 and the
@@ -670,6 +695,8 @@ class Service:
                 dt = time_module.perf_counter() - t0
                 self._scorer_busy_s += dt
                 self.tracer.observe(batch.window_start_ms, "score", dt)
+                # device plane: the same duration, attributed per bucket
+                self.device.observe_score(batch, dt)
                 record_window(batch, logits)
             finally:
                 self.window_queue.task_done()
@@ -708,15 +735,30 @@ class Service:
                 arena = self._stage_arenas.fill(
                     (batches[0].n_pad, batches[0].e_pad), cols
                 )
-                stacked = {k: jnp.asarray(v) for k, v in arena.items()}
-                stage_s = time_module.perf_counter() - t0
-                out = self._score_many_fn(self.model_state, stacked)
+                t_arena = time_module.perf_counter()
+                with self._bucket_ctx(batches[0]):
+                    stacked = {k: jnp.asarray(v) for k, v in arena.items()}
+                    t_xfer = time_module.perf_counter()
+                    stage_s = t_xfer - t0
+                    out = self._score_many_fn(self.model_state, stacked)
                 self._scorer_busy_s += time_module.perf_counter() - t0
                 # the whole group staged in one arena fill + transfer:
                 # each member's span carries the shared staging time
                 # (critical-path semantics — observe keeps the max)
                 for b in batches:
                     self.tracer.observe(b.window_start_ms, "stage", stage_s)
+                    # occupancy per REAL window — the group's
+                    # power-of-two padding re-ships the last member's
+                    # columns, but that's a dispatch artifact (its
+                    # logits are discarded), not a staged window
+                    self.device.observe_staged(b)
+                # one dispatch: arena fill vs transfer split + the bytes
+                # the whole stacked group shipped
+                self.device.observe_transfer(
+                    sum(v.nbytes for v in arena.values()),
+                    t_arena - t0,
+                    t_xfer - t_arena,
+                )
                 return ("group", batches, out)
             except BaseException:
                 for _ in batches:
@@ -739,6 +781,7 @@ class Service:
                     # shared device time for the vmapped group — each
                     # window's `score` stage carries the group dispatch
                     self.tracer.observe(batch.window_start_ms, "score", dt)
+                    self.device.observe_score(batch, dt)
                     record_window(batch, logits[i])
             finally:
                 for _ in batches:
@@ -808,12 +851,24 @@ class Service:
                     continue
                 try:
                     t0 = time_module.perf_counter()
-                    graph = {
-                        k: jnp.asarray(v) for k, v in batch.device_arrays().items()
-                    }
-                    dt = time_module.perf_counter() - t0
+                    # host prep (lazy node_deg fill etc.) vs transfer
+                    # dispatch: the serial path's arena analog is the
+                    # device_arrays() call — same decomposition the
+                    # group path gets from its arena fill
+                    cols = batch.device_arrays()
+                    t_arena = time_module.perf_counter()
+                    with self._bucket_ctx(batch):
+                        graph = {k: jnp.asarray(v) for k, v in cols.items()}
+                    t_xfer = time_module.perf_counter()
+                    dt = t_xfer - t0
                     self._scorer_busy_s += dt
                     self.tracer.observe(batch.window_start_ms, "stage", dt)
+                    self.device.observe_staged(batch)
+                    self.device.observe_transfer(
+                        sum(v.nbytes for v in cols.values()),
+                        t_arena - t0,
+                        t_xfer - t_arena,
+                    )
                 except Exception:
                     # the popped window still owes its accounting
                     self.window_queue.task_done()
@@ -832,6 +887,14 @@ class Service:
                 self.window_queue.task_done()
             if carry is not None:
                 self.window_queue.task_done()
+
+    def _bucket_ctx(self, batch: GraphBatch):
+        """Compile-attribution context (ISSUE 11): XLA compiles fired
+        while staging/scoring ``batch`` — synchronously, on this
+        thread — tag with its shape bucket in the recorder trail."""
+        if self.compile_plane is None:
+            return contextlib.nullcontext()
+        return self.compile_plane.bucket(bucket_key(batch))
 
     def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> ScoreBatch:
         """Columnar edge annotation: no per-edge Python objects on the
@@ -938,4 +1001,7 @@ class Service:
         self._threads.clear()
         if self.sharded is not None:
             self.sharded.stop()
+        if self.compile_plane is not None:
+            # detach the jax-logger capture and restore log_compiles
+            self.compile_plane.stop()
         log.info(f"service stopped; metrics={self.metrics.snapshot()}")

@@ -28,7 +28,7 @@ import logging
 import re
 from collections import Counter
 from contextlib import contextmanager
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 
 class RetraceBudgetExceeded(AssertionError):
@@ -47,6 +47,11 @@ STEADY_STATE_BUDGETS: Dict[str, int] = {
 }
 
 _COMPILING_RE = re.compile(r"^Compiling ([^\s]+)")
+# the paired completion message carries the wall duration; the fn name
+# arrives wrapped as jit(<name>) (dispatch.py's module-name framing)
+_FINISHED_RE = re.compile(
+    r"^Finished XLA compilation of (?:jit\()?([^)\s]+)\)? in ([0-9eE.+-]+) sec"
+)
 
 
 class _CaptureHandler(logging.Handler):
@@ -62,6 +67,14 @@ class _CaptureHandler(logging.Handler):
         m = _COMPILING_RE.match(msg)
         if m:
             self._watcher._record(m.group(1), msg)
+            return
+        m = _FINISHED_RE.match(msg)
+        if m:
+            try:
+                secs = float(m.group(2))
+            except ValueError:
+                secs = 0.0
+            self._watcher._finished(m.group(1), secs)
 
 
 class CompileWatcher:
@@ -74,15 +87,48 @@ class CompileWatcher:
     Nesting is safe (each watcher owns its handler; ``jax_log_compiles``
     is saved/restored). Counts include every shape instantiation — one
     per (entry point, shape bucket) is the expected steady state.
+
+    ``on_event`` (ISSUE 11, the production hookup): an optional callback
+    fired per captured event — ``("compiling", name, None)`` when a
+    compile starts (the countable event budgets assert on) and
+    ``("finished", name, secs)`` when the paired "Finished XLA
+    compilation" message lands with its wall duration. A raising
+    callback is swallowed: the capture must never take down the
+    compiling thread.
+
+    Retention: ``events``/``finished`` are rings of the last
+    ``max_events`` entries — a watcher held open for a service lifetime
+    (the production plane) in exactly the pathology it exists to detect
+    (a per-window steady-state retrace) must not grow RSS unbounded.
+    Budget tests measure deltas over bounded windows far below the cap;
+    the production plane keeps its own cumulative counters.
     """
 
-    def __init__(self) -> None:
-        self.events: List[Tuple[str, str]] = []  # (traced fn name, full message)
+    def __init__(self, on_event=None, max_events: int = 4096) -> None:
+        from collections import deque
+
+        # (traced fn name, full message), oldest dropped past max_events
+        self.events: "deque[Tuple[str, str]]" = deque(maxlen=max_events)
+        self.finished: "deque[Tuple[str, float]]" = deque(maxlen=max_events)
+        self._on_event = on_event
         self._handler: Optional[_CaptureHandler] = None
         self._prev_log_compiles: Optional[bool] = None
 
     def _record(self, name: str, msg: str) -> None:
         self.events.append((name, msg))
+        if self._on_event is not None:
+            try:
+                self._on_event("compiling", name, None)
+            except Exception:  # noqa: BLE001 - see docstring
+                pass
+
+    def _finished(self, name: str, secs: float) -> None:
+        self.finished.append((name, secs))
+        if self._on_event is not None:
+            try:
+                self._on_event("finished", name, secs)
+            except Exception:  # noqa: BLE001 - see docstring
+                pass
 
     def __enter__(self) -> "CompileWatcher":
         import jax

@@ -213,6 +213,153 @@ def make_e2e_rows(n_rows: int, pods: int, svcs: int, windows: int = 4, seed: int
 from alaz_tpu.replay.synth import make_ingest_trace  # noqa: E402
 
 
+# ---------------------------------------------------------------------------
+# Bench regression ledger (ISSUE 11). Every --ingest round appends its
+# headline metrics to BENCH_HISTORY.jsonl and is first checked against
+# the trailing median of prior comparable rounds — the repo finally has
+# a MEMORY that catches "the refactor landed and rows/s quietly fell
+# 12%" instead of relying on a human diffing BENCH_r* files. Rounds are
+# comparable only when (metric, rows) match: a --workers run or a small
+# smoke run starts its own series and can never poison the 1M-row one.
+# ---------------------------------------------------------------------------
+
+BENCH_HISTORY = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+)
+
+
+def _load_bench_history(history_path: str, metric: str, rows) -> list:
+    """Prior comparable rounds, oldest first; unreadable lines are
+    skipped — a truncated write from a killed round must not wedge
+    every later one. Comparable = same metric name, row count AND host
+    core count: the committed history crosses machines, and a 2-core
+    box judged against a 16-core box's median would flag a phantom
+    regression on every round."""
+    entries = []
+    cpus = os.cpu_count()
+    try:
+        with open(history_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (
+                    e.get("metric") == metric
+                    and e.get("rows") == rows
+                    and e.get("cpus") == cpus
+                ):
+                    entries.append(e)
+    except OSError:
+        return []
+    return entries
+
+
+def check_bench_history(
+    out: dict,
+    history_path: str = BENCH_HISTORY,
+    window: int = 5,
+    rows_drop_pct: float = 10.0,
+    p99_inflation_x: float = 2.0,
+    min_prior: int = 3,
+) -> list:
+    """Regression findings for this round against the trailing median of
+    the last ``window`` comparable rounds (expected: none).
+
+    - rows/s more than ``rows_drop_pct`` below the median → finding (the
+      acceptance threshold: >10% drop);
+    - any stage's p99 latency more than ``p99_inflation_x`` the median
+      AND >1 ms above it → finding (stage p99s on shared CI boxes jitter
+      far past 10%, so the inflation bar is 2× with an absolute floor —
+      a real regression like an accidental per-row observe blows through
+      both, scheduler noise does not).
+
+    Fewer than ``min_prior`` comparable rounds → no findings: a young
+    (or just-reset) trajectory accumulates before it judges. Rounds
+    that themselves flagged are excluded from the median basis — a
+    sustained regression keeps flagging round after round instead of
+    being absorbed into the baseline after ~window/2 appends (accepting
+    a deliberate perf tradeoff = reset or edit the history file)."""
+    findings = []
+    prior = [
+        e
+        for e in _load_bench_history(
+            history_path, out.get("metric"), out.get("rows")
+        )
+        if not e.get("regressed")
+    ]
+    if len(prior) < min_prior:
+        return findings
+    tail = prior[-window:]
+
+    def median(vals):
+        vals = sorted(vals)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    med_rows = median([e["value"] for e in tail if "value" in e])
+    if med_rows > 0 and out["value"] < med_rows * (1.0 - rows_drop_pct / 100.0):
+        findings.append(
+            f"rows/s regression: {out['value']:,} is "
+            f"{100.0 * (1.0 - out['value'] / med_rows):.1f}% below the "
+            f"trailing-median {med_rows:,.0f} of the last {len(tail)} rounds"
+        )
+    cur_stages = out.get("stage_latency", {})
+    for stage, cur in cur_stages.items():
+        hist_p99s = [
+            e["stage_p99_ms"][stage]
+            for e in tail
+            if stage in e.get("stage_p99_ms", {})
+        ]
+        if len(hist_p99s) < min_prior:
+            continue
+        med_p99 = median(hist_p99s)
+        cur_p99 = cur.get("p99_ms", 0.0)
+        if cur_p99 > med_p99 * p99_inflation_x and cur_p99 - med_p99 > 1.0:
+            findings.append(
+                f"stage p99 inflation: {stage} at {cur_p99:.2f}ms vs "
+                f"trailing-median {med_p99:.2f}ms "
+                f"(> {p99_inflation_x:.0f}x + 1ms)"
+            )
+    return findings
+
+
+def append_bench_history(out: dict, history_path: str = BENCH_HISTORY) -> None:
+    """Record this round's headline in the trajectory (one JSON line;
+    the check above reads it next round). A write failure must not kill
+    a bench that already measured — it costs the memory, not the number."""
+    entry = {
+        "recorded_at": round(time.time(), 3),
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "unit": out.get("unit"),
+        "rows": out.get("rows"),
+        "cpus": os.cpu_count(),
+        "windows_closed": out.get("windows_closed"),
+        "pad_waste_pct": out.get("pad_waste_pct"),
+        "trace_overhead_pct": out.get("trace_overhead_pct"),
+        "stage_p99_ms": {
+            s: v.get("p99_ms", 0.0)
+            for s, v in out.get("stage_latency", {}).items()
+        },
+    }
+    if out.get("regression_findings"):
+        # flagged rounds are recorded (the trajectory stays complete)
+        # but excluded from future medians — see check_bench_history
+        entry["regressed"] = True
+    if "workers" in out:
+        entry["workers"] = out["workers"]
+    try:
+        with open(history_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as exc:
+        print(f"# bench history append failed: {exc!r}", file=sys.stderr)
+
+
 def bench_ingest(args) -> dict:
     """CPU-only host-ingest microbench: synthetic L7 trace → process_l7
     (join, attribution, reverse-DNS naming, payload enrichment) →
@@ -245,7 +392,7 @@ def bench_ingest(args) -> dict:
     def run_once(trace: bool = True):
         """One serial pass. ``trace`` arms the span plane (the default,
         as in production); ``trace=False`` is the A/B arm that bounds
-        its cost. Returns (dt, windows, edges, tracer)."""
+        its cost. Returns (dt, windows, edges, tracer, pad_waste_pct)."""
         from alaz_tpu.obs.spans import SpanTracer
 
         interner = Interner()
@@ -264,7 +411,7 @@ def bench_ingest(args) -> dict:
         store.flush()
         dt = time.perf_counter() - t0
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges, tracer
+        return dt, len(closed), edges, tracer, store.builder.pad_waste_pct
 
     def run_once_sharded(n: int, trace: bool = True):
         """One sharded-pipeline pass (aggregator/sharded.py): same trace,
@@ -272,7 +419,7 @@ def bench_ingest(args) -> dict:
         the A/B arm bounding the span plane's cost on THIS pipeline —
         the headline arm under --workers, where N workers share one
         SpanTracer lock. Returns (wall, windows, edges, merge-stage
-        share of wall, tracer)."""
+        share of wall, tracer, pad_waste_pct)."""
         from alaz_tpu.aggregator.sharded import ShardedIngest
         from alaz_tpu.obs.spans import SpanTracer
 
@@ -298,7 +445,7 @@ def bench_ingest(args) -> dict:
         merge_share = pipe.merge_s / dt if dt > 0 else 0.0
         pipe.stop()
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges, merge_share, pipe.tracer
+        return dt, len(closed), edges, merge_share, pipe.tracer, pipe.builder.pad_waste_pct
 
     # the host path must never touch XLA: any compile during ingest is a
     # retrace regression (a jit leaking into the hot loop), so the
@@ -378,7 +525,7 @@ def bench_ingest(args) -> dict:
             best, best_off, scaling, sharded_off = measure()
     else:
         best, best_off, scaling, sharded_off = measure()
-    dt, n_windows, n_edges, tracer = best
+    dt, n_windows, n_edges, tracer, pad_waste_pct = best
     serial_rows_per_s = n_rows / dt
     rows_per_s = serial_rows_per_s
     # spans-on vs spans-off A/B (ISSUE 9): positive = tracing costs
@@ -402,6 +549,7 @@ def bench_ingest(args) -> dict:
         rows_per_s = n_rows / head[0]
         dt, n_windows, n_edges = head[0], head[1], head[2]
         tracer = head[4]  # the sharded pipeline's span plane
+        pad_waste_pct = head[5]
         # the published overhead must describe the HEADLINE arm: under
         # --workers that is the sharded pipeline, so the serial A/B
         # above is superseded by the sharded on/off pair
@@ -513,10 +661,28 @@ def bench_ingest(args) -> dict:
         "flow_findings": flow_findings,
         "stage_latency": stage_latency,
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        # bucket-padding waste of the headline pipeline (ISSUE 11): the
+        # share of assembled edge slots that were pad — the TPU-native
+        # efficiency number the bucketed-CSR/Pallas work will be judged
+        # by, published from the host side every round so it has a
+        # trajectory before the device work starts
+        "pad_waste_pct": round(pad_waste_pct, 2),
     }
     if worker_scaling is not None:
         out["workers"] = args.workers
         out["worker_scaling"] = worker_scaling
+    # bench regression ledger (ISSUE 11): judge this round against the
+    # trailing median of prior comparable rounds, THEN append it — the
+    # trajectory starts accumulating from this PR and every later round
+    # inherits a memory that flags quiet rows/s or stage-p99 regressions
+    history_path = getattr(args, "history_path", None) or BENCH_HISTORY
+    regressions = check_bench_history(out, history_path)
+    for r in regressions:
+        print(f"# bench regression: {r}", file=sys.stderr)
+    out["regression_findings"] = len(regressions)
+    if regressions:
+        out["regressions"] = regressions
+    append_bench_history(out, history_path)
     if getattr(args, "chaos", None) is not None and chaos_report is not None:
         # --chaos SEED: publish the degraded-mode numbers next to the
         # clean ones — chaos-run throughput and the per-cause drop-
@@ -1039,6 +1205,12 @@ def main() -> None:
     p.add_argument("--ingest-scalar", action="store_true",
                    help="with --ingest: drive the pre-vectorization "
                         "_scalar_* reference paths (the A/B baseline)")
+    p.add_argument("--history-path", default=None, metavar="PATH",
+                   help="with --ingest: the bench regression ledger "
+                        "(default: BENCH_HISTORY.jsonl next to bench.py); "
+                        "each round appends its headline and is checked "
+                        "against the trailing median of prior comparable "
+                        "rounds (regression_findings, expected 0)")
     p.add_argument("--workers", type=int, default=0,
                    help="with --ingest: ALSO drive the sharded multi-worker "
                         "pipeline at pool widths up to N (headline = N; the "

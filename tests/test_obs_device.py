@@ -1,0 +1,770 @@
+"""Device-side observability plane (ISSUE 11): per-bucket score
+telemetry, occupancy/pad-waste accounting, the stage arena/transfer
+decomposition, the always-on compile event plane, the ``/profile``
+endpoint, the sparse-histogram exposition discipline, and the bench
+regression ledger.
+
+Covers the satellite checklist: per-bucket score histograms have
+count == scored windows per bucket under the CPU backend (serial +
+``ShardedIngest`` N ∈ {1, 2}); occupancy/pad-waste gauges non-vacuous
+and exact against a hand-built staged batch; compile-event counts ==
+one per (model, bucket) at warmup then 0 steady-state — the alazsan
+budget asserted through the production metric; ``/profile`` drive with
+overlap rejection; zero-observation per-bucket series omitted from
+snapshot/exposition (the gauge-error discipline); and the
+BENCH_HISTORY trailing-median regression check as a bounded smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from alaz_tpu.config import ModelConfig, RuntimeConfig, TraceConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.graph.snapshot import GraphBatch, pad_to_bucket
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.obs.device import (
+    CompileEventPlane,
+    DeviceTelemetry,
+    batch_pad_waste_pct,
+    bucket_key,
+)
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.runtime.metrics import Metrics
+from alaz_tpu.runtime.service import Service
+
+
+def _mk_batch(n_nodes: int, n_edges: int, cfg=None, seed: int = 0,
+              window_start_ms: int = 0):
+    """Synthetic GraphBatch at an exact (node, edge) bucket."""
+    cfg = cfg if cfg is not None else ModelConfig()
+    rng = np.random.default_rng(seed)
+    n_pad = pad_to_bucket(n_nodes)
+    e_pad = pad_to_bucket(n_edges)
+    node_mask = np.zeros(n_pad, bool)
+    node_mask[:n_nodes] = True
+    edge_mask = np.zeros(e_pad, bool)
+    edge_mask[:n_edges] = True
+    src = rng.integers(0, n_nodes, e_pad).astype(np.int32)
+    dst = rng.integers(0, n_nodes, e_pad).astype(np.int32)
+    src[n_edges:] = src[n_edges - 1]
+    dst[n_edges:] = n_pad - 1
+    return GraphBatch(
+        node_feats=rng.normal(size=(n_pad, cfg.node_feature_dim)).astype(np.float32),
+        node_type=rng.integers(0, 4, n_pad).astype(np.int32),
+        node_mask=node_mask,
+        edge_src=src,
+        edge_dst=dst,
+        edge_type=rng.integers(0, cfg.num_edge_types, e_pad).astype(np.int32),
+        edge_feats=rng.normal(size=(e_pad, cfg.edge_feature_dim)).astype(np.float32),
+        edge_mask=edge_mask,
+        edge_label=np.zeros(e_pad, np.float32),
+        n_nodes=n_nodes,
+        n_edges=n_edges,
+        window_start_ms=window_start_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# DeviceTelemetry units: occupancy/pad-waste exactness
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceTelemetry:
+    def test_occupancy_and_pad_waste_exact_against_hand_built_batch(self):
+        # 200 edges in a 256-slot bucket: occupancy 200/256, waste 56
+        b = _mk_batch(100, 200)
+        assert b.e_pad == 256 and b.n_pad == 128
+        assert b.bucket_key == "n128xe256"
+        assert b.pad_edge_slots == 56
+        dt = DeviceTelemetry()
+        dt.observe_staged(b)
+        assert dt.staged_windows == 1
+        assert dt.staged_edges == 200
+        assert dt.padded_edge_slots == 56
+        assert dt.pad_waste_pct == pytest.approx(100.0 * 56 / 256)
+        snap = dt.snapshot()
+        assert snap["buckets"]["n128xe256"]["staged"] == 1
+        # occupancy rides a LINEAR 5%-step ladder (not the 2x latency
+        # ladder): p50 within 5 points of the true 200/256 ratio
+        occ = snap["buckets"]["n128xe256"]["occupancy_p50_pct"]
+        true = 100.0 * 200 / 256
+        assert abs(occ - true) <= 5.0, (occ, true)
+
+    def test_occupancy_never_reports_above_100_pct(self):
+        # a fully-packed bucket on the geometric ladder used to
+        # interpolate up to ~104.9% (review finding): the linear ladder
+        # caps at exactly 1.0
+        dt = DeviceTelemetry()
+        full = _mk_batch(128, 128)
+        assert full.e_pad == full.n_edges == 128
+        for _ in range(5):
+            dt.observe_staged(full)
+        b = dt.snapshot()["buckets"]["n128xe128"]
+        assert 95.0 <= b["occupancy_p99_pct"] <= 100.0
+        assert 95.0 <= b["occupancy_p50_pct"] <= 100.0
+
+    def test_pad_waste_accumulates_across_buckets(self):
+        dt = DeviceTelemetry()
+        dt.observe_staged(_mk_batch(100, 128))  # full 128 bucket: 0 pad
+        dt.observe_staged(_mk_batch(100, 100))  # 28 pad slots of 128
+        assert dt.staged_edges == 228
+        assert dt.padded_edge_slots == 28
+        assert dt.pad_waste_pct == pytest.approx(100.0 * 28 / 256)
+        assert set(dt.snapshot()["buckets"]) == {"n128xe128"}
+
+    def test_pad_waste_zero_when_nothing_staged_never_nan(self):
+        import math
+
+        dt = DeviceTelemetry()
+        assert dt.pad_waste_pct == 0.0
+        assert not math.isnan(dt.pad_waste_pct)
+
+    def test_transfer_decomposition_and_byte_ledger(self):
+        dt = DeviceTelemetry()
+        dt.observe_transfer(4096, arena_s=0.001, transfer_s=0.002)
+        dt.observe_transfer(1024, arena_s=0.003, transfer_s=0.004)
+        assert dt.transfer_bytes == 5120
+        snap = dt.snapshot()["stage_split_ms"]
+        assert snap["arena"]["count"] == 2
+        assert snap["transfer"]["count"] == 2
+        assert snap["transfer"]["p99_ms"] >= snap["transfer"]["p50_ms"] > 0
+
+    def test_score_per_bucket_counts(self):
+        dt = DeviceTelemetry()
+        a, b = _mk_batch(100, 100), _mk_batch(200, 300)
+        for _ in range(3):
+            dt.observe_score(a, 0.01)
+        dt.observe_score(b, 0.02)
+        snap = dt.snapshot()
+        assert snap["buckets"][bucket_key(a)]["scored"] == 3
+        assert snap["buckets"][bucket_key(b)]["scored"] == 1
+
+    def test_disabled_plane_is_inert(self):
+        dt = DeviceTelemetry(enabled=False)
+        dt.observe_staged(_mk_batch(10, 10))
+        dt.observe_transfer(100, 0.1, 0.1)
+        dt.observe_score(_mk_batch(10, 10), 0.1)
+        assert dt.staged_windows == 0 and dt.transfer_bytes == 0
+        assert dt.snapshot()["buckets"] == {}
+
+    def test_disabled_plane_registers_nothing_absent_not_zero(self):
+        # DEVICE_TRACE_ENABLED=0 must make the series ABSENT from the
+        # scrape, not render pad_waste_pct=0 as if collection were live
+        # and perfectly efficient (review finding)
+        m = Metrics()
+        DeviceTelemetry(metrics=m, enabled=False)
+        snap = m.snapshot()
+        assert not any(k.startswith("device.") for k in snap)
+        text = m.render_prometheus()
+        assert "alaz_tpu_device_pad_waste_pct" not in text
+        assert "alaz_tpu_latency_stage_arena_s" not in text
+
+    def test_metrics_registration_gauges_exact(self):
+        m = Metrics()
+        dt = DeviceTelemetry(metrics=m)
+        dt.observe_staged(_mk_batch(100, 200))
+        dt.observe_transfer(2048, 0.001, 0.001)
+        snap = m.snapshot()
+        assert snap["device.staged_edges"] == 200
+        assert snap["device.padded_edge_slots"] == 56
+        assert snap["device.transfer_bytes"] == 2048
+        assert snap["device.pad_waste_pct"] == pytest.approx(100.0 * 56 / 256)
+        # no gauge error anywhere on the zero/low-traffic paths
+        assert m.counter("metrics.gauge_errors").value == 0
+
+    def test_bucket_registration_never_holds_device_lock(self):
+        # ABBA regression (review finding): _bucket used to call the
+        # Metrics registry while holding the device lock, while the
+        # registry reads the pad_waste gauge while holding ITS lock — a
+        # /metrics scrape racing a first-bucket staging deadlocked both
+        m = Metrics()
+        dt = DeviceTelemetry(metrics=m)
+        orig = m.histogram
+        held_during_registration = []
+
+        def spy(name, sparse=False, bounds=None):
+            held_during_registration.append(dt._lock.locked())
+            return orig(name, sparse=sparse, bounds=bounds)
+
+        m.histogram = spy
+        dt.observe_staged(_mk_batch(100, 200))
+        assert held_during_registration  # the spy saw the registration
+        assert not any(held_during_registration)
+
+    def test_pad_waste_gauge_readable_while_device_lock_held(self):
+        # the other half of the ABBA cycle: the registry reads this
+        # gauge under its own lock, so the read must never block on the
+        # device lock (bounded probe, not a suite-wedging deadlock)
+        m = Metrics()
+        dt = DeviceTelemetry(metrics=m)
+        dt.observe_staged(_mk_batch(100, 200))
+        done = threading.Event()
+
+        def read():
+            assert m.snapshot()["device.pad_waste_pct"] > 0.0
+            done.set()
+
+        with dt._lock:
+            t = threading.Thread(target=read, daemon=True)
+            t.start()
+            t.join(3.0)
+        assert done.is_set(), "gauge read blocked on the device lock"
+
+    def test_batch_pad_waste_helper_matches_builder_counters(self):
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.aggregator.engine import Aggregator
+        from alaz_tpu.graph.builder import WindowedGraphStore
+        from alaz_tpu.replay.synth import make_ingest_trace
+
+        ev, msgs = make_ingest_trace(16384, windows=3, seed=4)
+        interner = Interner()
+        closed = []
+        store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+        cluster = ClusterInfo(interner)
+        for msg in msgs:
+            cluster.handle_msg(msg)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        agg.process_l7(ev, now_ns=10_000_000_000)
+        store.flush()
+        assert closed
+        assert store.builder.assembled_edge_rows == sum(b.n_edges for b in closed)
+        assert store.builder.assembled_pad_slots == sum(
+            b.pad_edge_slots for b in closed
+        )
+        assert store.builder.pad_waste_pct == pytest.approx(
+            batch_pad_waste_pct(closed)
+        )
+        assert 0.0 < store.builder.pad_waste_pct < 100.0  # non-vacuous
+
+
+# ---------------------------------------------------------------------------
+# Sparse (per-bucket) series exposition discipline
+# ---------------------------------------------------------------------------
+
+
+class TestSparseHistogramExposition:
+    def test_empty_sparse_series_omitted_everywhere(self):
+        # the ISSUE 11 satellite, next to the PR 9 gauge-error rule: a
+        # per-bucket series with zero observations is ABSENT from the
+        # snapshot and the scrape — never a nan/zero render
+        m = Metrics()
+        m.histogram("latency.score_s.n128xe256", sparse=True)
+        snap = m.snapshot()
+        assert not any(k.startswith("latency.score_s.") for k in snap)
+        text = m.render_prometheus()
+        assert "latency_score_s" not in text
+        assert "nan" not in text.lower().replace("alaz_tpu_", "")
+        json.dumps(snap, allow_nan=False)  # strict RFC 8259 consumers
+
+    def test_sparse_series_appears_after_first_observation(self):
+        m = Metrics()
+        h = m.histogram("latency.score_s.n128xe256", sparse=True)
+        h.observe(0.01)
+        snap = m.snapshot()
+        assert snap["latency.score_s.n128xe256.count"] == 1
+        text = m.render_prometheus()
+        assert "# TYPE alaz_tpu_latency_score_s_n128xe256 histogram" in text
+
+    def test_fixed_name_histograms_still_render_at_zero(self):
+        # dashboards key on the fixed stage series EXISTING; only the
+        # dynamic per-bucket label space is sparse
+        m = Metrics()
+        m.histogram("latency.merge_s")
+        assert "latency.merge_s.count" in m.snapshot()
+        assert "# TYPE alaz_tpu_latency_merge_s histogram" in m.render_prometheus()
+
+    def test_sparse_discipline_holds_alongside_gauge_error_path(self):
+        # the regression pairing the satellite asks for: an erroring
+        # gauge and an empty sparse series in ONE registry — both
+        # absent, the error counted, nothing nan
+        m = Metrics()
+        m.histogram("device.occupancy.n128xe256", sparse=True)
+        m.gauge("bad.gauge", lambda: 1 / 0)
+        snap = m.snapshot()
+        assert "bad.gauge" not in snap
+        assert not any(k.startswith("device.occupancy.") for k in snap)
+        assert m.counter("metrics.gauge_errors").value >= 1
+        text = m.render_prometheus()
+        assert "bad_gauge" not in text
+        assert "device_occupancy" not in text
+
+
+# ---------------------------------------------------------------------------
+# CompileWatcher duration capture (the retrace.py extension)
+# ---------------------------------------------------------------------------
+
+
+class TestCompileWatcherDurations:
+    def test_finished_events_carry_durations_and_callback_fires(self):
+        import jax.numpy as jnp
+
+        from alaz_tpu.sanitize.retrace import CompileWatcher
+
+        seen = []
+
+        def on_event(kind, name, secs):
+            seen.append((kind, name, secs))
+
+        def obsdev_probe_fn(x):
+            return x * 2.0
+
+        with CompileWatcher(on_event=on_event) as w:
+            jax.jit(obsdev_probe_fn)(jnp.ones((7,)))
+        assert w.count("obsdev_probe_fn") == 1
+        finished = [n for n, _ in w.finished]
+        assert "obsdev_probe_fn" in finished
+        secs = dict(w.finished)["obsdev_probe_fn"]
+        assert secs > 0.0
+        assert ("compiling", "obsdev_probe_fn", None) in seen
+        assert any(
+            k == "finished" and n == "obsdev_probe_fn" and s == secs
+            for k, n, s in seen
+        )
+
+    def test_watcher_retention_is_bounded(self):
+        # the production plane holds a watcher open for the service
+        # lifetime: in the exact pathology it detects (a per-window
+        # steady-state retrace) the event lists must ring, not leak
+        from alaz_tpu.sanitize.retrace import CompileWatcher
+
+        w = CompileWatcher(max_events=8)
+        for i in range(50):
+            w._record(f"fn{i}", f"Compiling fn{i} with ...")
+            w._finished(f"fn{i}", 0.01)
+        assert len(w.events) == 8
+        assert len(w.finished) == 8
+        assert w.events[0][0] == "fn42"  # oldest dropped
+
+    def test_raising_callback_is_swallowed(self):
+        import jax.numpy as jnp
+
+        from alaz_tpu.sanitize.retrace import CompileWatcher
+
+        def explode(kind, name, secs):
+            raise RuntimeError("sink blew up")
+
+        with CompileWatcher(on_event=explode) as w:
+            jax.jit(lambda x: x + 3.0)(jnp.ones((9,)))
+        assert w.total >= 1  # capture survived its consumer
+
+
+# ---------------------------------------------------------------------------
+# The production compile plane + per-bucket score telemetry, driven
+# through a REAL scoring Service (windows fed straight to the scorer)
+# ---------------------------------------------------------------------------
+
+
+def _scoring_service(hidden: int, score_batch_windows: int = 1) -> Service:
+    """A Service whose jit cache no other test pre-warmed: off-default
+    hidden_dim ⇒ its own ModelConfig ⇒ its own lru_cache entry."""
+    cfg = RuntimeConfig(
+        model=ModelConfig(model="graphsage", hidden_dim=hidden, use_pallas=False),
+        score_batch_windows=score_batch_windows,
+    )
+    init, _ = get_model("graphsage")
+    params = init(jax.random.PRNGKey(0), cfg.model)
+    return Service(config=cfg, interner=Interner(), model_state=params)
+
+
+class TestCompileEventPlaneProduction:
+    def test_one_compile_per_bucket_at_warmup_then_zero_steady_state(self):
+        """The alazsan acceptance budget, asserted through the PRODUCTION
+        metric: compile.score_apply == one per (model, bucket) after
+        warmup, frozen across a steady-state pass over the same buckets;
+        the per-bucket score histograms count every scored window; every
+        compile landed in the flight recorder with its bucket tag."""
+        svc = _scoring_service(hidden=44)
+        assert svc.compile_plane is not None  # always-on for scorers
+        buckets = [(100, 100), (200, 300)]  # n128xe128, n256xe384
+        svc.start()
+        try:
+            w_ms = 1000
+            for n, e in buckets:  # warmup: one compile per bucket
+                svc.window_queue.put_nowait_drop(
+                    [_mk_batch(n, e, svc.config.model, seed=n, window_start_ms=w_ms)]
+                )
+                w_ms += 1000
+            svc.drain(timeout_s=30)
+            warm = svc.compile_plane.count("score_apply")
+            assert warm == len(buckets), svc.compile_plane.snapshot()
+            assert svc.metrics.counter("compile.score_apply").value == warm
+            for rep in range(2):  # steady state: same buckets, new data
+                for n, e in buckets:
+                    svc.window_queue.put_nowait_drop(
+                        [_mk_batch(n, e, svc.config.model, seed=50 + rep + n,
+                                   window_start_ms=w_ms)]
+                    )
+                    w_ms += 1000
+            svc.drain(timeout_s=30)
+        finally:
+            svc.stop()
+        assert svc.scored_batches == 6
+        # steady state: the production counter FROZE
+        assert svc.compile_plane.count("score_apply") == len(buckets)
+        assert svc.metrics.counter("compile.score_apply").value == len(buckets)
+        assert svc.metrics.counter("compile.events").value >= len(buckets)
+        # per-bucket score histograms: count == scored windows per bucket
+        snap = svc.device.snapshot()
+        assert snap["buckets"]["n128xe128"]["scored"] == 3
+        assert snap["buckets"]["n256xe384"]["scored"] == 3
+        for key in ("n128xe128", "n256xe384"):
+            h = svc.metrics.histogram(f"latency.score_s.{key}")
+            assert h.total_count == snap["buckets"][key]["scored"], key
+        # occupancy accounting staged exactly what was scored
+        assert snap["staged_windows"] == 6
+        assert snap["buckets"]["n128xe128"]["staged"] == 3
+        # transfer decomposition saw one dispatch per window, with bytes
+        assert snap["stage_split_ms"]["arena"]["count"] == 6
+        assert snap["stage_split_ms"]["transfer"]["count"] == 6
+        assert snap["transfer_bytes"] > 0
+        # compile events rode the flight recorder with bucket attribution
+        compile_evs = [
+            e for e in svc.recorder.events()
+            if e["kind"] == "compile" and e.get("fn") == "score_apply"
+        ]
+        assert len(compile_evs) == len(buckets)
+        assert {e["bucket"] for e in compile_evs} == {"n128xe128", "n256xe384"}
+        assert all(e["duration_ms"] > 0 for e in compile_evs)
+
+    def test_no_compile_plane_without_model_and_kill_switch_honored(self):
+        svc = Service(interner=Interner())  # scoring disabled
+        assert svc.compile_plane is None
+        cfg = RuntimeConfig(trace=TraceConfig(device_enabled=False))
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg.model)
+        svc2 = Service(config=cfg, interner=Interner(), model_state=params)
+        assert svc2.compile_plane is None
+        assert not svc2.device.enabled
+        # the MASTER switch silences the compile capture too (review
+        # finding: TRACE_ENABLED=0 left it running)
+        cfg3 = RuntimeConfig(trace=TraceConfig(enabled=False))
+        svc3 = Service(config=cfg3, interner=Interner(), model_state=params)
+        assert svc3.compile_plane is None
+        assert not svc3.device.enabled
+
+
+# ---------------------------------------------------------------------------
+# End to end through the REAL ingest pipelines (serial store and
+# ShardedIngest N ∈ {1, 2}) with the scorer behind them
+# ---------------------------------------------------------------------------
+
+
+class TestPerBucketTelemetryEndToEnd:
+    def _drive(self, ingest_workers: int | None, hidden: int):
+        from alaz_tpu.config import SimulationConfig
+        from alaz_tpu.replay.simulator import Simulator
+
+        interner = Interner()
+        cfg = RuntimeConfig(
+            model=ModelConfig(model="graphsage", hidden_dim=hidden,
+                              use_pallas=False),
+        )
+        if ingest_workers is not None:
+            cfg.ingest_workers = ingest_workers
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg.model)
+        svc = Service(config=cfg, interner=interner, model_state=params,
+                      score_threshold=0.0)
+        sim = Simulator(
+            SimulationConfig(test_duration_s=1.5, pod_count=30,
+                             service_count=10, edge_count=15, edge_rate=200),
+            interner=interner,
+        )
+        svc.start()
+        try:
+            for m in sim.setup():
+                svc.submit_k8s(m)
+            svc.submit_tcp(sim.tcp_events())
+            time.sleep(0.1)
+            for batch in sim.iter_l7_batches():
+                svc.submit_l7(batch)
+            svc.drain(timeout_s=20)
+            svc.flush_windows()
+            svc.drain(timeout_s=20)
+        finally:
+            svc.stop()
+        return svc
+
+    @pytest.mark.parametrize("workers", [None, 1, 2])
+    def test_score_histogram_count_equals_scored_windows_per_bucket(self, workers):
+        # distinct hidden per pipeline shape so each drive owns its jit
+        # cache (the compile assertions stay meaningful)
+        svc = self._drive(workers, hidden=48 + (0 if workers is None else workers))
+        assert svc.scored_batches > 0
+        snap = svc.device.snapshot()
+        assert snap["buckets"], "no bucket telemetry for a scoring service"
+        total = 0
+        for key, b in snap["buckets"].items():
+            h = svc.metrics.histogram(f"latency.score_s.{key}")
+            assert h.total_count == b["scored"], key
+            # every scored window was first staged (serial scorer path)
+            assert b["staged"] == b["scored"], key
+            total += b["scored"]
+        assert total == svc.scored_batches
+        # warmup compiled once per bucket, through the production metric
+        assert svc.compile_plane.count("score_apply") == len(snap["buckets"])
+        # staging decomposition + byte ledger are non-vacuous
+        assert snap["transfer_bytes"] > 0
+        assert snap["stage_split_ms"]["transfer"]["count"] == svc.scored_batches
+        # pad-waste gauge agrees with the exact slot accounting
+        expect = 100.0 * snap["padded_edge_slots"] / (
+            snap["padded_edge_slots"] + snap["staged_edges"]
+        )
+        assert svc.device.pad_waste_pct == pytest.approx(expect)
+        assert svc.metrics.snapshot()["device.pad_waste_pct"] == pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# /profile endpoint: bounded, single-flight, overlap-rejecting
+# ---------------------------------------------------------------------------
+
+
+class TestProfileEndpoint:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=60
+            ) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_profile_drive_with_overlap_rejection_and_clamp(self):
+        import tempfile
+
+        from alaz_tpu.runtime.debug_http import DebugServer
+
+        # the process's FIRST profiler session pays ~10s of one-time
+        # lazy init on this box; warm it so the drive below measures the
+        # endpoint's behavior, not the profiler's setup
+        with jax.profiler.trace(tempfile.mkdtemp(prefix="alaz-warm-")):
+            pass
+
+        cfg = RuntimeConfig(trace=TraceConfig(profile_max_s=0.4))
+        svc = Service(config=cfg, interner=Interner())
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            # bad input: a non-numeric seconds is a 400, not a crash
+            code, _ = self._get(port, "/profile?seconds=banana")
+            assert code == 400
+            # nan parses as float and sails through min/max clamps
+            # (NaN comparisons are all False) — must 400 before any
+            # side effect, not 500 at time.sleep(nan)
+            for bad in ("nan", "inf", "-inf"):
+                code, body = self._get(port, f"/profile?seconds={bad}")
+                assert code == 400, (bad, body)
+            assert not any(
+                e["kind"] == "profile" for e in svc.recorder.events()
+            ), "rejected request left a recorder event"
+            # a long request CLAMPS to PROFILE_MAX_SECONDS: the endpoint
+            # can never wedge a debug thread for the requested hour
+            results = {}
+
+            def long_profile():
+                results["first"] = self._get(port, "/profile?seconds=60")
+
+            t = threading.Thread(target=long_profile)
+            t.start()
+            time.sleep(0.15)  # the first trace is now in flight
+            code2, body2 = self._get(port, "/profile?seconds=0.1")
+            t.join(timeout=10)
+            code1, body1 = results["first"]
+            # exactly one of the overlapping requests ran; the other got
+            # the single-flight rejection
+            assert code1 == 200, body1
+            assert code2 == 409, body2
+            parsed = json.loads(body1)
+            assert parsed["seconds"] == 0.4  # clamped
+            assert parsed["requested_seconds"] == 60.0
+            import os
+
+            assert os.path.isdir(parsed["trace_dir"])
+            # single-flight released: a later request succeeds again
+            code3, body3 = self._get(port, "/profile?seconds=0.05")
+            assert code3 == 200, body3
+            # the deep dive left its trail in the flight recorder
+            assert any(
+                e["kind"] == "profile" for e in svc.recorder.events()
+            )
+            # the manual /profiler session and /profile exclude each
+            # other (jax's profiler is process-global): while a manual
+            # trace runs, /profile is 409; after stop it works again
+            code4, body4 = self._get(port, "/profiler/start")
+            assert code4 == 200 and "tracing to" in body4
+            code5, _ = self._get(port, "/profile?seconds=0.05")
+            assert code5 == 409
+            code6, body6 = self._get(port, "/profiler/stop")
+            assert code6 == 200 and "trace written" in body6
+            code7, _ = self._get(port, "/profile?seconds=0.05")
+            assert code7 == 200
+        finally:
+            server.stop()
+
+
+class TestProfileDirRetention:
+    def test_prune_keeps_only_newest_dirs(self, tmp_path, monkeypatch):
+        # a polled /profile must not grow /tmp without bound (review
+        # finding): older trace dirs beyond the newest few are pruned
+        import os
+        import tempfile
+
+        from alaz_tpu.runtime.debug_http import DebugServer
+
+        monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+        mine = DebugServer._profile_prefix()
+        for i in range(7):
+            d = tmp_path / f"{mine}{i}"
+            d.mkdir()
+            (d / "trace.json").write_text("{}")
+            os.utime(d, (i, i))  # deterministic mtime order
+        # a SIBLING process's in-flight trace (different pid): the
+        # per-process single-flight lock can't protect it, so the
+        # pruner must never touch it
+        other = tmp_path / f"alaz-profile-{os.getpid() + 1}-0"
+        other.mkdir()
+        (tmp_path / "unrelated-dir").mkdir()
+        DebugServer._prune_profile_dirs(keep=4)
+        left = sorted(p.name for p in tmp_path.iterdir())
+        assert left == sorted([
+            f"{mine}3", f"{mine}4", f"{mine}5", f"{mine}6",
+            other.name, "unrelated-dir",
+        ])
+
+
+# ---------------------------------------------------------------------------
+# Bench regression ledger (the bounded smoke wired into make test)
+# ---------------------------------------------------------------------------
+
+
+class TestBenchHistory:
+    def _out(self, value=1000, scatter_p99=10.0, rows=65536):
+        return {
+            "metric": "ingest_rows_per_sec",
+            "value": value,
+            "unit": "rows/s",
+            "rows": rows,
+            "windows_closed": 8,
+            "pad_waste_pct": 5.0,
+            "trace_overhead_pct": 1.0,
+            "stage_latency": {
+                "scatter": {"count": 8, "p50_ms": 5.0, "p99_ms": scatter_p99},
+                "merge": {"count": 8, "p50_ms": 1.0, "p99_ms": 2.0},
+            },
+        }
+
+    def _seed_history(self, path, n=4, value=1000, scatter_p99=10.0):
+        from bench import append_bench_history
+
+        for _ in range(n):
+            append_bench_history(self._out(value, scatter_p99), str(path))
+
+    def test_empty_history_yields_no_findings(self, tmp_path):
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        assert check_bench_history(self._out(), str(hist)) == []
+
+    def test_rows_per_sec_drop_over_10pct_flags(self, tmp_path):
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        self._seed_history(hist)
+        assert check_bench_history(self._out(value=950), str(hist)) == []
+        findings = check_bench_history(self._out(value=850), str(hist))
+        assert len(findings) == 1 and "rows/s regression" in findings[0]
+
+    def test_p99_stage_inflation_flags_with_absolute_floor(self, tmp_path):
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        self._seed_history(hist, scatter_p99=10.0)
+        # 2x + >1ms over the median: flagged
+        findings = check_bench_history(
+            self._out(scatter_p99=25.0), str(hist)
+        )
+        assert len(findings) == 1 and "scatter" in findings[0]
+        # big relative jump on a sub-ms stage: under the absolute floor,
+        # scheduler noise, not a regression
+        self._seed_history(tmp_path / "h2.jsonl", scatter_p99=0.2)
+        assert check_bench_history(
+            self._out(scatter_p99=0.9), str(tmp_path / "h2.jsonl")
+        ) == []
+
+    def test_incomparable_rounds_never_cross_judge(self, tmp_path):
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        self._seed_history(hist)  # rows=65536 series
+        # the 1M-row series has no priors: a small smoke run can never
+        # poison (or be poisoned by) the flagship series
+        out = self._out(value=100, rows=1_048_576)
+        assert check_bench_history(out, str(hist)) == []
+
+    def test_foreign_host_rounds_never_judge_this_host(self, tmp_path):
+        # the committed history crosses machines: entries from a
+        # different core count are not comparable — a slow box must not
+        # flag phantom regressions against a fast box's median
+        import os
+
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        entry = {
+            "metric": "ingest_rows_per_sec", "value": 10_000_000,
+            "rows": 65536, "cpus": (os.cpu_count() or 1) + 99,
+            "stage_p99_ms": {},
+        }
+        with open(hist, "w") as f:
+            for _ in range(5):
+                f.write(json.dumps(entry) + "\n")
+        assert check_bench_history(self._out(value=100), str(hist)) == []
+
+    def test_sustained_regression_keeps_flagging(self, tmp_path):
+        # review finding: appended regressed rounds used to absorb into
+        # the trailing median after ~window/2 rounds and silence the
+        # alarm; flagged rounds are now excluded from the median basis
+        from bench import append_bench_history, check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        self._seed_history(hist, n=4, value=1000)
+        for round_i in range(5):  # the regression persists for 5 rounds
+            out = self._out(value=850)
+            findings = check_bench_history(out, str(hist))
+            assert findings, f"round {round_i} stopped flagging"
+            out["regression_findings"] = len(findings)
+            append_bench_history(out, str(hist))
+        # recovery to the old level reads clean again
+        assert check_bench_history(self._out(value=1000), str(hist)) == []
+
+    def test_append_then_check_roundtrip(self, tmp_path):
+        from bench import append_bench_history, check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        for v in (1000, 1010, 990, 1005):
+            append_bench_history(self._out(value=v), str(hist))
+        lines = [json.loads(ln) for ln in hist.read_text().splitlines()]
+        assert len(lines) == 4
+        assert all(ln["metric"] == "ingest_rows_per_sec" for ln in lines)
+        assert lines[0]["stage_p99_ms"]["scatter"] == 10.0
+        # an equal round is clean; the trajectory is self-consistent
+        assert check_bench_history(self._out(value=1000), str(hist)) == []
+
+    def test_corrupt_history_lines_are_skipped(self, tmp_path):
+        from bench import check_bench_history
+
+        hist = tmp_path / "h.jsonl"
+        self._seed_history(hist)
+        with open(hist, "a") as f:
+            f.write("{truncated by a killed roun")  # no newline, no JSON
+        findings = check_bench_history(self._out(value=500), str(hist))
+        assert len(findings) == 1  # the intact rounds still judge
