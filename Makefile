@@ -7,7 +7,7 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint sanitize abi-check flow chaos scenarios specs image image-multiarch bench
+.PHONY: native test lint sanitize abi-check flow race chaos scenarios specs image image-multiarch bench
 
 native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
@@ -16,11 +16,14 @@ native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent e
 # main run skips their test files so the (not-cheap) stress and
 # spec-regen work isn't paid twice per invocation (tier-1 CI runs plain
 # `pytest tests/` and still covers both)
-test: lint sanitize abi-check flow chaos scenarios
+test: lint sanitize abi-check flow race chaos scenarios
 	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py --ignore=tests/test_alazspec.py
 
 flow:  ## alazflow: whole-program row-conservation + blocking-discipline dataflow (ALZ040-ALZ044), incl. cause-vocabulary/metric-registry triangulation
 	python -m tools.alazflow --json
+
+race:  ## alazrace: whole-program thread-escape + lockset race detection (ALZ050-ALZ054), incl. golden concurrency-map drift (resources/specs/threads.json)
+	python -m tools.alazrace --json
 
 chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates + one composed scenario×chaos case (no accelerator needed)
 	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2 --composed hot_key
@@ -34,11 +37,12 @@ sanitize:  ## alazsan runtime heads: lock-order stress + retrace budgets + trans
 abi-check:  ## alazspec: C-struct/dtype/enum ABI parity + golden shape/dtype/sharding contract diff (ALZ020-ALZ023)
 	env JAX_PLATFORMS=cpu python -m tools.alazspec --abi --check-specs --json
 
-specs:  ## regenerate golden specfiles + wire layout table (resources/specs) — review and commit the diff
+specs:  ## regenerate golden specfiles + wire layout table + concurrency map (resources/specs) — review and commit the diff
 	env JAX_PLATFORMS=cpu python -m tools.alazspec --write-specs
+	python -m tools.alazrace --write-threads
 
 lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 and spec hygiene ALZ024 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
-	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec tools/alazflow --json
+	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec tools/alazflow tools/alazrace --json
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check alaz_tpu tools; \
 	else \

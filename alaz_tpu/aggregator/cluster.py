@@ -30,10 +30,10 @@ class _IpTable:
     """dict[u32 ip] -> int32 uid-id with a lazily compiled sorted-array view."""
 
     def __init__(self) -> None:
-        self._map: dict[int, int] = {}
-        self._dirty = True
-        self._ips = np.zeros(0, dtype=np.uint32)
-        self._uids = np.zeros(0, dtype=np.int32)
+        self._map: dict[int, int] = {}  # guarded-by: self._lock
+        self._dirty = True  # guarded-by: self._lock
+        self._ips = np.zeros(0, dtype=np.uint32)  # guarded-by: self._lock
+        self._uids = np.zeros(0, dtype=np.int32)  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def set(self, ip: int, uid_id: int) -> None:
@@ -63,7 +63,10 @@ class _IpTable:
             return self._ips, self._uids
 
     def contains(self, ip: int) -> bool:
-        return ip in self._map
+        # under the lock like every other _map access (alazrace ALZ050:
+        # this read used to race the k8s fold's set/remove rehash)
+        with self._lock:
+            return ip in self._map
 
     def lookup(self, ips: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(found_mask, uid_ids) for a batch of uint32 IPs."""
@@ -78,7 +81,8 @@ class _IpTable:
         return found, uids
 
     def __len__(self) -> int:
-        return len(self._map)
+        with self._lock:
+            return len(self._map)
 
 
 class ClusterInfo:
@@ -88,11 +92,18 @@ class ClusterInfo:
         self.interner = interner
         self.pod_ips = _IpTable()
         self.svc_ips = _IpTable()
-        # uid-id keyed object snapshots (for features + datastore forward)
-        self.pods: dict[int, Pod] = {}
-        self.services: dict[int, Service] = {}
-        self._pod_uid_to_ip: dict[int, int] = {}
-        self._svc_uid_to_ips: dict[int, list[int]] = {}
+        # uid-id keyed object snapshots (for features + datastore
+        # forward). The IP tables carry their own locks; these dicts
+        # used to ride bare on "only the k8s fold writes them" — true
+        # today, but the fold thread differs by mode (k8s worker serial,
+        # the scatter caller sharded) and nothing stopped a reader from
+        # growing on another role (alazrace ALZ050). One low-rate lock
+        # per k8s EVENT — control plane, never the row path.
+        self._meta_lock = threading.Lock()
+        self.pods: dict[int, Pod] = {}  # guarded-by: self._meta_lock
+        self.services: dict[int, Service] = {}  # guarded-by: self._meta_lock
+        self._pod_uid_to_ip: dict[int, int] = {}  # guarded-by: self._meta_lock
+        self._svc_uid_to_ips: dict[int, list[int]] = {}  # guarded-by: self._meta_lock
 
     # -- k8s event folding (persist.go:55-130 handler analog) --------------
 
@@ -108,67 +119,72 @@ class ClusterInfo:
 
     def _handle_pod(self, event: EventType, pod: Pod) -> None:
         uid_id = self.interner.intern(pod.uid)
-        old_ip = self._pod_uid_to_ip.get(uid_id)
-        if event == EventType.DELETE:
-            if old_ip is not None:
+        # lock order: _meta_lock → _IpTable._lock (one direction — the
+        # IP tables never call back into ClusterInfo)
+        with self._meta_lock:
+            old_ip = self._pod_uid_to_ip.get(uid_id)
+            if event == EventType.DELETE:
+                if old_ip is not None:
+                    self.pod_ips.remove(old_ip)
+                    self._pod_uid_to_ip.pop(uid_id, None)
+                self.pods.pop(uid_id, None)
+                return
+            self.pods[uid_id] = pod
+            if not pod.ip:
+                return
+            ip = ip_to_u32(pod.ip)
+            if old_ip is not None and old_ip != ip:
                 self.pod_ips.remove(old_ip)
-                self._pod_uid_to_ip.pop(uid_id, None)
-            self.pods.pop(uid_id, None)
-            return
-        self.pods[uid_id] = pod
-        if not pod.ip:
-            return
-        ip = ip_to_u32(pod.ip)
-        if old_ip is not None and old_ip != ip:
-            self.pod_ips.remove(old_ip)
-        self.pod_ips.set(ip, uid_id)
-        self._pod_uid_to_ip[uid_id] = ip
+            self.pod_ips.set(ip, uid_id)
+            self._pod_uid_to_ip[uid_id] = ip
 
     def _handle_service(self, event: EventType, svc: Service) -> None:
         uid_id = self.interner.intern(svc.uid)
-        old_ips = self._svc_uid_to_ips.get(uid_id, [])
-        if event == EventType.DELETE:
+        with self._meta_lock:
+            old_ips = self._svc_uid_to_ips.get(uid_id, [])
+            if event == EventType.DELETE:
+                for ip in old_ips:
+                    self.svc_ips.remove(ip)
+                self._svc_uid_to_ips.pop(uid_id, None)
+                self.services.pop(uid_id, None)
+                return
+            self.services[uid_id] = svc
+            ips = []
+            candidates = list(svc.cluster_ips) if svc.cluster_ips else []
+            if svc.cluster_ip and svc.cluster_ip not in candidates:
+                candidates.append(svc.cluster_ip)
+            for ip_s in candidates:
+                if ip_s and ip_s not in ("None", ""):
+                    try:
+                        ips.append(ip_to_u32(ip_s))
+                    except OSError:
+                        continue
             for ip in old_ips:
-                self.svc_ips.remove(ip)
-            self._svc_uid_to_ips.pop(uid_id, None)
-            self.services.pop(uid_id, None)
-            return
-        self.services[uid_id] = svc
-        ips = []
-        candidates = list(svc.cluster_ips) if svc.cluster_ips else []
-        if svc.cluster_ip and svc.cluster_ip not in candidates:
-            candidates.append(svc.cluster_ip)
-        for ip_s in candidates:
-            if ip_s and ip_s not in ("None", ""):
-                try:
-                    ips.append(ip_to_u32(ip_s))
-                except OSError:
-                    continue
-        for ip in old_ips:
-            if ip not in ips:
-                self.svc_ips.remove(ip)
-        for ip in ips:
-            self.svc_ips.set(ip, uid_id)
-        self._svc_uid_to_ips[uid_id] = ips
+                if ip not in ips:
+                    self.svc_ips.remove(ip)
+            for ip in ips:
+                self.svc_ips.set(ip, uid_id)
+            self._svc_uid_to_ips[uid_id] = ips
 
     def _handle_endpoints(self, event: EventType, ep: Endpoints) -> None:
         # Endpoints → pod-IP hints for pods scheduled before their informer
         # event landed (persist.go forwards them; we fold addresses in).
         if event == EventType.DELETE:
             return
-        for addr in ep.addresses:
-            for aip in addr.ips:
-                if aip.type == "pod" and aip.ip and aip.id:
-                    try:
-                        ip = ip_to_u32(aip.ip)
-                    except OSError:
-                        continue
-                    if self.pod_ips.contains(ip):
-                        continue  # pod informer already owns this IP
-                    uid_id = self.interner.intern(aip.id)
-                    self.pod_ips.set(ip, uid_id)
-                    # record ownership so a later pod DELETE cleans it up
-                    self._pod_uid_to_ip.setdefault(uid_id, ip)
+        with self._meta_lock:
+            for addr in ep.addresses:
+                for aip in addr.ips:
+                    if aip.type == "pod" and aip.ip and aip.id:
+                        try:
+                            ip = ip_to_u32(aip.ip)
+                        except OSError:
+                            continue
+                        if self.pod_ips.contains(ip):
+                            continue  # pod informer already owns this IP
+                        uid_id = self.interner.intern(aip.id)
+                        self.pod_ips.set(ip, uid_id)
+                        # record ownership so a later pod DELETE cleans it up
+                        self._pod_uid_to_ip.setdefault(uid_id, ip)
 
     # -- batch attribution (setFromToV2, data.go:827-870) ------------------
 

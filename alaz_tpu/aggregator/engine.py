@@ -178,9 +178,12 @@ class AggregatorStats:
         self.l7_dropped_no_socket = 0
         self.l7_dropped_not_pod = 0
         self.l7_requeued = 0
-        self.tcp_in = 0
-        self.proc_in = 0
-        self.k8s_in = 0
+        # single-writer stream counters: each is incremented by exactly
+        # one worker (tcp/proc/k8s consume loops); readers are /stats
+        # gauges where an off-by-one-batch read is fine
+        self.tcp_in = 0  # lockless-ok: single-writer GIL-atomic int counter (tcp worker); racy reads are stats gauges
+        self.proc_in = 0  # lockless-ok: single-writer GIL-atomic int counter (proc worker); racy reads are stats gauges
+        self.k8s_in = 0  # lockless-ok: single-writer GIL-atomic int counter (k8s fold thread); racy reads are stats gauges
         self.edges_out = 0
         self.kafka_out = 0
         self.l7_rate_limited = 0
@@ -248,7 +251,7 @@ class Aggregator:
         # per-pid rate limiting (100/s burst 1000, data.go:339-353) — the
         # reference applies it on the trace path; gated off by default
         self.rate_limit: tuple[float, float] | None = None
-        self._pid_buckets: dict[int, TokenBucket] = {}
+        self._pid_buckets: dict[int, TokenBucket] = {}  # guarded-by: self._l7_lock
 
     def backfill_from_proc(
         self,
@@ -413,8 +416,12 @@ class Aggregator:
                 with self._l7_lock:  # stmt caches belong to the L7 worker
                     self.pg_stmts.drop_pid(pid)
                     self.mysql_stmts.drop_pid(pid)
-                # a reused pid must start with a fresh burst allowance
-                self._pid_buckets.pop(pid, None)
+                    # a reused pid must start with a fresh burst
+                    # allowance. Under the same lock as the L7 worker's
+                    # bucket inserts (alazrace ALZ050: this pop used to
+                    # ride bare on dict-op GIL atomicity while
+                    # _apply_rate_limit inserted concurrently)
+                    self._pid_buckets.pop(pid, None)
             elif r["type"] == ProcEventType.EXEC:
                 self.live_pids.add(pid)
 
@@ -457,10 +464,10 @@ class Aggregator:
         order = np.argsort(inverse, kind="stable")
         boundaries = np.searchsorted(inverse[order], np.arange(pids.shape[0] + 1))
         for g, pid in enumerate(pids):
-            bucket = self._pid_buckets.get(int(pid))
+            bucket = self._pid_buckets.get(int(pid))  # alazlint: disable=ALZ010 -- _l7_lock IS held here: _apply_rate_limit's only caller is process_l7 inside `with self._l7_lock` (the per-file rule can't see caller-held locks; alazrace's interprocedural lockset can and agrees)
             if bucket is None:
                 bucket = TokenBucket(rate, burst, now_s=now_s)
-                self._pid_buckets[int(pid)] = bucket
+                self._pid_buckets[int(pid)] = bucket  # alazlint: disable=ALZ010 -- same caller-held _l7_lock as the get above
             idx = order[boundaries[g] : boundaries[g + 1]]
             admitted = bucket.admit(idx.shape[0], now_s)
             if admitted < idx.shape[0]:
@@ -908,10 +915,14 @@ class Aggregator:
                     cache.clear()
         # prune idle rate-limit buckets (deployments without proc events
         # never hit the EXIT cleanup; idle = 10min behind the newest pid).
-        # Snapshot: the L7 worker inserts buckets concurrently.
-        buckets = list(self._pid_buckets.items())
-        if buckets:
-            newest = max(b._last for _, b in buckets)
-            for p, b in buckets:
-                if newest - b._last > 600:
-                    self._pid_buckets.pop(p, None)
+        # Under the L7 lock like every other bucket access (alazrace
+        # ALZ050: the snapshot+pop used to race the L7 worker's inserts
+        # on GIL atomicity alone); the sweep is 10-minute housekeeping,
+        # so holding the RLock for the scan costs nothing measurable.
+        with self._l7_lock:
+            buckets = list(self._pid_buckets.items())
+            if buckets:
+                newest = max(b._last for _, b in buckets)
+                for p, b in buckets:
+                    if newest - b._last > 600:
+                        self._pid_buckets.pop(p, None)

@@ -458,7 +458,7 @@ class ShardedIngest:
         # heartbeat races the wave-waiter's, and whoever loses that race
         # must still re-drive (the original close died with the thread)
         self._worker_gen = [0] * self.n  # guarded-by: self._restart_lock
-        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge
+        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge  # lockless-ok: written only under the merge lock's bare bounded acquire (invisible to with-based lockset models); the racy float read IS the last_wave_age_s freshness gauge
 
         self._stop = threading.Event()
         if autostart:

@@ -259,7 +259,7 @@ class SocketLineStore:
     because keys are interned tuples, not a kernel address space."""
 
     def __init__(self) -> None:
-        self._lines: dict[tuple[int, int], SocketLine] = {}
+        self._lines: dict[tuple[int, int], SocketLine] = {}  # lockless-ok: double-checked fast path — reads are single GIL-atomic dict lookups; every structural mutation holds self._lock
         self._lock = threading.Lock()
 
     def __len__(self) -> int:

@@ -408,6 +408,7 @@ def _run_backend_leg(
         error_prob=cfg.backend_error_prob,
         timeout_prob=cfg.backend_timeout_prob,
     )
+    ledger = DropLedger()
     be = BatchingBackend(
         flaky,
         Interner(),
@@ -421,6 +422,7 @@ def _run_backend_leg(
         ),
         time_fn=time_fn,
         sleep_fn=sleep_fn,
+        ledger=ledger,
     )
     # breaker open/close flips land in the suite ring, so a failing
     # backend gate replays WHEN the export leg went dark
@@ -454,12 +456,26 @@ def _run_backend_leg(
     appended += 40
     be.pump(force=True)
     st = be.stats()
-    settled = st["requests"]["sent"] + st["requests"]["failed"]
-    if settled + st["requests"]["pending"] != appended:
+    req = st["requests"]
+    # EXACT conservation through the export leg (ISSUE 12 satellite):
+    # every appended row is sent, still pending, failed on the wire, or
+    # shed by the open breaker — and every shed row is attributed to the
+    # drop ledger's closed `shed` cause, exactly once. The old gate let
+    # breaker sheds hide inside `stream.failed`; now the ledger is the
+    # bookkeeper the rest of the pipeline already answers to.
+    settled = req["sent"] + req["failed"] + req["shed"]
+    if settled + req["pending"] != appended:
         findings.append(
             f"backend: rows unaccounted — appended={appended} "
-            f"sent={st['requests']['sent']} failed={st['requests']['failed']} "
-            f"pending={st['requests']['pending']}"
+            f"sent={req['sent']} failed={req['failed']} "
+            f"shed={req['shed']} pending={req['pending']}"
+        )
+    shed_ledgered = ledger.count("shed")
+    if shed_ledgered != req["shed"]:
+        findings.append(
+            f"backend: ledger drift — stream shed {req['shed']} rows but "
+            f"the ledger holds {shed_ledgered} under `shed` (every "
+            "breaker short must attribute exactly once)"
         )
     if be.breaker.state != "closed":
         findings.append(
@@ -467,8 +483,10 @@ def _run_backend_leg(
         )
     return {
         "appended_rows": appended,
-        "sent": st["requests"]["sent"],
-        "failed": st["requests"]["failed"],
+        "sent": req["sent"],
+        "failed": req["failed"],
+        "shed": req["shed"],
+        "ledger_shed": shed_ledgered,
         "breaker_opens": be.breaker.opens,
         "breaker_shorted": be.breaker.shorted,
         "breaker_state": be.breaker.state,

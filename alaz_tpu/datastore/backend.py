@@ -87,7 +87,7 @@ class CircuitBreaker:
         # optional flight recorder (ISSUE 9): open/close flips become
         # structured ring events, so a post-incident dump shows WHEN the
         # export leg went dark relative to the windows it was shedding
-        self.recorder = None
+        self.recorder = None  # lockless-ok: attach-once wiring before traffic flows; readers null-check an atomic reference swap
 
     def allow(self) -> bool:
         """May a send go to the wire right now?"""
@@ -171,6 +171,14 @@ def http_transport(host: str, timeout_s: float = 10.0) -> Transport:
 
 @dataclass
 class _Stream:
+    """Per-endpoint buffer + delivery accounting. Every field is
+    guarded by the owning ``BatchingBackend._lock`` — the backend is
+    the only holder of ``_Stream`` references, and alazrace's golden
+    concurrency map pins that ownership (the pump thread and the
+    caller's flush/stop both account through the one lock; the
+    off-lock ``sent += len(chunk)`` this replaced was an ALZ051 lost
+    update whenever ``stop(flush=True)`` overlapped a pump tick)."""
+
     name: str
     endpoint: str
     batch_size: int
@@ -178,7 +186,8 @@ class _Stream:
     pending: List[Any] = field(default_factory=list)
     last_flush: float = 0.0
     sent: int = 0
-    failed: int = 0
+    failed: int = 0  # exhausted the retry ladder (or non-retryable 4xx)
+    shed: int = 0  # short-circuited by the open breaker, never wired
 
 
 class BatchingBackend(BaseDataStore):
@@ -192,6 +201,7 @@ class BatchingBackend(BaseDataStore):
         config: Optional[BackendConfig] = None,
         time_fn: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
+        ledger=None,
     ):
         cfg = config if config is not None else BackendConfig()
         self.cfg = cfg
@@ -223,9 +233,15 @@ class BatchingBackend(BaseDataStore):
         )
         # metrics scrape-and-push leg (backend.go:340-392): a render
         # function (Prometheus text) polled every metrics_export_interval_s
-        self._metrics_render: Optional[Callable[[], str]] = None
-        self._metrics_last_push = now
-        self.metrics_pushed = 0
+        self._metrics_render: Optional[Callable[[], str]] = None  # lockless-ok: attach-once reference swap at wiring; the pump thread may already be live (cmd_serve starts the backend before Service attaches), but readers null-check and an unattached tick merely skips the scrape — nothing is lost or torn
+        self._metrics_last_push = now  # guarded-by: self._lock
+        self.metrics_pushed = 0  # guarded-by: self._lock
+        # drop-ledger hookup (ISSUE 12 satellite): rows the OPEN breaker
+        # sheds attribute to the closed `shed` cause, so the export leg
+        # joins the conservation equation instead of hiding loss in
+        # `stream.failed`; attach-once at wiring (Service adopts the
+        # backend into its ledger, the chaos harness passes its own)
+        self.ledger = ledger  # lockless-ok: attach-once reference swap at wiring; the pump thread may already be live (cmd_serve starts the backend before Service adopts it), but no rows are appended until wiring completes, so no shed can precede the swap — readers null-check
 
     # -- DataStore surface -------------------------------------------------
 
@@ -317,20 +333,32 @@ class BatchingBackend(BaseDataStore):
             log.warning(f"metrics push failed: {exc}")
             return
         if status < 400:
-            self.metrics_pushed += 1
+            with self._lock:
+                self.metrics_pushed += 1
         else:
             log.warning(f"metrics push not success: {status}")
 
     def pump(self, force: bool = False) -> None:
         """Flush every stream that hit its batch size or cadence; push the
-        metrics scrape when its interval elapses."""
+        metrics scrape when its interval elapses. Concurrency-safe
+        against itself: the pump thread and a caller's ``stop(flush=True)``
+        / manual pump both run this, so ALL accounting happens under
+        ``self._lock`` (alazrace ALZ050/051: the cadence stamp and the
+        sent/failed tallies used to race exactly that overlap)."""
         now = self.time_fn()
-        if (
-            self._metrics_render is not None
-            and self.cfg.metrics_export
-            and (force or now - self._metrics_last_push >= self.cfg.metrics_export_interval_s)
-        ):
-            self._metrics_last_push = now
+        push_due = False
+        if self._metrics_render is not None and self.cfg.metrics_export:
+            with self._lock:
+                push_due = (
+                    force
+                    or now - self._metrics_last_push
+                    >= self.cfg.metrics_export_interval_s
+                )
+                if push_due:
+                    # stamp INSIDE the lock: two racing pumps must not
+                    # both see "due" and double-push the scrape
+                    self._metrics_last_push = now
+        if push_due:
             self._push_metrics()
         for stream in list(self._streams.values()) + list(self._resource_streams.values()):
             with self._lock:
@@ -349,18 +377,29 @@ class BatchingBackend(BaseDataStore):
             # send outside the lock, chunked to batch_size
             for i in range(0, len(todo), stream.batch_size):
                 chunk = todo[i : i + stream.batch_size]
-                ok = self._send(stream.endpoint, chunk)
-                if ok:
-                    stream.sent += len(chunk)
-                else:
-                    stream.failed += len(chunk)
+                outcome = self._send(stream.endpoint, chunk)
+                with self._lock:
+                    if outcome == "sent":
+                        stream.sent += len(chunk)
+                    elif outcome == "shed":
+                        stream.shed += len(chunk)
+                    else:
+                        stream.failed += len(chunk)
+                if outcome == "shed" and self.ledger is not None:
+                    # outside the backend lock: the ledger has its own
+                    self.ledger.add(
+                        "shed", len(chunk), reason="breaker_open"
+                    )
 
-    def _send(self, endpoint: str, rows: List[Any]) -> bool:
+    def _send(self, endpoint: str, rows: List[Any]) -> str:
+        """One chunk's delivery fate: ``"sent"`` | ``"failed"`` (retry
+        ladder exhausted, or non-retryable 4xx) | ``"shed"`` (open
+        breaker short-circuit — attributed to the drop ledger by the
+        caller)."""
         if not self.breaker.allow():
-            # circuit open: shed without touching the wire (the caller
-            # counts the rows into stream.failed — same fate a failed
-            # retry ladder ends in, minus the retry ladder)
-            return False
+            # circuit open: shed without touching the wire — one counter
+            # bump + a ledger attribution instead of a retry ladder
+            return "shed"
         payload = {
             "metadata": {
                 "monitoring_id": self.cfg.monitoring_id,
@@ -379,7 +418,7 @@ class BatchingBackend(BaseDataStore):
                 status = 599
             if status < 400:
                 self.breaker.record(True)
-                return True
+                return "sent"
             if status not in (400, 429) and status < 500:
                 # non-retryable 4xx: drop loudly (once per endpoint) so a
                 # backend without this endpoint doesn't silently eat data.
@@ -391,7 +430,7 @@ class BatchingBackend(BaseDataStore):
                         f"dropping batch for {endpoint}: non-retryable HTTP {status}"
                     )
                 self.breaker.record(True)
-                return False
+                return "failed"
             if attempt < self.cfg.max_retries:
                 # exponential backoff with FULL jitter (not a fixed 0.1s
                 # additive fuzz): N agents retrying a recovered backend
@@ -402,7 +441,7 @@ class BatchingBackend(BaseDataStore):
                 )
                 backoff *= 2
         self.breaker.record(False)
-        return False
+        return "failed"
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -430,8 +469,14 @@ class BatchingBackend(BaseDataStore):
 
     def stats(self) -> dict:
         out = {}
-        for s in list(self._streams.values()) + list(self._resource_streams.values()):
-            out[s.name] = {"pending": len(s.pending), "sent": s.sent, "failed": s.failed}
+        with self._lock:
+            for s in list(self._streams.values()) + list(self._resource_streams.values()):
+                out[s.name] = {
+                    "pending": len(s.pending),
+                    "sent": s.sent,
+                    "failed": s.failed,
+                    "shed": s.shed,
+                }
         out["breaker"] = {
             "state": self.breaker.state,
             "opens": self.breaker.opens,

@@ -32,7 +32,7 @@ NODE_FEATURE_DIM = 32
 EDGE_FEATURE_DIM = 16
 
 
-class NodeTable:
+class NodeTable:  # role-private: every instance is owned by one GraphBuilder and mutated only behind that builder's owner's lock (WindowedGraphStore._lock serial / ShardedIngest's bounded _merge_lock acquire sharded) — cross-role reach is serialized by the owner, and alazrace's golden map pins the ownership
     """uid-id → stable node slot, with endpoint type.
 
     Backed by flat int32 arrays, not a dict: uid ids are interner ids, so
@@ -621,7 +621,7 @@ def partial_from_rows(
     )
 
 
-class GraphBuilder:
+class GraphBuilder:  # role-private: every instance is owned by one store and its mutations (node table growth, pad/sample counters) run only behind that owner's lock (WindowedGraphStore._lock serial / ShardedIngest's bounded _merge_lock acquire sharded) — cross-role reach is serialized by the owner, and alazrace's golden map pins the ownership
     """Aggregates one window's REQUEST_DTYPE rows into a GraphBatch.
 
     ``renumber=True`` applies the cluster_renumber locality pass to each
@@ -937,11 +937,11 @@ class WindowedGraphStore(BaseDataStore):
             tracer=tracer,
         )
         self.batches: List[GraphBatch] = []
-        self.request_count = 0
-        self.late_dropped = 0
-        self.last_persist_monotonic: float | None = None
+        self.request_count = 0  # guarded-by: self._lock
+        self.late_dropped = 0  # guarded-by: self._lock
+        self.last_persist_monotonic: float | None = None  # guarded-by: self._lock
         self._pending: dict[int, List[np.ndarray]] = {}
-        self._watermark = -1
+        self._watermark = -1  # guarded-by: self._lock
         self._closed_upto = -1
         self._lock = threading.Lock()
 

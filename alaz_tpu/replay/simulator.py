@@ -78,7 +78,7 @@ class Simulator:
         self.pods: List[Pod] = []
         self.services: List[Service] = []
         self.edges: List[SimEdge] = []
-        self._setup_done = False
+        self._setup_done = False  # lockless-ok: setup() completes before any delivery thread reads it (bool flip is a single store; asserts are the only readers)
 
     # -- topology ----------------------------------------------------------
 

@@ -80,10 +80,10 @@ class Gauge:
 
 class Metrics:
     def __init__(self) -> None:
-        self._counters: Dict[str, Counter] = {}
+        self._counters: Dict[str, Counter] = {}  # guarded-by: self._lock
         self._gauges: Dict[str, Gauge] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        self._histograms: Dict[str, Histogram] = {}  # guarded-by: self._lock
         # sparse histograms (ISSUE 11, the per-bucket labeled series):
         # registered lazily per shape bucket, OMITTED from snapshot and
         # exposition while their count is zero — the same discipline the

@@ -256,6 +256,17 @@ class Service:
                 metrics=self.metrics, recorder=self.recorder
             ).start()
         self._export_backend = export_backend
+        if export_backend is not None and getattr(
+            export_backend, "ledger", None
+        ) is None:
+            # wire the export leg its OWN ledger (ISSUE 12 satellite):
+            # breaker sheds attribute as the closed `shed` cause. A
+            # SEPARATE instance, not self.ledger — the export tee sees
+            # rows the graph path also emits, so folding its sheds into
+            # the pipeline ledger would double-count against
+            # pushed == emitted + ledger.total (the exact equation the
+            # chaos gates check); degraded_snapshot surfaces it apart.
+            export_backend.ledger = DropLedger()
 
         q = self.config.queues
         self.l7_queue = BatchQueue(q.l7_events, "l7", ledger=self.ledger)
@@ -926,6 +937,10 @@ class Service:
             out["last_wave_age_s"] = round(self.sharded.last_wave_age_s, 3)
             out["shard_backlog"] = self.sharded.unfinished
         be = self._export_backend
+        if be is not None and getattr(be, "ledger", None) is not None:
+            # the export leg's OWN ledger (breaker sheds) — reported
+            # beside, never summed into, the pipeline ledger above
+            out["export_ledger"] = be.ledger.snapshot()
         if be is not None and hasattr(be, "breaker"):
             out["breaker"] = {
                 "state": be.breaker.state,

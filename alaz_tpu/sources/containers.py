@@ -60,7 +60,7 @@ class ContainerIndex:
         self.sync_interval_s = sync_interval_s
         self.containers: Dict[str, ContainerInfo] = {}
         self.container_pids: Set[int] = set()
-        self._service = None
+        self._service = None  # lockless-ok: attach-once publication in start() before the sync thread exists; readers null-check an atomic reference swap
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()

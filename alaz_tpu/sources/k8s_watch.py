@@ -255,7 +255,7 @@ class K8sWatchSource:
         self._watches: set = set()  # guarded-by: self._watch_lock
         self._watch_lock = threading.Lock()
         self._client: Optional[K8sRestClient] = None
-        self._service = None
+        self._service = None  # lockless-ok: attach-once publication in start() before the watch threads exist; readers null-check an atomic reference swap
         self.live = False
 
     # -- injected mode (tests / replay) ------------------------------------

@@ -66,7 +66,7 @@ class DropLedger:
         # every ledger decision becomes a structured ring event — the
         # drop trail a post-incident dump replays. Attach-once at wiring
         # time (service / harness); adds are per-chunk, never per row.
-        self.recorder = None
+        self.recorder = None  # lockless-ok: attach-once wiring before the pipeline runs; readers null-check an atomic reference swap
 
     def add(self, cause: str, n: int, reason: Optional[str] = None) -> None:
         """Attribute ``n`` lost rows to ``cause``. Unknown causes raise —

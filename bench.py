@@ -646,6 +646,24 @@ def bench_ingest(args) -> dict:
     except Exception:  # repo layout unavailable (installed wheel): skip
         flow_findings = -1
 
+    # the race contract rides along too (ISSUE 12): the alazrace pass
+    # over the tree (unsynchronized multi-role writes, off-lock
+    # compounds, annotation closure, concurrency-map drift) must report
+    # 0, or the measured pipeline is one whose thread topology can
+    # drift under it. Its wall-clock is reported so the `make test`
+    # budget stays visible as the head (and the tree) grows.
+    try:
+        from tools.alazrace.driver import (
+            DEFAULT_PATHS as RACE_PATHS,
+            race_paths,
+        )
+
+        _race_t0 = time.perf_counter()
+        race_findings = len(race_paths(list(RACE_PATHS), tree_mode=True))
+        race_runtime_s = round(time.perf_counter() - _race_t0, 2)
+    except Exception:  # repo layout unavailable (installed wheel): skip
+        race_findings, race_runtime_s = -1, -1.0
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -659,6 +677,8 @@ def bench_ingest(args) -> dict:
         "chaos_findings": chaos_findings,
         "scenario_findings": scenario_findings,
         "flow_findings": flow_findings,
+        "race_findings": race_findings,
+        "race_runtime_s": race_runtime_s,
         "stage_latency": stage_latency,
         "trace_overhead_pct": round(trace_overhead_pct, 2),
         # bucket-padding waste of the headline pipeline (ISSUE 11): the

@@ -1,0 +1,38 @@
+"""ALZ050 clean twin: the same two-role write topology, made legal the
+two sanctioned ways — one lock at every access site (with the
+``# guarded-by`` annotation ALZ052 would otherwise demand), and a
+``# lockless-ok`` single-store flag with its justification."""
+
+import threading
+
+
+def compute() -> int:
+    return 1
+
+
+class Worker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: self._lock
+        self.last_seen = 0  # lockless-ok: single GIL-atomic int store per side; readers are freshness gauges
+        self._thread = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._worker_loop)
+        self._thread.start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.total = compute()
+        self.last_seen = compute()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.total = 0
+
+
+def main() -> None:
+    w = Worker()
+    w.start()
+    w.reset()
+    w.last_seen = 0

@@ -1,0 +1,29 @@
+"""ALZ050 flagged fixture: a field shared between a worker thread and
+the main entry surface, written on both sides with no lock anywhere —
+the exact shape of the interner-counter and ingest-thread-list races
+PR 2 fixed by hand (commit 5b37e74's history notes them)."""
+
+import threading
+
+
+def compute() -> int:
+    return 1
+
+
+class Worker:
+    def __init__(self) -> None:
+        self.total = 0
+        self._thread = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._worker_loop)
+        self._thread.start()
+
+    def _worker_loop(self) -> None:
+        self.total = compute()  # alz-expect: ALZ050
+
+
+def main() -> None:
+    w = Worker()
+    w.start()
+    w.total = 0  # alz-expect: ALZ050

@@ -1,0 +1,34 @@
+"""ALZ051 clean twin: the same compounds made atomic — every
+read-modify-write (aug-assign and dict check-then-act) runs inside the
+one lock both roles share, and the declarations carry the
+``# guarded-by`` annotation so ALZ010 enforces the discipline per-file
+from here on."""
+
+import threading
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: self._lock
+        self.cache: dict = {}  # guarded-by: self._lock
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.hits += 1
+            if "k" not in self.cache:
+                self.cache["k"] = 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self.hits = 0
+            self.cache.clear()
+
+
+def main() -> None:
+    c = Counter()
+    c.start()
+    c.reset()

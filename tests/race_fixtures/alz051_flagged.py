@@ -1,0 +1,33 @@
+"""ALZ051 flagged fixture: compound read-modify-writes on multi-role
+fields outside any common lock — the aug-assign lost update (two
+``+=`` land, one increment survives) and the dict check-then-act
+(both threads see "missing", both insert, one insert vanishes)."""
+
+import threading
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.cache: dict = {}
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        self.hits += 1  # alz-expect: ALZ051
+        if "k" not in self.cache:
+            self.cache["k"] = 1  # alz-expect: ALZ051
+
+    def reset(self) -> None:
+        with self._lock:
+            self.hits = 0
+
+
+def main() -> None:
+    c = Counter()
+    c.start()
+    c.reset()
+    if "k" in c.cache:
+        del c.cache["k"]

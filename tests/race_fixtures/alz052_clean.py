@@ -1,0 +1,30 @@
+"""ALZ052 clean twin: the identical consistently-locked topology WITH
+its ``# guarded-by`` annotation — the whole-program pass hands coverage
+to the per-file ALZ010 checker and stays silent."""
+
+import threading
+
+
+class Buffer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.pending = 0  # guarded-by: self._lock
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.pending += 1
+
+    def drain(self) -> int:
+        with self._lock:
+            n = self.pending
+            self.pending = 0
+            return n
+
+
+def main() -> None:
+    b = Buffer()
+    b.start()
+    b.drain()

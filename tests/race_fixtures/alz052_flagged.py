@@ -1,0 +1,31 @@
+"""ALZ052 flagged fixture: a shared field that every access site
+already guards with the same lock — the synchronization is right, the
+ANNOTATION is missing, so the fast per-file ALZ010 checker cannot see a
+future off-lock access. The finding anchors at the declaration."""
+
+import threading
+
+
+class Buffer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.pending = 0  # alz-expect: ALZ052
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.pending += 1
+
+    def drain(self) -> int:
+        with self._lock:
+            n = self.pending
+            self.pending = 0
+            return n
+
+
+def main() -> None:
+    b = Buffer()
+    b.start()
+    b.drain()

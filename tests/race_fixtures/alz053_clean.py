@@ -1,0 +1,43 @@
+"""ALZ053 clean twin: sanctions that hold — a justified single-store
+int flag, a container whose mutations all hold the lock (lockless reads
+of a locked-write dict are the one blessed container shape), a float
+that is only STORED (never compounded) under its sanction, and a
+justified class-level ``# role-private`` confinement claim."""
+
+import threading
+
+
+class Gauges:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.ticks = 0  # lockless-ok: single GIL-atomic int store per side; readers are gauges
+        self.series: dict = {}  # lockless-ok: reads are single dict lookups; every structural mutation holds self._lock
+        self.ewma = 0.0  # lockless-ok: single float STORE per update (no compound); racy read is a gauge
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        self.ticks = 1
+        with self._lock:
+            self.series["w"] = 1
+        self.ewma = 0.5
+
+    def peek(self) -> int:
+        return self.series.get("w", 0)
+
+
+class ScratchPad:  # role-private: one pad per worker thread, handed out by the pool and never shared across workers
+    def __init__(self) -> None:
+        self.rows = 0
+
+    def note_worker(self) -> None:
+        self.rows += 1
+
+
+def main() -> None:
+    g = Gauges()
+    g.start()
+    g.ticks = 0
+    g.peek()
+    ScratchPad().note_worker()

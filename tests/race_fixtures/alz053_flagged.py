@@ -1,0 +1,28 @@
+"""ALZ053 flagged fixture: lockless-ok claims that do not hold — a
+bare annotation with no justification, a container whose structural
+mutation runs unlocked under the sanction (resize/rehash is not
+GIL-atomic), and a float compound (``+=`` loses updates even under the
+GIL). The audit anchors at the annotation it refutes."""
+
+import threading
+
+
+class Gauges:
+    def __init__(self) -> None:
+        self.ticks = 0  # lockless-ok  # alz-expect: ALZ053
+        self.series: dict = {}  # lockless-ok: per-key writers never collide  # alz-expect: ALZ053
+        self.ewma = 0.0  # lockless-ok: readers tolerate staleness  # alz-expect: ALZ053
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        self.ticks = 1
+        self.series["w"] = 1
+        self.ewma += 0.5
+
+
+def main() -> None:
+    g = Gauges()
+    g.start()
+    g.ticks = 0

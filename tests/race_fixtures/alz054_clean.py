@@ -1,0 +1,31 @@
+"""ALZ054 clean fixture: a small pinned topology — one worker role,
+one entry role, one shared class. ``alz054_golden.json`` beside this
+file is generated FROM this module (the test asserts byte-fixpoint), so
+checking this module against it reports no drift."""
+
+import threading
+
+
+class Shared:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: self._lock
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.total += 1
+
+    def drain(self) -> int:
+        with self._lock:
+            n = self.total
+            self.total = 0
+            return n
+
+
+def main() -> None:
+    s = Shared()
+    s.start()
+    s.drain()

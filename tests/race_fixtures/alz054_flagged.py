@@ -1,0 +1,57 @@
+"""ALZ054 flagged fixture: the ``alz054_clean`` topology after a
+drive-by growth spurt — a NEW thread role (the flusher) and a NEW
+shared class — checked against the golden map generated from the clean
+twin. Both growths are drift findings anchored at the golden file: the
+map forces them into review instead of letting the race surface grow
+silently."""
+
+import threading
+
+
+class Shared:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: self._lock
+
+    def start(self) -> None:
+        threading.Thread(target=self._worker_loop).start()
+        threading.Thread(target=self._flusher_loop).start()
+
+    def _worker_loop(self) -> None:
+        with self._lock:
+            self.total += 1
+
+    def _flusher_loop(self) -> None:
+        with self._lock:
+            self.total = 0
+
+    def drain(self) -> int:
+        with self._lock:
+            n = self.total
+            self.total = 0
+            return n
+
+
+class Sidecar:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.beats = 0  # guarded-by: self._lock
+
+    def start(self) -> None:
+        threading.Thread(target=self._pulse_loop).start()
+
+    def _pulse_loop(self) -> None:
+        self.beat()
+
+    def beat(self) -> None:
+        with self._lock:
+            self.beats += 1
+
+
+def main() -> None:
+    s = Shared()
+    s.start()
+    s.drain()
+    side = Sidecar()
+    side.start()
+    side.beat()

@@ -180,10 +180,15 @@ class TestSpecRegeneration:
         """`make specs` must be a fixpoint on a clean tree — any diff a
         regen produces IS a contract change that needs review."""
         out = specfiles.write_specs(tmp_path / "specs")
-        # metrics.json sits beside the spec set but is alazflow's golden
-        # (`--write-metrics` owns it), so the spec regen doesn't emit it
+        # metrics.json / threads.json sit beside the spec set but are
+        # alazflow's / alazrace's goldens (`--write-metrics` /
+        # `--write-threads` own them), so the spec regen doesn't emit them
         assert len(out) == len(
-            [p for p in SPECS.glob("*.json") if p.name != "metrics.json"]
+            [
+                p
+                for p in SPECS.glob("*.json")
+                if p.name not in ("metrics.json", "threads.json")
+            ]
         )
         for fresh in out:
             golden = SPECS / fresh.name

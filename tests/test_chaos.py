@@ -523,7 +523,10 @@ class TestCircuitBreaker:
             t[0] += 0.1
         assert be.breaker.state == "open"
         assert calls[0] == 2  # threshold sends hit the wire, rest shorted
-        assert be.stats()["requests"]["failed"] == 5
+        # ISSUE 12 satellite: breaker sheds no longer hide in `failed` —
+        # wire failures and open-circuit sheds are separate fates
+        assert be.stats()["requests"]["failed"] == 2
+        assert be.stats()["requests"]["shed"] == 3
         assert be.breaker.shorted >= 3
 
 

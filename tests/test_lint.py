@@ -265,6 +265,7 @@ class TestSelfEnforcement:
                 str(REPO / "tools" / "alazlint"),
                 str(REPO / "tools" / "alazspec"),
                 str(REPO / "tools" / "alazflow"),
+                str(REPO / "tools" / "alazrace"),
             ]
         )
         assert findings == [], "\n".join(f.render() for f in findings)

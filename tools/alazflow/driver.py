@@ -14,8 +14,9 @@ from typing import List, Optional, Sequence
 from tools.alazlint.core import (
     FileContext,
     Finding,
-    iter_py_files,
+    filter_disables,
     parse_context,
+    parse_files,
 )
 from tools.alazflow import blockrules, droprules, vocabrules
 from tools.alazflow.flowmodel import FlowModel
@@ -29,24 +30,7 @@ DEFAULT_PATHS = (
     str(REPO / "tools" / "alazflow"),
 )
 
-
-def _parse(paths: Sequence[str]):
-    ctxs: List[FileContext] = []
-    findings: List[Finding] = []
-    for f in iter_py_files(paths):
-        try:
-            source = f.read_text()
-        except (UnicodeDecodeError, OSError) as exc:
-            findings.append(
-                Finding("ALZ900", f"file is not readable: {exc}", str(f), 1, 0)
-            )
-            continue
-        ctx = parse_context(str(f), source)
-        if isinstance(ctx, Finding):
-            findings.append(ctx)
-            continue
-        ctxs.append(ctx)
-    return ctxs, findings
+_parse = parse_files  # the shared driver front end (tools.alazlint.core)
 
 
 def _run_rules(
@@ -65,15 +49,7 @@ def _run_rules(
     raw.extend(blockrules.check_alz042(ctxs, model=model))
     raw.extend(droprules.check_alz043(ctxs, model=model))
     raw.extend(vocabrules.check_alz044(ctxs, completeness=tree_mode))
-    by_path = {ctx.path: ctx for ctx in ctxs}
-    out: List[Finding] = []
-    for f in raw:
-        ctx = by_path.get(f.path)
-        if ctx is not None and f.code in ctx.disables.get(f.line, set()):
-            continue
-        out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
-    return out
+    return filter_disables(raw, ctxs)
 
 
 def flow_paths(paths: Sequence[str], tree_mode: bool = False) -> List[Finding]:

@@ -44,6 +44,10 @@ ROW_PLANE_PREFIXES = (
     "alaz_tpu.utils.ledger",
     "alaz_tpu.graph.builder",
     "alaz_tpu.runtime.service",
+    # the export leg joined the ledger in ISSUE 12 (breaker sheds
+    # attribute as the closed `shed` cause), so its drops are in scope
+    # for ALZ040/043 like every other row holder's
+    "alaz_tpu.datastore.backend",
 )
 
 # names that mark a value as row-bearing when they appear as parameters

@@ -210,6 +210,47 @@ def callee(call: ast.Call) -> "Tuple[Optional[str], Optional[str]]":
     return None, None
 
 
+def parse_files(
+    paths: Iterable[str],
+) -> "Tuple[List[FileContext], List[Finding]]":
+    """Parse every .py under ``paths`` into FileContexts; unreadable or
+    unparsable files become ALZ900 findings instead of aborting the run.
+    Shared by the whole-program driver heads (alazflow, alazrace)."""
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        try:
+            source = f.read_text()
+        except (UnicodeDecodeError, OSError) as exc:
+            findings.append(
+                Finding("ALZ900", f"file is not readable: {exc}", str(f), 1, 0)
+            )
+            continue
+        ctx = parse_context(str(f), source)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        ctxs.append(ctx)
+    return ctxs, findings
+
+
+def filter_disables(
+    findings: Iterable[Finding], ctxs: Iterable[FileContext]
+) -> List[Finding]:
+    """Drop findings a ``# alazlint: disable=`` comment suppresses and
+    return the survivors in the canonical (path, line, col, code) order
+    — the shared epilogue of every whole-program driver head."""
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    out: List[Finding] = []
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and f.code in ctx.disables.get(f.line, set()):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
 def iter_py_files(paths: Iterable[str]) -> Iterable[Path]:
     for p in paths:
         path = Path(p)

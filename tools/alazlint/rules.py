@@ -170,6 +170,43 @@ _ALL = [
         "(resources/specs/metrics.json; --write-metrics regenerates)",
         lambda ctx: (),  # emitted by tools.alazflow.vocabrules
     ),
+    # -- alazrace family (tools/alazrace): whole-program thread-escape +
+    # lockset race detection. Emitted by the alazrace driver (`python -m
+    # tools.alazrace`, `make race`) — the passes need thread-role
+    # discovery and call-graph lockset fixpoints over the full project
+    # model, plus the golden concurrency map — and registered here so
+    # codes stay append-only, `--list-rules` shows the whole catalog,
+    # and disable comments parse uniformly.
+    Rule(
+        "ALZ050",
+        "unsynchronized shared write: a multi-role-reachable field "
+        "written with no lock common to its access sites",
+        lambda ctx: (),  # emitted by tools.alazrace.racerules
+    ),
+    Rule(
+        "ALZ051",
+        "compound read-modify-write (aug-assign / check-then-act) on a "
+        "multi-role field outside any common lock",
+        lambda ctx: (),  # emitted by tools.alazrace.racerules
+    ),
+    Rule(
+        "ALZ052",
+        "shared field consistently guarded by one lock but missing its "
+        "# guarded-by annotation (ALZ010 coverage closure)",
+        lambda ctx: (),  # emitted by tools.alazrace.racerules
+    ),
+    Rule(
+        "ALZ053",
+        "# lockless-ok / # role-private audit: missing justification, "
+        "or a sanction covering a non-GIL-atomic access shape",
+        lambda ctx: (),  # emitted by tools.alazrace.racerules
+    ),
+    Rule(
+        "ALZ054",
+        "thread topology drifted from the golden concurrency map "
+        "(resources/specs/threads.json; --write-threads regenerates)",
+        lambda ctx: (),  # emitted by tools.alazrace.goldenmap
+    ),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -1,0 +1,28 @@
+"""alazrace — whole-program thread-escape + lockset race detector
+(ISSUE 12), the fifth tier-1-enforced analysis head.
+
+Rules (registered append-only in ``tools.alazlint.rules``):
+
+- ALZ050 — unsynchronized shared write (multi-role field, no common lock)
+- ALZ051 — compound read-modify-write outside any common lock
+- ALZ052 — consistently-locked shared field missing ``# guarded-by``
+- ALZ053 — ``# lockless-ok`` audit (missing why / non-GIL-atomic type)
+- ALZ054 — thread-topology drift vs the golden concurrency map
+  (``resources/specs/threads.json``; ``--write-threads`` regenerates)
+
+Run: ``python -m tools.alazrace [--json] [--write-threads] [paths...]``
+(``make race``).
+"""
+
+from tools.alazrace.driver import (  # noqa: F401
+    DEFAULT_PATHS,
+    main,
+    race_paths,
+    race_source,
+)
+from tools.alazrace.goldenmap import (  # noqa: F401
+    THREADS_GOLDEN,
+    compute_topology,
+    write_threads_golden,
+)
+from tools.alazrace.racemodel import RaceModel  # noqa: F401

@@ -1,0 +1,102 @@
+"""alazrace driver: parse → whole-program race rules → suppression →
+report. Mirrors the alazflow driver contract (same Finding type, same
+``# alazlint: disable=ALZ05x -- why`` escape hatch, same exit codes) so
+`make race` and tier-1 read one uniform finding stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.alazlint.core import (
+    FileContext,
+    Finding,
+    filter_disables,
+    parse_context,
+    parse_files,
+)
+from tools.alazrace import goldenmap, racerules
+from tools.alazrace.racemodel import RaceModel
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# what `make race` / bench's race_findings sweep: the host plane plus
+# the analyzer itself (self-enforcement, the alazlint precedent)
+DEFAULT_PATHS = (
+    str(REPO / "alaz_tpu"),
+    str(REPO / "tools" / "alazrace"),
+)
+
+_parse = parse_files  # the shared driver front end (tools.alazlint.core)
+
+
+def _run_rules(ctxs: List[FileContext], tree_mode: bool) -> List[Finding]:
+    """The four passes over ONE shared race model (role discovery + the
+    lockset fixpoints are the expensive part of a run). ``tree_mode``
+    arms the golden-map drift check (ALZ054), which only makes sense
+    over the full tree — fixture/single-file runs skip it so a fixture
+    pair proves exactly its own rule."""
+    model = RaceModel(ctxs)
+    reports = racerules.field_reports(model)
+    raw: List[Finding] = []
+    raw.extend(racerules.check_alz050_051(ctxs, model=model, reports=reports))
+    raw.extend(racerules.check_alz052(ctxs, model=model, reports=reports))
+    raw.extend(racerules.check_alz053(ctxs, model=model))
+    if tree_mode:
+        raw.extend(goldenmap.check_alz054(ctxs, model=model, reports=reports))
+    return filter_disables(raw, ctxs)
+
+
+def race_paths(paths: Sequence[str], tree_mode: bool = False) -> List[Finding]:
+    ctxs, findings = _parse(paths)
+    findings.extend(_run_rules(ctxs, tree_mode))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def race_source(path: str, source: str) -> List[Finding]:
+    """Analyze one file's source (fixture tests); the whole-program
+    rules run scoped to this single file, golden-map drift off."""
+    ctx = parse_context(path, source)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    return _run_rules([ctx], tree_mode=False)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--write-threads" in argv:
+        argv = [a for a in argv if a != "--write-threads"]
+        # regen MUST parse the same tree the drift check scans, or an
+        # ALZ054 finding in the analyzer's own package could prescribe
+        # a regen command that cannot clear it
+        ctxs, _ = _parse(argv or list(DEFAULT_PATHS))
+        path = goldenmap.write_threads_golden(RaceModel(ctxs))
+        print(f"wrote {path}")
+        return 0
+    # the golden-map drift check is a statement about the WHOLE tree —
+    # it runs on the default invocation (`make race`); explicit paths
+    # get the lockset rules only, so scanning a fixture doesn't
+    # re-litigate the tree-global golden
+    paths = argv or list(DEFAULT_PATHS)
+    findings = race_paths(paths, tree_mode=not argv)
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"alazrace: {len(findings)} finding(s)")
+    return 1 if findings else 0

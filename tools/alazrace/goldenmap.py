@@ -1,0 +1,193 @@
+"""The golden concurrency map: ``resources/specs/threads.json`` and
+ALZ054 (topology drift).
+
+The map pins what the race pass DISCOVERED — the role × shared-class ×
+guarding-lock topology — the same way alazspec's specfiles pin shapes
+and alazflow's ``metrics.json`` pins the metric namespace: regenerated
+deterministically (``make specs`` / ``python -m tools.alazrace
+--write-threads``), committed, byte-fixpoint under regen. The payoff is
+review-anchored topology change: a new thread root, a class newly
+escaping to a second role, or a field whose guard moved shows up as a
+one-line JSON diff in the PR that caused it — not as a silent growth of
+the race surface discovered three PRs later. ALZ054 flags any live
+topology that disagrees with the committed map.
+
+Map shape (all keys sorted — the byte-fixpoint contract):
+
+    {
+      "roles":  {"<root qualname>": {"kind": "...", "roots": [...]}},
+      "shared": {"<class qualname>": {
+          "roles": ["..."],
+          "fields": {"<field>": {"guard": "<lock>|null",
+                                  "policy": "guarded-by|lockless-ok|
+                                             locked|unlocked"}}}}
+    }
+
+Read-only shared classes (≥2 roles, zero writes) appear with their
+fields marked by policy — they are one write away from being a race,
+and the map is where that write becomes visible.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.alazlint.core import FileContext, Finding
+from tools.alazrace.racemodel import RaceModel
+from tools.alazrace.racerules import FieldReport, field_reports
+
+REPO = Path(__file__).resolve().parent.parent.parent
+THREADS_GOLDEN = REPO / "resources" / "specs" / "threads.json"
+
+
+def _field_entry(model: RaceModel, rep: FieldReport) -> dict:
+    decl = rep.decl
+    if decl.guarded_by is not None:
+        return {"guard": f"self.{decl.guarded_by}", "policy": "guarded-by"}
+    if model.lockless_sanction(decl) is not None:
+        return {"guard": None, "policy": "lockless-ok"}
+    if model.role_private_sanction(decl.cls_qn) is not None:
+        return {"guard": None, "policy": "role-private"}
+    own = rep.own_lock_candidates()
+    if len(own) == 1 and rep.common:
+        return {"guard": f"self.{own[0].rsplit('.', 1)[-1]}", "policy": "locked"}
+    if rep.common:
+        # guarded, but by a caller-side or foreign lock — name it
+        return {
+            "guard": sorted(rep.common)[0].split(":", 1)[-1],
+            "policy": "locked",
+        }
+    return {"guard": None, "policy": "unlocked"}
+
+
+def compute_topology(
+    model: RaceModel,
+    reports: Optional[Dict[Tuple[str, str], FieldReport]] = None,
+) -> dict:
+    reports = reports if reports is not None else field_reports(model)
+    roles = {
+        name: {"kind": role.kind, "roots": sorted(role.roots)}
+        for name, role in model.roles.items()
+    }
+    shared: Dict[str, dict] = {}
+    for (cls_qn, fname), rep in reports.items():
+        if not rep.multi_role:
+            continue
+        entry = shared.setdefault(cls_qn, {"roles": set(), "fields": {}})
+        entry["roles"] |= rep.roles
+        entry["fields"][fname] = _field_entry(model, rep)
+    return {
+        "roles": dict(sorted(roles.items())),
+        "shared": {
+            cls: {
+                "roles": sorted(e["roles"]),
+                "fields": dict(sorted(e["fields"].items())),
+            }
+            for cls, e in sorted(shared.items())
+        },
+    }
+
+
+def render(topology: dict) -> str:
+    return json.dumps(topology, indent=2, sort_keys=True) + "\n"
+
+
+def write_threads_golden(
+    model: RaceModel, path: Path = THREADS_GOLDEN
+) -> Path:
+    path.write_text(render(compute_topology(model)))
+    return path
+
+
+def check_alz054(
+    ctxs: Sequence[FileContext],
+    model: Optional[RaceModel] = None,
+    reports: Optional[Dict[Tuple[str, str], FieldReport]] = None,
+    golden_path: Path = THREADS_GOLDEN,
+) -> Iterable[Finding]:
+    model = model if model is not None else RaceModel(ctxs)
+    live = compute_topology(model, reports)
+    out: List[Finding] = []
+    try:
+        golden = json.loads(golden_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        out.append(
+            Finding(
+                "ALZ054",
+                f"golden concurrency map {golden_path.name} missing or "
+                "unreadable — regenerate with `python -m tools.alazrace "
+                "--write-threads` (or `make specs`) and commit",
+                str(golden_path),
+                1,
+                0,
+            )
+        )
+        return out
+    for kind, live_side, gold_side in (
+        ("thread role", live["roles"], golden.get("roles", {})),
+        ("shared class", live["shared"], golden.get("shared", {})),
+    ):
+        for name in sorted(set(live_side) - set(gold_side)):
+            out.append(
+                Finding(
+                    "ALZ054",
+                    f"new {kind} `{name}` is not in the golden concurrency "
+                    f"map ({golden_path.name}) — the thread topology grew; "
+                    "regenerate with --write-threads and REVIEW the diff "
+                    "(a new role or newly-escaping class is a deliberate "
+                    "design event, not a drive-by)",
+                    str(golden_path),
+                    1,
+                    0,
+                )
+            )
+        for name in sorted(set(gold_side) - set(live_side)):
+            out.append(
+                Finding(
+                    "ALZ054",
+                    f"golden {kind} `{name}` no longer exists in the tree "
+                    "— the committed topology is stale; regenerate with "
+                    "--write-threads and review what retired it",
+                    str(golden_path),
+                    1,
+                    0,
+                )
+            )
+    for cls, gold_entry in sorted(golden.get("shared", {}).items()):
+        live_entry = live["shared"].get(cls)
+        if live_entry is None:
+            continue  # already reported above
+        if sorted(gold_entry.get("roles", [])) != live_entry["roles"]:
+            out.append(
+                Finding(
+                    "ALZ054",
+                    f"role set of shared class `{cls}` drifted: golden "
+                    f"{gold_entry.get('roles', [])} vs live "
+                    f"{live_entry['roles']} — regenerate with "
+                    "--write-threads and review the new reachability",
+                    str(golden_path),
+                    1,
+                    0,
+                )
+            )
+        gold_fields = gold_entry.get("fields", {})
+        for fname in sorted(set(gold_fields) | set(live_entry["fields"])):
+            g = gold_fields.get(fname)
+            l = live_entry["fields"].get(fname)
+            if g != l:
+                out.append(
+                    Finding(
+                        "ALZ054",
+                        f"guard topology of `{cls}.{fname}` drifted: "
+                        f"golden {g} vs live {l} — a field's guard moving "
+                        "(or appearing/vanishing) is a synchronization "
+                        "design change; regenerate with --write-threads "
+                        "and review",
+                        str(golden_path),
+                        1,
+                        0,
+                    )
+                )
+    return out

@@ -1,0 +1,826 @@
+"""The race model — thread-role discovery + field-access lockset facts
+over the shared project model (ISSUE 12).
+
+alazrace is the fifth analysis head and deliberately a THIN layer: it
+reuses ``tools.alazlint.program.ProgramModel`` (function index, import
+maps, ``self.x = Cls(...)`` attr typing, ctor-arg resolution) through
+``tools.alazflow.flowmodel.FlowModel`` (element-type queue typing,
+entry-surface closure) and layers on exactly what the ALZ050-054 rules
+need:
+
+- **thread roles** — every distinct start-of-thread the program can
+  reach: resolvable ``threading.Thread(target=...)`` / ``Timer(...,
+  fn)`` / ``executor.submit(fn)`` targets, the worker-loop naming
+  convention ALZ030 already codified (``*_loop`` / ``*_worker`` /
+  ``*_main`` / ``_consume``), HTTP-handler methods (``do_GET`` runs on
+  the serving thread), and the serve/CLI entry surface folded into ONE
+  ``main`` role. Each role closes over the call graph, so
+  ``roles_of(fn)`` answers "which threads can be executing this line".
+
+- **field escape** — per class, every field access site the model can
+  attribute: ``self.f`` in the class's own methods (nested ``def run()``
+  closures inherit the enclosing method's class — the daemon-thread
+  idiom), ``self.attr.f`` through attr typing (the cross-module escape:
+  an object constructed in module A, stored by B's constructor, mutated
+  from B's worker), and ``local.f`` through local/element typing
+  (``stream = self._streams[name]`` where ``_streams`` is a dict of
+  ``_Stream(...)``). A class whose sites span ≥2 roles is
+  multi-role-reachable — the race candidate surface.
+
+- **locksets** — for every access site, the set of locks HELD there:
+  the ``with`` nesting inside the function plus the locks every caller
+  provably holds at every resolvable call site (an intersection-over-
+  callers fixpoint seeded empty at role roots — the sound "what is
+  ALWAYS held on entry" answer, closed over ALZ014's call summaries).
+
+Known precision bounds (ARCHITECTURE §3o): roles are per-CLASS, not
+per-instance — N workers sharing one role still race each other, which
+is correct, but two pipelines owning PRIVATE instances of one class
+merge into one role set, which over-approximates; the sanctioned
+``# lockless-ok: <why>`` annotation (field- or class-level, audited by
+ALZ053) is the designed pressure valve, exactly like ALZ010's justified
+disables. Mutating METHOD calls (``self.d.update(...)``) are not writes
+in v1 — subscript stores and aug-assigns are.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext, callee as _callee
+from tools.alazlint.program import (
+    FunctionInfo,
+    ProgramModel,
+    _lock_id_for,
+    _self_attr,
+)
+from tools.alazflow.flowmodel import walk_shallow
+
+# the worker-thread naming convention ALZ030 codified, plus the
+# HTTP-handler surface (BaseHTTPRequestHandler dispatches do_* on the
+# serving thread) — roots even when the Thread() target is dynamic
+WORKER_NAME_RE = re.compile(r"(_loop|_worker|_main)$|^_consume$|^do_[A-Z]+$")
+
+# the process entry surface: every cmd_*/main/serve runs on the ONE main
+# thread, so they fold into a single role instead of N phantom threads
+ENTRY_NAME_RE = re.compile(r"^(cmd_|main$|serve$)")
+
+MAIN_ROLE = "main"
+
+# ``# lockless-ok: <why>`` — the sanctioned intentionally-unsynchronized
+# marker ALZ050/051 honor and ALZ053 audits. Field-level on the
+# declaration statement, or class-level on the ``class X:`` line.
+_LOCKLESS_RE = re.compile(r"#\s*lockless-ok(?::\s*(?P<why>\S.*))?")
+
+# ``# role-private: <why>`` — class-level claim that INSTANCES of this
+# class are confined to one thread at a time (the per-shard Aggregator
+# pattern: the serial pipeline's instance and each shard worker's
+# instance are distinct objects, so the class-level role union is not a
+# race). Honored by ALZ050/051/052, audited by ALZ053, and recorded in
+# the golden map so the claim is reviewable topology, not a mute button.
+_ROLE_PRIVATE_RE = re.compile(r"#\s*role-private(?::\s*(?P<why>\S.*))?")
+
+_MUTATING_SUBSCRIPT_WRITE = "container-write"
+
+
+@dataclass(frozen=True)
+class Role:
+    name: str  # root qualname, or "main" for the folded entry surface
+    kind: str  # thread | timer | executor | convention | entry
+    roots: Tuple[str, ...]  # root function qualnames
+
+
+@dataclass
+class FieldDecl:
+    cls_qn: str
+    name: str
+    line: int  # declaration anchor (first assignment / AnnAssign)
+    ctx: FileContext
+    value_kind: str = "other"  # int | float | container | other
+    guarded_by: Optional[str] = None  # annotated lock attr (canonical)
+    lockless_why: Optional[str] = None  # field-level annotation text
+    lockless_line: Optional[int] = None
+
+
+@dataclass
+class Access:
+    cls_qn: str
+    fieldname: str
+    fn_qn: str
+    ctx: FileContext
+    line: int
+    col: int
+    write: bool
+    rmw: bool  # aug-assign / check-then-act compound
+    held: frozenset  # locks held at the site WITHIN the function
+    in_init: bool  # inside the declaring class's __init__
+
+
+class RaceModel:
+    """Roles + field accesses + locksets over one invocation's files."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.model = ProgramModel(ctxs)
+        self.ctxs = list(ctxs)
+        self._fn_of_node: Dict[int, str] = {
+            id(info.node): qn for qn, info in self.model.functions.items()
+        }
+        # effective class for nested defs: a ``def run()`` inside a
+        # method sees the method's ``self`` — attribute it to the class
+        self._eff_cls: Dict[str, Optional[ast.ClassDef]] = {}
+        for qn, info in self.model.functions.items():
+            self._eff_cls[qn] = self._effective_class(info)
+        self._elem_types: Dict[str, Dict[str, str]] = {}
+        self._infer_element_types()
+        self._extend_attr_types()
+        self.fields: Dict[Tuple[str, str], FieldDecl] = {}
+        self.class_lockless: Dict[str, Tuple[Optional[str], int]] = {}
+        self.class_role_private: Dict[str, Tuple[Optional[str], int]] = {}
+        self._lockless_lines: Dict[str, Dict[int, Optional[str]]] = {}
+        self._role_private_lines: Dict[str, Dict[int, Optional[str]]] = {}
+        for ctx in self.ctxs:
+            self._lockless_lines[ctx.path] = _scan_marker(ctx, _LOCKLESS_RE)
+            self._role_private_lines[ctx.path] = _scan_marker(
+                ctx, _ROLE_PRIVATE_RE
+            )
+        self._collect_fields()
+        self.roles: Dict[str, Role] = {}
+        self._discover_roles()
+        self.calls: Dict[str, List[Tuple[frozenset, str]]] = {}
+        self.accesses: List[Access] = []
+        for qn, info in self.model.functions.items():
+            self._summarize(qn, info)
+        self.role_members: Dict[str, Set[str]] = {
+            name: self._closure(role.roots) for name, role in self.roles.items()
+        }
+        self._roles_of: Dict[str, Set[str]] = {}
+        for name, members in self.role_members.items():
+            for qn in members:
+                self._roles_of.setdefault(qn, set()).add(name)
+        self.entry_locks = self._entry_lock_fixpoint()
+
+    # -- class / field tables ------------------------------------------------
+
+    def _effective_class(self, info: FunctionInfo) -> Optional[ast.ClassDef]:
+        if info.cls is not None:
+            return info.cls
+        for anc in info.ctx.ancestors(info.node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+            if isinstance(anc, ast.Module):
+                break
+        return None
+
+    def _infer_element_types(self) -> None:
+        """attr -> element class for container attrs: ``self._streams =
+        {k: _Stream(...)}`` / ``[Cls(...) for ...]`` / ``[Cls(...)]`` —
+        the alazflow queue-element idea generalized to any project
+        class, so ``stream.sent`` on a dict-valued local resolves."""
+        for cqn, cinfo in self.model.classes.items():
+            mod = self.model.module_of[id(cinfo.ctx)]
+            out: Dict[str, str] = {}
+            for node in ast.walk(cinfo.node):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets, v = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, v = [node.target], node.value
+                else:
+                    continue
+                elems: List[ast.AST] = []
+                if isinstance(v, ast.Dict):
+                    elems = list(v.values)
+                elif isinstance(v, ast.List):
+                    elems = v.elts
+                elif isinstance(v, (ast.ListComp, ast.SetComp)):
+                    elems = [v.elt]
+                elif isinstance(v, ast.DictComp):
+                    elems = [v.value]
+                if not elems:
+                    continue
+                classes = set()
+                for e in elems:
+                    if isinstance(e, ast.Call):
+                        t = self.model.resolve_class(mod, e.func)
+                        if t is not None:
+                            classes.add(t)
+                if len(classes) != 1:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        out[attr] = classes.pop()
+                        break
+            if out:
+                self._elem_types[cqn] = out
+
+    def _extend_attr_types(self) -> None:
+        """Attr typing the base model can't see, run to a fixpoint
+        (types only grow):
+
+        - ``self.x = interner or Interner()`` / ``a if c else b`` —
+          branch-resolving through BoolOp/IfExp when exactly one project
+          class is nameable;
+        - constructor args that are NAMES — a local previously assigned
+          ``Cls(...)`` in the calling function, or a typed ``self.attr``
+          of the calling class — flow their type into the callee's
+          ``self.<attr> = <param>`` stores. This is what lets the
+          per-process singletons (Interner, Metrics, recorder/ledger
+          planes) that are constructed at wiring time and THREADED
+          through constructors join the escape closure.
+        """
+
+        def branch_type(mod: str, value: ast.AST) -> Optional[str]:
+            if isinstance(value, ast.Call):
+                return self.model.resolve_class(mod, value.func)
+            kinds: Set[str] = set()
+            branches: List[ast.AST] = []
+            if isinstance(value, ast.BoolOp):
+                branches = value.values
+            elif isinstance(value, ast.IfExp):
+                branches = [value.body, value.orelse]
+            for b in branches:
+                t = branch_type(mod, b)
+                if t is not None:
+                    kinds.add(t)
+            return kinds.pop() if len(kinds) == 1 else None
+
+        # pass 0: BoolOp/IfExp direct assignments
+        for cqn, cinfo in self.model.classes.items():
+            mod = self.model.module_of[id(cinfo.ctx)]
+            for node in ast.walk(cinfo.node):
+                if not isinstance(node, ast.Assign) or isinstance(
+                    node.value, ast.Call
+                ):
+                    continue
+                t = branch_type(mod, node.value)
+                if t is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr not in cinfo.attr_types:
+                        cinfo.attr_types[attr] = t
+
+        # fixpoint: ctor-arg Name/self.attr typing (each round can
+        # unlock the next hop of an interner-style threading chain)
+        for _ in range(6):
+            changed = False
+            for ctx in self.ctxs:
+                mod = self.model.module_of[id(ctx)]
+                for node in ast.walk(ctx.tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target_cls = self.model.resolve_class(mod, node.func)
+                    if target_cls is None:
+                        continue
+                    tinfo = self.model.classes[target_cls]
+                    if not tinfo.ctor_param_attrs:
+                        continue
+                    encl_qn, encl_cls = self._enclosing(ctx, node)
+                    bound = list(zip(tinfo.ctor_params, node.args))
+                    bound += [
+                        (kw.arg, kw.value) for kw in node.keywords if kw.arg
+                    ]
+                    for pname, arg in bound:
+                        attr = tinfo.ctor_param_attrs.get(pname)
+                        if attr is None or attr in tinfo.attr_types:
+                            continue
+                        t = self._expr_type(ctx, mod, encl_qn, encl_cls, arg)
+                        if t is not None:
+                            tinfo.attr_types[attr] = t
+                            changed = True
+            if not changed:
+                break
+
+    def _expr_type(
+        self,
+        ctx: FileContext,
+        mod: str,
+        encl_qn: Optional[str],
+        encl_cls: Optional[ast.ClassDef],
+        arg: ast.AST,
+    ) -> Optional[str]:
+        """Project class an argument expression evidently carries, in
+        the scope of the function that contains the call site."""
+        if isinstance(arg, ast.Call):
+            return self.model.resolve_class(mod, arg.func)
+        attr = _self_attr(arg)
+        if attr is not None and encl_cls is not None:
+            cinfo = self.model.classes.get(f"{mod}:{encl_cls.name}")
+            if cinfo is not None:
+                return cinfo.attr_types.get(attr)
+            return None
+        if isinstance(arg, ast.Name) and encl_qn is not None:
+            info = self.model.functions.get(encl_qn)
+            if info is None:
+                return None
+            for node in walk_shallow(info.node):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == arg.id
+                    and isinstance(node.value, ast.Call)
+                ):
+                    return self.model.resolve_class(mod, node.value.func)
+        return None
+
+    def _collect_fields(self) -> None:
+        for cqn, cinfo in self.model.classes.items():
+            ctx = cinfo.ctx
+            lockless = self._lockless_lines.get(ctx.path, {})
+            role_private = self._role_private_lines.get(ctx.path, {})
+            # class-level markers: on the class line or a decorator line
+            for ln in range(
+                min(
+                    [cinfo.node.lineno]
+                    + [d.lineno for d in cinfo.node.decorator_list]
+                ),
+                cinfo.node.lineno + 1,
+            ):
+                if ln in lockless and cqn not in self.class_lockless:
+                    self.class_lockless[cqn] = (lockless[ln], ln)
+                if ln in role_private and cqn not in self.class_role_private:
+                    self.class_role_private[cqn] = (role_private[ln], ln)
+            for node in ast.walk(cinfo.node):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                is_ann_cls_level = False
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                    # dataclass-style field declaration: only DIRECT
+                    # class-body children count — an annotated LOCAL in
+                    # a method body (ast.walk visits those too) must not
+                    # become a phantom field that shadows the real
+                    # declaration's annotations (review-caught)
+                    is_ann_cls_level = isinstance(
+                        node.target, ast.Name
+                    ) and node in cinfo.node.body
+                else:
+                    continue
+                for t in targets:
+                    name = None
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        name = attr
+                    elif is_ann_cls_level:
+                        name = t.id  # type: ignore[union-attr]
+                    if name is None:
+                        continue
+                    if attr is not None and cinfo.lock_attrs.get(attr):
+                        continue  # locks/conditions are not data fields
+                    key = (cqn, name)
+                    if key in self.fields:
+                        continue  # first declaration anchors
+                    decl = FieldDecl(cqn, name, node.lineno, ctx)
+                    decl.value_kind = _value_kind(value)
+                    end = getattr(node, "end_lineno", None) or node.lineno
+                    for ln in range(node.lineno, end + 1):
+                        g = ctx.guarded_lines.get(ln)
+                        if g is not None:
+                            decl.guarded_by = g
+                        if ln in lockless:
+                            decl.lockless_why = lockless[ln]
+                            decl.lockless_line = ln
+                    self.fields[key] = decl
+
+    # -- role discovery ------------------------------------------------------
+
+    def _discover_roles(self) -> None:
+        entry_roots: List[str] = []
+        for qn, info in self.model.functions.items():
+            short = qn.split(":", 1)[-1].rsplit(".", 1)[-1]
+            if WORKER_NAME_RE.search(short):
+                self._add_role(qn, "convention")
+            elif ENTRY_NAME_RE.search(short):
+                entry_roots.append(qn)
+        for ctx in self.ctxs:
+            mod = self.model.module_of[id(ctx)]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                _, name = _callee(node)
+                target: Optional[ast.AST] = None
+                kind = None
+                if name == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target, kind = kw.value, "thread"
+                elif name == "Timer":
+                    if len(node.args) > 1:
+                        target, kind = node.args[1], "timer"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"
+                    and node.args
+                ):
+                    target, kind = node.args[0], "executor"
+                if target is None:
+                    continue
+                qn = self._resolve_target(ctx, mod, node, target)
+                if qn is not None:
+                    self._add_role(qn, kind or "thread")
+        if entry_roots:
+            self.roles[MAIN_ROLE] = Role(
+                MAIN_ROLE, "entry", tuple(sorted(entry_roots))
+            )
+
+    def _add_role(self, root_qn: str, kind: str) -> None:
+        name = root_qn
+        prev = self.roles.get(name)
+        if prev is None or prev.kind == "convention":
+            self.roles[name] = Role(name, kind, (root_qn,))
+
+    def _resolve_target(
+        self, ctx: FileContext, mod: str, site: ast.AST, target: ast.AST
+    ) -> Optional[str]:
+        """Function qualname a Thread/Timer/submit callable argument
+        names, resolved in the spawn site's scope."""
+        encl_qn, encl_cls = self._enclosing(ctx, site)
+        attr = _self_attr(target)
+        if attr is not None and encl_cls is not None:
+            cinfo = self.model.classes.get(f"{mod}:{encl_cls.name}")
+            if cinfo is not None:
+                return cinfo.methods.get(attr)
+            return None
+        if isinstance(target, ast.Name):
+            if encl_qn is not None:
+                nested = f"{encl_qn}.{target.id}"
+                if nested in self.model.functions:
+                    return nested
+            direct = f"{mod}:{target.id}"
+            if direct in self.model.functions:
+                return direct
+            imported = self.model.imports.get(mod, {}).get(target.id)
+            if imported and imported in self.model.functions:
+                return imported
+        return None
+
+    def _enclosing(
+        self, ctx: FileContext, node: ast.AST
+    ) -> Tuple[Optional[str], Optional[ast.ClassDef]]:
+        fn_qn = None
+        cls = None
+        for anc in ctx.ancestors(node):
+            if (
+                fn_qn is None
+                and isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ):
+                fn_qn = self._fn_of_node.get(id(anc))
+            if cls is None and isinstance(anc, ast.ClassDef):
+                cls = anc
+        return fn_qn, cls
+
+    # -- per-function summary ------------------------------------------------
+
+    def _summarize(self, qn: str, info: FunctionInfo) -> None:
+        ctx = info.ctx
+        mod = self.model.module_of[id(ctx)]
+        cls = self._eff_cls.get(qn)
+        local_prefix = qn + "."
+        calls: List[Tuple[frozenset, str]] = []
+        local_types = self._local_types(info, mod, cls)
+        in_init = (
+            info.cls is not None and info.node.name == "__init__"  # type: ignore[union-attr]
+        )
+
+        def field_site(
+            cls_qn: str, fname: str, node: ast.AST, write: bool, rmw: bool,
+            held: Tuple[str, ...],
+        ) -> None:
+            if (cls_qn, fname) not in self.fields:
+                return
+            own_init = in_init and info.cls is not None and (
+                f"{mod}:{info.cls.name}" == cls_qn
+            )
+            self.accesses.append(
+                Access(
+                    cls_qn, fname, qn, ctx, node.lineno, node.col_offset,
+                    write, rmw, frozenset(held), own_init,
+                )
+            )
+
+        def receiver_class(base: ast.AST) -> Optional[str]:
+            """Class of the object a field access / method call hangs
+            off: ``self``, a typed local, ``self.<typed attr>``, a typed
+            attr of a typed local (``be.breaker``), or an element of a
+            typed container attr (``self._streams[k]``)."""
+            if isinstance(base, ast.Subscript):
+                attr = _self_attr(base.value)
+                if attr is not None and cls is not None:
+                    elem = self._elem_types.get(f"{mod}:{cls.name}", {})
+                    return elem.get(attr)
+                return None
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    return f"{mod}:{cls.name}"
+                return local_types.get(base.id)
+            if isinstance(base, ast.Attribute):
+                owner = receiver_class(base.value)
+                if owner is not None:
+                    oinfo = self.model.classes.get(owner)
+                    if oinfo is not None:
+                        return oinfo.attr_types.get(base.attr)
+            return None
+
+        def resolve_any_call(node: ast.Call) -> Optional[str]:
+            """The base resolver, extended with typed-receiver dispatch
+            (``svc.stop()`` on a constructed local, ``be.breaker.record()``
+            through attr chains) — what lets the main role's wiring code
+            reach into the objects it drives."""
+            target = self.model.resolve_call(node, mod, cls, local_prefix)
+            if target is not None:
+                return target
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                owner = receiver_class(fn.value)
+                if owner is not None:
+                    oinfo = self.model.classes.get(owner)
+                    if oinfo is not None:
+                        return oinfo.methods.get(fn.attr)
+            return None
+
+        def callback_targets(node: ast.Call) -> List[str]:
+            """Project functions passed AS ARGUMENTS — a callback handed
+            to a runner may be invoked by it (``self._consume(q, handle)``
+            drives the nested ``handle``; ``on_batch=self._enqueue_window``
+            re-enters the service from the merge thread). Conservative
+            may-call edges — EXCEPT Thread/Timer/submit targets, which
+            run on the SPAWNED thread (they are role roots, not calls
+            from the spawner's role)."""
+            _, name = _callee(node)
+            if name in ("Thread", "Timer") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+            ):
+                return []
+            out: List[str] = []
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                t = self._resolve_target(ctx, mod, node, arg)
+                if t is not None:
+                    out.append(t)
+            return out
+
+        def check_then_act(node: ast.AST, cls_qn: str, fname: str) -> bool:
+            """An enclosing ``if``/``while`` test reads the same field
+            with a membership/None test — the dict/list check-then-act
+            compound (``if k not in self.cache: self.cache[k] = ...``)."""
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                if not isinstance(anc, (ast.If, ast.While)):
+                    continue
+                for sub in ast.walk(anc.test):
+                    if not isinstance(sub, ast.Attribute) or sub.attr != fname:
+                        continue
+                    if receiver_class(sub.value) == cls_qn:
+                        return True
+            return False
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return  # nested defs carry their own summaries
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                newly: List[str] = []
+                for item in node.items:
+                    lock = _lock_id_for(self.model, mod, cls, item.context_expr)
+                    walk(item.context_expr, held)
+                    if lock is not None and lock not in held:
+                        newly.append(lock)
+                inner = held + tuple(newly)
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                target = resolve_any_call(node)
+                if target is not None and target != qn:
+                    calls.append((frozenset(held), target))
+                for cb in callback_targets(node):
+                    if cb != qn:
+                        calls.append((frozenset(held), cb))
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                rmw = isinstance(node, ast.AugAssign)
+                for t in targets:
+                    base = t
+                    container = False
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                        container = True
+                    if isinstance(base, ast.Attribute):
+                        cls_qn = receiver_class(base.value)
+                        if cls_qn is not None:
+                            compound = rmw or (
+                                container
+                                and check_then_act(t, cls_qn, base.attr)
+                            )
+                            field_site(
+                                cls_qn, base.attr, t, True, compound, held
+                            )
+            if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                cls_qn = receiver_class(node.value)
+                if cls_qn is not None:
+                    field_site(cls_qn, node.attr, node, False, False, held)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        body = info.node.body if isinstance(info.node.body, list) else [info.node.body]
+        for stmt in body:
+            walk(stmt, ())
+        self.calls[qn] = calls
+
+    def _local_types(
+        self, info: FunctionInfo, mod: str, cls: Optional[ast.ClassDef]
+    ) -> Dict[str, str]:
+        """Locals with an evident project class: ``x = Cls(...)``,
+        ``x = self.<attr>`` (typed attr), ``x = self.<container attr>[k]``
+        (element type), and ``for x in self.<container>.values()``."""
+        out: Dict[str, str] = {}
+        cinfo = (
+            self.model.classes.get(f"{mod}:{cls.name}") if cls is not None else None
+        )
+        elem = self._elem_types.get(cinfo.qualname, {}) if cinfo is not None else {}
+
+        def attr_type(value: ast.AST) -> Optional[str]:
+            attr = _self_attr(value)
+            if attr is not None and cinfo is not None:
+                return cinfo.attr_types.get(attr)
+            if isinstance(value, ast.Subscript):
+                attr = _self_attr(value.value)
+                if attr is not None:
+                    return elem.get(attr)
+            if isinstance(value, ast.Call):
+                f = value.func
+                if isinstance(f, ast.Attribute) and f.attr in ("values", "get"):
+                    attr = _self_attr(f.value)
+                    if attr is not None:
+                        return elem.get(attr)
+                return self.model.resolve_class(mod, f)
+            return None
+
+        def iter_elem_type(it: ast.AST) -> Optional[str]:
+            """Element class of an iterable expression:
+            ``self._streams.values()``, ``list(...)`` wrappers, ``+``
+            concatenation of same-typed iterables, and typed container
+            attrs themselves."""
+            if isinstance(it, ast.BinOp) and isinstance(it.op, ast.Add):
+                left = iter_elem_type(it.left)
+                right = iter_elem_type(it.right)
+                return left if left == right else None
+            if isinstance(it, ast.Call):
+                f = it.func
+                if getattr(f, "id", None) in ("list", "sorted", "tuple") and it.args:
+                    return iter_elem_type(it.args[0])
+                if isinstance(f, ast.Attribute) and f.attr == "values":
+                    return iter_elem_type(f.value)
+                return None
+            attr = _self_attr(it)
+            if attr is not None:
+                return elem.get(attr)
+            return None
+
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    ty = attr_type(node.value)
+                    if ty is not None:
+                        out[t.id] = ty
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                ty = iter_elem_type(node.iter)
+                if ty is not None and isinstance(node.target, ast.Name):
+                    out[node.target.id] = ty
+        return out
+
+    # -- closures ------------------------------------------------------------
+
+    def _closure(self, roots: Sequence[str]) -> Set[str]:
+        seen: Set[str] = set(roots)
+        work = list(roots)
+        while work:
+            qn = work.pop()
+            for _, target in self.calls.get(qn, ()):
+                if target not in seen:
+                    seen.add(target)
+                    work.append(target)
+        return seen
+
+    def roles_of(self, qn: str) -> Set[str]:
+        return self._roles_of.get(qn, set())
+
+    def _entry_lock_fixpoint(self) -> Dict[str, frozenset]:
+        """Locks ALWAYS held when a function is entered: intersection
+        over every resolvable call site of (caller's entry set ∪ locks
+        held at the site); role roots and never-called functions seed
+        empty — they can be entered cold. Decreasing sets → terminates."""
+        universe = frozenset(
+            lock for calls in self.calls.values() for held, _ in calls for lock in held
+        )
+        callers: Dict[str, List[Tuple[str, frozenset]]] = {}
+        for qn, calls in self.calls.items():
+            for held, target in calls:
+                callers.setdefault(target, []).append((qn, held))
+        roots: Set[str] = set()
+        for role in self.roles.values():
+            roots.update(role.roots)
+        entry: Dict[str, frozenset] = {}
+        for qn in self.model.functions:
+            if qn in roots or qn not in callers:
+                entry[qn] = frozenset()
+            else:
+                entry[qn] = universe
+        changed = True
+        while changed:
+            changed = False
+            for qn, sites in callers.items():
+                if qn in roots:
+                    continue
+                new = None
+                for caller, held in sites:
+                    s = entry.get(caller, frozenset()) | held
+                    new = s if new is None else (new & s)
+                if new is not None and new != entry[qn]:
+                    entry[qn] = new
+                    changed = True
+        return entry
+
+    def lockset(self, acc: Access) -> frozenset:
+        return self.entry_locks.get(acc.fn_qn, frozenset()) | acc.held
+
+    def classes_ctx(self, cls_qn: str) -> FileContext:
+        return self.model.classes[cls_qn].ctx
+
+    def lockless_sanction(
+        self, decl: FieldDecl
+    ) -> Optional[Tuple[Optional[str], int]]:
+        """(why, line) when the field is sanctioned lockless — its own
+        annotation or a class-level one; None otherwise."""
+        if decl.lockless_line is not None:
+            return decl.lockless_why, decl.lockless_line
+        cls_level = self.class_lockless.get(decl.cls_qn)
+        if cls_level is not None:
+            return cls_level
+        return None
+
+    def role_private_sanction(
+        self, cls_qn: str
+    ) -> Optional[Tuple[Optional[str], int]]:
+        """(why, line) when the class claims instance confinement."""
+        return self.class_role_private.get(cls_qn)
+
+
+def _value_kind(value: Optional[ast.AST]) -> str:
+    """GIL-atomicity class of a field's declared initial value — what
+    ALZ053 audits lockless-ok against."""
+    if isinstance(
+        value,
+        (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp),
+    ):
+        return "container"
+    if isinstance(value, ast.Call):
+        _, name = _callee(value)
+        if name in ("list", "dict", "set", "defaultdict", "OrderedDict", "deque"):
+            return "container"
+    if isinstance(value, ast.Constant):
+        if isinstance(value.value, bool):
+            return "int"
+        if isinstance(value.value, int):
+            return "int"
+        if isinstance(value.value, float):
+            return "float"
+    if isinstance(value, ast.UnaryOp) and isinstance(value.operand, ast.Constant):
+        return _value_kind(value.operand)
+    return "other"
+
+
+def _scan_marker(
+    ctx: FileContext, marker_re: re.Pattern
+) -> Dict[int, Optional[str]]:
+    """line -> justification (None when missing) for every matching
+    annotation comment. Token-stream scan like the core's
+    disable/guarded-by maps — string literals can't false-positive."""
+    out: Dict[int, Optional[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(ctx.source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = marker_re.search(tok.string)
+            if m:
+                out[tok.start[0]] = m.group("why")
+    except tokenize.TokenError:
+        pass
+    return out

@@ -1,0 +1,342 @@
+"""Lockset race rules: ALZ050 (unsynchronized shared write), ALZ051
+(compound read-modify-write outside any common lock), ALZ052 (missing
+``# guarded-by`` on a consistently-locked shared field), ALZ053
+(``# lockless-ok`` audit).
+
+The race condition these rules pin statically: a field of a
+multi-role-reachable class, written from at least one thread role while
+another role can touch it, with NO lock common to every access site.
+Every real race the earlier heads found by hand or by stress fits this
+shape — the interner counters (PR 2), the ingest-server thread-list
+rebind (PR 2), the StagingArenas buffer swap (PR 2), the breaker-vs-
+scrape ABBA (PR 10) — and none of them required an annotation to exist
+first, which is exactly the gap ALZ010 leaves.
+
+Finding discipline (what anchors where):
+
+- every role-relevant WRITE site holding no lock at all gets its own
+  finding — ALZ051 when the write is compound (aug-assign, subscript
+  check-then-act), ALZ050 otherwise;
+- a field whose sites all hold SOME lock but no COMMON one gets one
+  ALZ050 at its first write site (inconsistent locking — two sites
+  think they are synchronized and are not);
+- ``# guarded-by`` fields are ALZ010's jurisdiction and are skipped;
+  ``# lockless-ok: <why>`` fields are sanctioned and skipped — and
+  audited by ALZ053: a missing justification, a container-valued field
+  (list/dict/set mutation is not GIL-atomic), or a float compound
+  under the annotation is still flagged;
+- ALZ052 closes the annotation loop: a shared field that every site
+  already guards with exactly ONE lock of its own class — provably,
+  intra-method, so the per-file ALZ010 checker can take over — must
+  carry the annotation, so coverage survives this whole-program pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext, Finding
+from tools.alazrace.racemodel import Access, FieldDecl, RaceModel
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split(":", 1)[-1]
+
+
+def _cls_short(cls_qn: str) -> str:
+    return cls_qn.split(":", 1)[-1]
+
+
+class FieldReport:
+    """One shared field's aggregated facts — computed once, consumed by
+    ALZ050/051/052 and the golden topology map."""
+
+    def __init__(self, decl: FieldDecl, sites: List[Access], model: RaceModel):
+        self.decl = decl
+        self.sites = sites
+        self.roles: Set[str] = set()
+        for s in sites:
+            self.roles |= model.roles_of(s.fn_qn)
+        self.writes = [s for s in sites if s.write]
+        locksets = [model.lockset(s) for s in sites]
+        self.common: frozenset = (
+            frozenset.intersection(*locksets) if locksets else frozenset()
+        )
+        self.model = model
+
+    @property
+    def multi_role(self) -> bool:
+        return len(self.roles) >= 2
+
+    def own_lock_candidates(self) -> List[str]:
+        """Locks in the common set that are attributes of the DECLARING
+        class — the only guards ``# guarded-by: self.<lock>`` can name."""
+        prefix = f"{self.decl.cls_qn}."
+        return sorted(l for l in self.common if l.startswith(prefix))
+
+    def intra_method_consistent(self, lock: str) -> bool:
+        """Every site holds ``lock`` inside its own function body (not
+        merely via a caller) — the property ALZ010 can verify."""
+        return all(lock in s.held for s in self.sites)
+
+
+def field_reports(model: RaceModel) -> Dict[Tuple[str, str], FieldReport]:
+    """Role-relevant access aggregation: sites inside any ``__init__``
+    are publication-time (happens-before thread start) and excluded;
+    sites in functions no role reaches are dead to the race surface."""
+    grouped: Dict[Tuple[str, str], List[Access]] = {}
+    for acc in model.accesses:
+        if acc.in_init:
+            continue
+        fn_short = acc.fn_qn.rsplit(".", 1)[-1]
+        if fn_short == "__init__":
+            continue  # constructor wiring of another object: publication
+        if not model.roles_of(acc.fn_qn):
+            continue
+        grouped.setdefault((acc.cls_qn, acc.fieldname), []).append(acc)
+    out: Dict[Tuple[str, str], FieldReport] = {}
+    for key, sites in grouped.items():
+        decl = model.fields.get(key)
+        if decl is None:
+            continue
+        out[key] = FieldReport(decl, sites, model)
+    return out
+
+
+def check_alz050_051(
+    ctxs: Sequence[FileContext],
+    model: Optional[RaceModel] = None,
+    reports: Optional[Dict[Tuple[str, str], FieldReport]] = None,
+) -> Iterable[Finding]:
+    model = model if model is not None else RaceModel(ctxs)
+    reports = reports if reports is not None else field_reports(model)
+    out: List[Finding] = []
+    for (cls_qn, fname), rep in sorted(reports.items()):
+        if not rep.multi_role or not rep.writes:
+            continue
+        if rep.decl.guarded_by is not None:
+            continue  # ALZ010's jurisdiction (per-file, annotation-driven)
+        if model.lockless_sanction(rep.decl) is not None:
+            continue  # sanctioned — ALZ053 audits the claim
+        if model.role_private_sanction(cls_qn) is not None:
+            continue  # instance-confined by design — ALZ053 audits
+        if rep.common:
+            continue
+        roles = ", ".join(sorted(r.split(":", 1)[-1] for r in rep.roles))
+        unlocked_writes = [
+            s for s in rep.writes if not model.lockset(s)
+        ]
+        for s in sorted(unlocked_writes, key=lambda a: (a.ctx.path, a.line, a.col)):
+            if s.rmw:
+                out.append(
+                    Finding(
+                        "ALZ051",
+                        f"compound read-modify-write of "
+                        f"`{_cls_short(cls_qn)}.{fname}` with no lock held "
+                        f"— the field is reachable from roles [{roles}] "
+                        "and a concurrent writer lands between the read "
+                        "and the write-back (lost update / check-then-act "
+                        "TOCTOU); take the field's lock around the whole "
+                        "compound, or sanction it with "
+                        "`# lockless-ok: <why>` on the declaration",
+                        s.ctx.path,
+                        s.line,
+                        s.col,
+                    )
+                )
+            else:
+                out.append(
+                    Finding(
+                        "ALZ050",
+                        f"unsynchronized write to "
+                        f"`{_cls_short(cls_qn)}.{fname}` — the field is "
+                        f"reachable from roles [{roles}] and no access "
+                        "site shares a lock with this write; guard every "
+                        "access with one lock (then annotate "
+                        "`# guarded-by`), or sanction a deliberate "
+                        "lockless field with `# lockless-ok: <why>`",
+                        s.ctx.path,
+                        s.line,
+                        s.col,
+                    )
+                )
+        if not unlocked_writes:
+            # every site holds SOMETHING, but no lock is common: two
+            # sites each believe they are synchronized and are not
+            first = min(rep.writes, key=lambda a: (a.ctx.path, a.line, a.col))
+            locks = sorted(
+                {_short(l) for s in rep.sites for l in model.lockset(s)}
+            )
+            out.append(
+                Finding(
+                    "ALZ050",
+                    f"inconsistently locked field "
+                    f"`{_cls_short(cls_qn)}.{fname}`: access sites hold "
+                    f"{locks} but NO lock is common to all of them "
+                    f"(roles [{roles}]) — pick ONE lock for every access "
+                    "or sanction with `# lockless-ok: <why>`",
+                    first.ctx.path,
+                    first.line,
+                    first.col,
+                )
+            )
+    return out
+
+
+def check_alz052(
+    ctxs: Sequence[FileContext],
+    model: Optional[RaceModel] = None,
+    reports: Optional[Dict[Tuple[str, str], FieldReport]] = None,
+) -> Iterable[Finding]:
+    model = model if model is not None else RaceModel(ctxs)
+    reports = reports if reports is not None else field_reports(model)
+    out: List[Finding] = []
+    for (cls_qn, fname), rep in sorted(reports.items()):
+        if not rep.multi_role or not rep.writes:
+            continue
+        if rep.decl.guarded_by is not None:
+            continue  # already annotated
+        if model.lockless_sanction(rep.decl) is not None:
+            continue
+        if model.role_private_sanction(cls_qn) is not None:
+            continue
+        candidates = rep.own_lock_candidates()
+        if len(candidates) != 1:
+            continue
+        lock = candidates[0]
+        if not rep.intra_method_consistent(lock):
+            continue  # guarded only via callers: ALZ010 could not verify
+        out.append(
+            Finding(
+                "ALZ052",
+                f"shared field `{_cls_short(cls_qn)}.{fname}` is "
+                f"consistently guarded by `self.{lock.rsplit('.', 1)[-1]}` "
+                "at every access site but its declaration carries no "
+                "`# guarded-by` annotation — annotate it so the per-file "
+                "ALZ010 checker inherits this coverage (a future access "
+                "added off-lock then fails fast lint, not a stress run)",
+                rep.decl.ctx.path,
+                rep.decl.line,
+                0,
+            )
+        )
+    return out
+
+
+def check_alz053(
+    ctxs: Sequence[FileContext],
+    model: Optional[RaceModel] = None,
+) -> Iterable[Finding]:
+    model = model if model is not None else RaceModel(ctxs)
+    out: List[Finding] = []
+    # field-level annotations
+    for (cls_qn, fname), decl in sorted(model.fields.items()):
+        if decl.lockless_line is None:
+            continue
+        if decl.lockless_why is None:
+            out.append(
+                Finding(
+                    "ALZ053",
+                    f"`# lockless-ok` on `{_cls_short(cls_qn)}.{fname}` "
+                    "has no justification — write "
+                    "`# lockless-ok: <why this is safe>` (the annotation "
+                    "is a reviewed claim, not a mute button)",
+                    decl.ctx.path,
+                    decl.lockless_line,
+                    0,
+                )
+            )
+        out.extend(_audit_atomicity(model, cls_qn, fname, decl, decl.lockless_line))
+    # class-level annotations cover every field of the class — audit each
+    for cls_qn, (why, line) in sorted(model.class_lockless.items()):
+        if why is None:
+            out.append(
+                Finding(
+                    "ALZ053",
+                    f"class-level `# lockless-ok` on "
+                    f"`{_cls_short(cls_qn)}` has no justification — write "
+                    "`# lockless-ok: <why this is safe>`",
+                    model.classes_ctx(cls_qn).path,
+                    line,
+                    0,
+                )
+            )
+        for (cqn, fname), decl in sorted(model.fields.items()):
+            if cqn != cls_qn or decl.lockless_line is not None:
+                continue
+            out.extend(_audit_atomicity(model, cqn, fname, decl, line))
+    # role-private is a different claim (confinement, not atomicity) —
+    # the audit is that it carries a why; the golden map carries the rest
+    for cls_qn, (why, line) in sorted(model.class_role_private.items()):
+        if why is None:
+            out.append(
+                Finding(
+                    "ALZ053",
+                    f"`# role-private` on `{_cls_short(cls_qn)}` has no "
+                    "justification — write `# role-private: <why instances "
+                    "never cross threads>` (the annotation is a reviewed "
+                    "confinement claim, not a mute button)",
+                    model.classes_ctx(cls_qn).path,
+                    line,
+                    0,
+                )
+            )
+    return out
+
+
+def _audit_atomicity(
+    model: RaceModel, cls_qn: str, fname: str, decl: FieldDecl, anchor_line: int
+) -> Iterable[Finding]:
+    """A lockless-ok claim is only tenable for GIL-atomic access shapes:
+    int/reference reads and single stores. Containers with UNLOCKED
+    structural mutation and float compounds are multi-op under the hood
+    — the annotation cannot bless them. (Locked writes + lockless
+    double-checked reads on a dict is the one sanctioned container
+    shape: reads are single GIL-atomic lookups.)"""
+    if decl.value_kind == "container":
+        unlocked_writes = [
+            a
+            for a in model.accesses
+            if a.cls_qn == cls_qn
+            and a.fieldname == fname
+            and a.write
+            and not a.in_init
+            and not a.fn_qn.endswith(".__init__")
+            and not model.lockset(a)
+        ]
+        if unlocked_writes:
+            first = min(unlocked_writes, key=lambda a: (a.ctx.path, a.line))
+            yield Finding(
+                "ALZ053",
+                f"`# lockless-ok` covers container field "
+                f"`{_cls_short(cls_qn)}.{fname}` (list/dict/set) with an "
+                f"UNLOCKED structural mutation at "
+                f"{first.ctx.path}:{first.line} — resize/rehash is not "
+                "GIL-atomic, so the sanction does not hold; lock every "
+                "mutation (lockless reads of a locked-write dict are the "
+                "one blessed container shape) or use atomic-swap-of-"
+                "immutable",
+                decl.ctx.path,
+                anchor_line,
+                0,
+            )
+        return
+    if decl.value_kind == "float":
+        rmw = [
+            a
+            for a in model.accesses
+            if a.cls_qn == cls_qn and a.fieldname == fname and a.rmw
+        ]
+        if rmw:
+            first = min(rmw, key=lambda a: (a.ctx.path, a.line))
+            yield Finding(
+                "ALZ053",
+                f"`# lockless-ok` covers float field "
+                f"`{_cls_short(cls_qn)}.{fname}` with a compound update at "
+                f"{first.ctx.path}:{first.line} — float `+=` is "
+                "read-modify-write and loses updates under the GIL too; "
+                "the sanction only covers reads and single stores",
+                decl.ctx.path,
+                anchor_line,
+                0,
+            )

@@ -359,9 +359,10 @@ def check_specs(specs_dir: Optional[Path] = None) -> List[Finding]:
             )
         )
     for stray in sorted(specs_dir.glob("*.json")):
-        if stray.name == "metrics.json":
-            continue  # alazflow's golden metric registry (ALZ044) lives
-            # beside the spec set but is owned by `--write-metrics`
+        if stray.name in ("metrics.json", "threads.json"):
+            continue  # alazflow's golden metric registry (ALZ044) and
+            # alazrace's golden concurrency map (ALZ054) live beside the
+            # spec set but are owned by --write-metrics / --write-threads
         if stray.name not in live:
             out.append(
                 Finding(
