@@ -37,8 +37,9 @@ sanitize:  ## alazsan runtime heads: lock-order stress + retrace budgets + trans
 abi-check:  ## alazspec: C-struct/dtype/enum ABI parity + golden shape/dtype/sharding contract diff (ALZ020-ALZ023)
 	env JAX_PLATFORMS=cpu python -m tools.alazspec --abi --check-specs --json
 
-specs:  ## regenerate golden specfiles + wire layout table + concurrency map (resources/specs) — review and commit the diff
+specs:  ## regenerate golden specfiles + wire layout table + metric registry + concurrency map (resources/specs) — review and commit the diff
 	env JAX_PLATFORMS=cpu python -m tools.alazspec --write-specs
+	python -m tools.alazflow --write-metrics
 	python -m tools.alazrace --write-threads
 
 lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 and spec hygiene ALZ024 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed

@@ -259,6 +259,22 @@ class TraceConfig:
     # longer than this is clamped — the endpoint must never wedge a
     # debug-port thread (or fill a disk) for an unbounded stretch
     profile_max_s: float = 30.0
+    # score-plane observability (ISSUE 13, obs/scores.py): per-model
+    # score-distribution sketches, PSI/L∞ drift detection with
+    # churn-triggered rebaselining, and the top-K attribution ledger
+    # (/scores, /scores/top). ON by default — cost is one vectorized
+    # pass per scored window, inside the ≤2% bench bound
+    # (score_plane_overhead_pct re-measures it every round).
+    score_enabled: bool = True
+    # rolling drift reference: the trailing K windows the current
+    # window's score distribution is compared against (PSI + L∞-on-CDF
+    # with hysteresis). Size to several multiples of the deploy cadence
+    # you want paged on; a rebaseline refills it before judging resumes.
+    score_drift_windows: int = 8
+    # attribution ledger width: the K highest-scoring nodes kept per
+    # window with feature z-scores + top contributing in-edges —
+    # bounded cardinality by construction, never a per-node series
+    score_top_k: int = 10
 
     @classmethod
     def from_env(cls) -> "TraceConfig":
@@ -269,6 +285,9 @@ class TraceConfig:
             recorder_dump_on_crash=env_bool("RECORDER_DUMP_ON_CRASH", True),
             device_enabled=env_bool("DEVICE_TRACE_ENABLED", True),
             profile_max_s=env_float("PROFILE_MAX_SECONDS", 30.0),
+            score_enabled=env_bool("SCORE_TRACE_ENABLED", True),
+            score_drift_windows=env_int("SCORE_DRIFT_WINDOWS", 8),
+            score_top_k=env_int("SCORE_TOP_K", 10),
         )
 
 

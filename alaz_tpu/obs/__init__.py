@@ -15,10 +15,16 @@ flight recorder, and the device-side telemetry plane.
   ``CompileEventPlane``: per-bucket score latency/occupancy, the
   stage arena/transfer decomposition with a byte ledger, pad-waste
   accounting, and the always-on XLA compile event hookup.
+- :mod:`alaz_tpu.obs.scores` — ``ScorePlane`` + ``DriftDetector``
+  (ISSUE 13): per-model streaming score-distribution sketches on the
+  [0,1] ladder, PSI/L∞-on-CDF drift detection with hysteresis and
+  churn-triggered rebaselining, and the bounded top-K anomaly
+  attribution ledger (``/scores``, ``/scores/top``).
 
 Config: ``TRACE_*`` / ``RECORDER_*`` / ``DEVICE_TRACE_*`` /
-``PROFILE_*`` env vars (CONFIG.md, TraceConfig).
-Design notes: ARCHITECTURE §3m (host plane) and §3n (device plane).
+``SCORE_TRACE_*`` / ``PROFILE_*`` env vars (CONFIG.md, TraceConfig).
+Design notes: ARCHITECTURE §3m (host plane), §3n (device plane) and
+§3p (score plane).
 """
 
 from alaz_tpu.obs.device import (
@@ -29,6 +35,12 @@ from alaz_tpu.obs.device import (
 )
 from alaz_tpu.obs.histogram import DEFAULT_BOUNDS, Histogram
 from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.scores import (
+    SCORE_BOUNDS,
+    DriftDetector,
+    ScorePlane,
+    feature_scores,
+)
 from alaz_tpu.obs.spans import HOST_STAGES, STAGES, SpanTracer, WindowSpan
 
 __all__ = [
@@ -43,4 +55,8 @@ __all__ = [
     "DeviceTelemetry",
     "batch_pad_waste_pct",
     "bucket_key",
+    "SCORE_BOUNDS",
+    "DriftDetector",
+    "ScorePlane",
+    "feature_scores",
 ]

@@ -159,6 +159,26 @@ class Histogram:
             out[key] = self._percentile_from(counts, total, q)
         return out
 
+    def add_counts(self, counts: Sequence[int], vsum: float) -> None:
+        """Fold an already-bucketed batch of observations in — the
+        vectorized batch-observe path (obs/scores.py buckets a whole
+        per-window score vector with one searchsorted+bincount instead
+        of E bisects). ``counts`` is NON-cumulative per-bucket counts of
+        length ``len(bounds)+1`` (last = overflow); ``vsum`` the sum of
+        the raw values. Exactly equivalent to observing each value
+        individually (tested), so merged sketches stay associative."""
+        if len(counts) != len(self.bounds) + 1:
+            raise ValueError("counts length must be len(bounds)+1")
+        total = 0
+        s = self._stripes[_stripe_index()]
+        with s.lock:
+            for i, c in enumerate(counts):
+                c = int(c)
+                s.counts[i] += c
+                total += c
+            s.count += total
+            s.sum += float(vsum)
+
     # -- merge (associative: shared ladder, vector addition) -----------------
 
     def merge(self, other: "Histogram") -> "Histogram":

@@ -192,6 +192,17 @@ def replay_delivery(target, d: Delivery, now_ns: Optional[int] = None) -> int:
     can track the stream's high-water mark."""
     for kind, payload in d.pre:
         if kind == "k8s":
+            # control events must not race ahead of queued data rows:
+            # the sharded pipeline folds k8s synchronously while L7
+            # rows may still sit in shard queues, so a rollout's pod
+            # DELETE would apply BEFORE the pod's earlier traffic
+            # attributes (its pre-cut rows all drop as not_pod and the
+            # pod never appears in any emitted window). Stream position
+            # is the contract — drain the data plane first. Serial
+            # targets process synchronously and have no drain: no-op.
+            drain = getattr(target, "drain", None)
+            if drain is not None:
+                drain(timeout_s=10.0)
             for m in payload:
                 target.process_k8s(m)
         else:
@@ -382,14 +393,29 @@ class DeployRollout(Incident):
         t_cut = ((t_base // _WINDOW_NS) + self.at_window) * _WINDOW_NS
         rolled = False
         rewritten = 0
+        out_deliveries: List[Delivery] = []
         for d in traffic.deliveries:
             b = d.batch
             after = b["write_time_ns"] >= np.uint64(t_cut)
             if not after.any():
+                out_deliveries.append(d)
                 continue
             if not rolled:
-                d.pre.append(("k8s", msgs))
+                if not after.all():
+                    # the chunk straddles the cut: split it so the
+                    # DELETE+ADD lands exactly at the rollout's window
+                    # boundary — attached to the straddling chunk it
+                    # would apply mid-window and cut the victims' rows
+                    # HALF a window early (a phantom perturbation the
+                    # drift monitor rightly paged on)
+                    out_deliveries.append(Delivery(b[~after], pre=d.pre))
+                    d = Delivery(b[after], pre=[("k8s", msgs)])
+                    b = d.batch
+                    after = b["write_time_ns"] >= np.uint64(t_cut)
+                else:
+                    d.pre.append(("k8s", msgs))
                 rolled = True
+            out_deliveries.append(d)
             eidx = _row_edge_lookup(b, keys)
             hit = after & (eidx >= 0)
             if hit.any():
@@ -404,6 +430,7 @@ class DeployRollout(Incident):
                     b["daddr"][sub] = svc_ip[eidx[sub]]
                     b["dport"][sub] = 80
                     rewritten += int(sub.sum())
+        traffic.deliveries = out_deliveries
         traffic.meta["deploy_rollout"] = {
             "churned_pods": int(n_churn),
             "rewritten_rows": rewritten,
@@ -885,7 +912,11 @@ def run_host_leg(
             f"{name}: p99 window close took {p99:.2f}s with the cap armed "
             "(close wave stalling)"
         )
+    score_plane = run_drift_leg(
+        name, closed, findings=findings, gated=chaos is None, interner=interner
+    )
     return {
+        "score_plane": score_plane,
         "scenario": name,
         "seed": seed,
         "scale": scale,
@@ -911,6 +942,113 @@ def run_host_leg(
             "worker_restarts": pipe.worker_restarts,
         },
     }
+
+
+# ---------------------------------------------------------------------------
+# Score-plane drift leg (ISSUE 13): the emitted windows through the
+# drift monitor, with the deterministic feature-space scorer.
+# ---------------------------------------------------------------------------
+
+# scenarios whose score distribution MUST trip a drift event on clean
+# fixed seeds (the shapes the monitor exists for: an error cascade and
+# a composition-shifting fan-in); dns_storm in practice trips too but
+# is reported, not gated — its drift is a side effect, not the point
+DRIFT_TRIP_SCENARIOS = ("retry_storm", "hot_key")
+# a drift event later than this many windows after the incident's first
+# hot window is a detection failure, not a page (the gate's N)
+DRIFT_MAX_LAG_WINDOWS = 2
+
+
+def run_drift_leg(
+    name: str,
+    closed: List,
+    findings: Optional[List[str]] = None,
+    gated: bool = True,
+    interner=None,
+) -> dict:
+    """Feed the host leg's emitted windows (emission order) through a
+    :class:`~alaz_tpu.obs.scores.ScorePlane` driven by the deterministic
+    feature-space scorer, and gate the drift contract:
+
+    - ``retry_storm`` / ``hot_key`` must raise a drift event within
+      ``DRIFT_MAX_LAG_WINDOWS`` of the incident's first hot window;
+    - ``deploy_rollout`` must REBASELINE (node-churn detection) without
+      a drift false alarm;
+    - anything else is report-only (dns_storm legitimately drifts).
+
+    ``gated=False`` (chaos-perturbed runs) records but never gates —
+    duplicated/late delivery legitimately reshapes per-window
+    distributions. On a gate failure the top-K attribution ledger of
+    the newest windows is attached to the finding, the trail an
+    operator would pull from ``/scores/top``."""
+    from alaz_tpu.obs.scores import ScorePlane, feature_scores
+
+    if findings is None:
+        findings = []
+    plane = ScorePlane(
+        enabled=True,
+        model=name,
+        # short fixed-seed runs (3-6 windows; a composed
+        # backpressure_wave compresses to 3): a 2-window trailing
+        # reference armed from the FIRST window, flip on the first
+        # over-threshold compare — the production default (8, hysteresis
+        # 2) would spend the whole run warming up
+        drift_windows=2,
+        min_ref=1,
+        hysteresis=1,
+        top_k=5,
+        resolve=interner.lookup if interner is not None else None,
+    )
+    first_drift_window = None
+    for i, b in enumerate(closed):
+        plane.observe_window(b, feature_scores(b))
+        if first_drift_window is None and plane.drift_events > 0:
+            first_drift_window = i
+    snap = plane.snapshot()
+    out = {
+        "windows": snap["windows"],
+        "drift_events": snap["drift"]["events"],
+        "rebaselines": snap["drift"]["rebaselines"],
+        "first_drift_window": first_drift_window,
+        "psi": snap["drift"]["psi"],
+        "dist": snap["dist"],
+    }
+    if not gated:
+        return out
+    if name in DRIFT_TRIP_SCENARIOS:
+        if plane.drift_events == 0:
+            findings.append(
+                f"{name}: score distribution never tripped the drift "
+                f"monitor (psi last={snap['drift']['psi']}) — the plane "
+                "missed the incident it exists for; top ledger: "
+                f"{plane.top_snapshot(2)}"
+            )
+        else:
+            # "within N windows": the incident's first hot window is 2
+            # for every gated scenario (make_incident), and the drift
+            # compare arms at window 2 — a first event past
+            # 2 + DRIFT_MAX_LAG_WINDOWS means the monitor needed the
+            # incident to persist unreasonably long before paging
+            if first_drift_window > 2 + DRIFT_MAX_LAG_WINDOWS:
+                findings.append(
+                    f"{name}: drift event arrived at window "
+                    f"{first_drift_window}, more than "
+                    f"{DRIFT_MAX_LAG_WINDOWS} windows after the "
+                    "incident onset"
+                )
+    if name == "deploy_rollout":
+        if plane.rebaselines == 0:
+            findings.append(
+                f"{name}: node-table churn never rebaselined the drift "
+                "reference — a real rollout would page as drift"
+            )
+        if plane.drift_events > 0:
+            findings.append(
+                f"{name}: drift false alarm across a rebaselining "
+                f"rollout (events={plane.drift_events}); top ledger: "
+                f"{plane.top_snapshot(2)}"
+            )
+    return out
 
 
 # ---------------------------------------------------------------------------

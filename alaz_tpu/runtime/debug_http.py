@@ -13,6 +13,14 @@ Endpoints:
                         and the per-bucket device breakdown:
                         score percentiles, occupancy, pad waste,
                         stage arena/transfer split, compile events)
+- ``/scores``           score-plane snapshot (ISSUE 13): per-model
+                        distribution sketch percentiles, last-window
+                        summary, drift state/PSI/rebaselines; 404 when
+                        the plane is disabled (absent-not-zero)
+- ``/scores/top?windows=N``  top-K anomaly attribution ledger: the K
+                        highest-scoring nodes of the last N windows with
+                        feature z-scores + top contributing in-edges;
+                        bounded by the ledger ring however large N
 - ``/recorder``         flight-recorder dump (alaz_tpu/obs): the last-N
                         structured runtime events, oldest→newest
 - ``/stack``            all-thread stack dump (goroutine-profile analog)
@@ -103,6 +111,12 @@ class DebugServer:
                     plane = getattr(svc, "compile_plane", None)
                     if plane is not None:
                         stats["compile"] = plane.snapshot()
+                    score_plane = getattr(svc, "scores", None)
+                    if score_plane is not None and score_plane.enabled:
+                        # drift + distribution summary next to the
+                        # device breakdown (ISSUE 13); the full ledger
+                        # stays on /scores/top
+                        stats["scores"] = score_plane.snapshot()
                     recorder = getattr(svc, "recorder", None)
                     if recorder is not None:
                         stats["recorder"] = {
@@ -111,6 +125,53 @@ class DebugServer:
                             "capacity": recorder.capacity,
                         }
                     self._send(200, json.dumps(stats, indent=2), "application/json")
+                elif self.path == "/scores":
+                    plane = getattr(svc, "scores", None)
+                    if plane is None or not plane.enabled:
+                        # absent-not-zero (ISSUE 13): a disabled plane
+                        # has no surface, it does not serve empty JSON
+                        self._send(404, "score plane disabled")
+                    else:
+                        self._send(
+                            200,
+                            json.dumps(plane.snapshot(), indent=2),
+                            "application/json",
+                        )
+                elif self.path == "/scores/top" or self.path.startswith(
+                    "/scores/top?"
+                ):
+                    plane = getattr(svc, "scores", None)
+                    if plane is None or not plane.enabled:
+                        self._send(404, "score plane disabled")
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    raw = qs.get("windows", ["1"])[0]
+                    # malformed params 400 BEFORE any side effect (the
+                    # /profile discipline); the ledger ring bounds the
+                    # response however large the ask
+                    try:
+                        windows = int(raw)
+                    except ValueError:
+                        self._send(
+                            400,
+                            '{"error": "windows must be an integer"}',
+                            "application/json",
+                        )
+                        return
+                    if windows < 0:
+                        self._send(
+                            400,
+                            '{"error": "windows must be >= 0"}',
+                            "application/json",
+                        )
+                        return
+                    self._send(
+                        200,
+                        json.dumps(plane.top_snapshot(windows), indent=2),
+                        "application/json",
+                    )
                 elif self.path == "/recorder":
                     recorder = getattr(svc, "recorder", None)
                     if recorder is None:

@@ -31,6 +31,7 @@ from alaz_tpu.graph.snapshot import GraphBatch
 from alaz_tpu.logging import get_logger
 from alaz_tpu.obs.device import CompileEventPlane, DeviceTelemetry, bucket_key
 from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.scores import ScorePlane
 from alaz_tpu.obs.spans import SpanTracer
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges, ledger_gauges
 from alaz_tpu.utils.ledger import DropLedger
@@ -255,6 +256,23 @@ class Service:
             self.compile_plane = CompileEventPlane(
                 metrics=self.metrics, recorder=self.recorder
             ).start()
+        # score-plane observability (ISSUE 13, obs/scores.py): per-model
+        # distribution sketch, drift detection, top-K attribution —
+        # rides model_state like the compile plane (a non-scoring
+        # service has no scores to watch) and registers NOTHING when
+        # disabled (absent-not-zero). Serial + ShardedIngest paths share
+        # one accounting: both feed through record_window.
+        self.scores = ScorePlane(
+            metrics=self.metrics,
+            recorder=self.recorder,
+            enabled=(
+                model_state is not None and tcfg.enabled and tcfg.score_enabled
+            ),
+            model=self.config.model.model,
+            drift_windows=tcfg.score_drift_windows,
+            top_k=tcfg.score_top_k,
+            resolve=self.interner.lookup,
+        )
         self._export_backend = export_backend
         if export_backend is not None and getattr(
             export_backend, "ledger", None
@@ -671,15 +689,26 @@ class Service:
             """Per-window accounting + export — the ONE definition both
             the serial and batched paths share (their score parity is a
             tested invariant; two copies of this block could drift).
-            Times the export-ack leg and COMPLETES the window's span —
-            the last lifecycle stage, so completion lives here and only
-            here."""
+            Computes the sigmoid ONCE for the score plane and the export
+            leg, times the export-ack leg and COMPLETES the window's
+            span — the last lifecycle stage, so completion lives here
+            and only here."""
             self.scored_batches += 1
             self.scored_edges += batch.n_edges
             self.metrics.counter("scored.edges").inc(batch.n_edges)
+            scores = None
+            if self.scores.enabled or self.score_sink is not None:
+                n = batch.n_edges
+                scores = (1.0 / (1.0 + np.exp(-logits[:n]))).astype(np.float32)
+            # score plane (ISSUE 13): sketch + drift compare + top-K
+            # attribution, one vectorized pass per window — BOTH scorer
+            # paths (serial and vmapped group) land here, so the plane's
+            # accounting is identical under serial and sharded ingest
+            if scores is not None:
+                self.scores.observe_window(batch, scores)
             te0 = time_module.perf_counter()
             if self.score_sink is not None:
-                annotated = self._annotate(batch, logits)
+                annotated = self._annotate(batch, scores)
                 if len(annotated):
                     self.score_sink(annotated)
             self.tracer.observe(
@@ -907,13 +936,13 @@ class Service:
             return contextlib.nullcontext()
         return self.compile_plane.bucket(bucket_key(batch))
 
-    def _annotate(self, batch: GraphBatch, logits: np.ndarray) -> ScoreBatch:
+    def _annotate(self, batch: GraphBatch, scores: np.ndarray) -> ScoreBatch:
         """Columnar edge annotation: no per-edge Python objects on the
         return leg — the annotate path must sustain bench-rate edge
         throughput (the export backend resolves strings per unique node
-        at serialization time)."""
-        n = batch.n_edges
-        scores = (1.0 / (1.0 + np.exp(-logits[:n]))).astype(np.float32)
+        at serialization time). ``scores`` are the window's [0,1] edge
+        scores, computed ONCE in record_window and shared with the
+        score plane."""
         keep = np.flatnonzero(scores >= self.score_threshold)
         uids = batch.node_uids
         return ScoreBatch(
@@ -932,6 +961,18 @@ class Service:
         so every health PUT carries it — the observable that turns
         "windows stopped arriving" from a mystery into a diagnosis."""
         out: dict = {"ledger": self.ledger.snapshot()}
+        if self.scores.enabled:
+            # drift state rides the health payload (ISSUE 13): a node
+            # whose score distribution moved says so in every PUT, next
+            # to what it is losing
+            s = self.scores.snapshot()
+            out["scores"] = {
+                "drift_state": s["drift"]["state"],
+                "psi": s["drift"]["psi"],
+                "drift_events": s["drift"]["events"],
+                "rebaselines": s["drift"]["rebaselines"],
+                "windows": s["windows"],
+            }
         if self.sharded is not None:
             out["worker_restarts"] = self.sharded.worker_restarts
             out["last_wave_age_s"] = round(self.sharded.last_wave_age_s, 3)

@@ -342,6 +342,10 @@ def append_bench_history(out: dict, history_path: str = BENCH_HISTORY) -> None:
         "windows_closed": out.get("windows_closed"),
         "pad_waste_pct": out.get("pad_waste_pct"),
         "trace_overhead_pct": out.get("trace_overhead_pct"),
+        # ISSUE 13: the score-plane trajectory rides the same
+        # comparability keys (metric, rows, cpus) as everything else
+        "score_plane_overhead_pct": out.get("score_plane_overhead_pct"),
+        "drift_findings": out.get("drift_findings"),
         "stage_p99_ms": {
             s: v.get("p99_ms", 0.0)
             for s, v in out.get("stage_latency", {}).items()
@@ -392,7 +396,8 @@ def bench_ingest(args) -> dict:
     def run_once(trace: bool = True):
         """One serial pass. ``trace`` arms the span plane (the default,
         as in production); ``trace=False`` is the A/B arm that bounds
-        its cost. Returns (dt, windows, edges, tracer, pad_waste_pct)."""
+        its cost. Returns (dt, windows, edges, tracer, pad_waste_pct,
+        closed batches — the score-plane A/B replays them)."""
         from alaz_tpu.obs.spans import SpanTracer
 
         interner = Interner()
@@ -411,7 +416,7 @@ def bench_ingest(args) -> dict:
         store.flush()
         dt = time.perf_counter() - t0
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges, tracer, store.builder.pad_waste_pct
+        return dt, len(closed), edges, tracer, store.builder.pad_waste_pct, closed
 
     def run_once_sharded(n: int, trace: bool = True):
         """One sharded-pipeline pass (aggregator/sharded.py): same trace,
@@ -419,7 +424,7 @@ def bench_ingest(args) -> dict:
         the A/B arm bounding the span plane's cost on THIS pipeline —
         the headline arm under --workers, where N workers share one
         SpanTracer lock. Returns (wall, windows, edges, merge-stage
-        share of wall, tracer, pad_waste_pct)."""
+        share of wall, tracer, pad_waste_pct, closed batches)."""
         from alaz_tpu.aggregator.sharded import ShardedIngest
         from alaz_tpu.obs.spans import SpanTracer
 
@@ -445,7 +450,10 @@ def bench_ingest(args) -> dict:
         merge_share = pipe.merge_s / dt if dt > 0 else 0.0
         pipe.stop()
         edges = sum(b.n_edges for b in closed)
-        return dt, len(closed), edges, merge_share, pipe.tracer, pipe.builder.pad_waste_pct
+        return (
+            dt, len(closed), edges, merge_share, pipe.tracer,
+            pipe.builder.pad_waste_pct, closed,
+        )
 
     # the host path must never touch XLA: any compile during ingest is a
     # retrace regression (a jit leaking into the hot loop), so the
@@ -525,7 +533,7 @@ def bench_ingest(args) -> dict:
             best, best_off, scaling, sharded_off = measure()
     else:
         best, best_off, scaling, sharded_off = measure()
-    dt, n_windows, n_edges, tracer, pad_waste_pct = best
+    dt, n_windows, n_edges, tracer, pad_waste_pct, closed_windows = best
     serial_rows_per_s = n_rows / dt
     rows_per_s = serial_rows_per_s
     # spans-on vs spans-off A/B (ISSUE 9): positive = tracing costs
@@ -550,6 +558,7 @@ def bench_ingest(args) -> dict:
         dt, n_windows, n_edges = head[0], head[1], head[2]
         tracer = head[4]  # the sharded pipeline's span plane
         pad_waste_pct = head[5]
+        closed_windows = head[6]
         # the published overhead must describe the HEADLINE arm: under
         # --workers that is the sharded pipeline, so the serial A/B
         # above is superseded by the sharded on/off pair
@@ -584,6 +593,47 @@ def bench_ingest(args) -> dict:
     print(
         f"# ingest rows={n_rows} windows_closed={n_windows} agg_edges={n_edges} "
         f"wall={dt*1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    # score-plane A/B (ISSUE 13): replay the HEADLINE run's emitted
+    # windows through the plane (deterministic feature-space scorer,
+    # identical in both arms) with the plane armed vs killed — the arm
+    # delta over the ingest wall bounds what per-window sketch + drift
+    # compare + top-K attribution cost the pipeline (expected ≤2%, next
+    # to trace_overhead_pct). The armed pass also reports
+    # drift_findings: drift events on the CLEAN synthetic trace,
+    # expected 0 — a monitor that pages on steady traffic is broken.
+    from alaz_tpu.obs.scores import ScorePlane, feature_scores
+
+    def score_plane_pass(enabled: bool):
+        plane = ScorePlane(
+            enabled=enabled, model="bench", drift_windows=4, top_k=10
+        )
+        t0 = time.perf_counter()
+        for b in closed_windows:
+            plane.observe_window(b, feature_scores(b))
+        return time.perf_counter() - t0, plane
+
+    score_plane_pass(True)  # warm the table/allocator before timing
+    plane_on = None
+    t_on = t_off = float("inf")
+    for i in range(5):  # best-of-5 per arm (passes are ~ms), alternating
+        if i % 2 == 0:
+            a, _ = score_plane_pass(False)
+            b_, plane_on_i = score_plane_pass(True)
+        else:
+            b_, plane_on_i = score_plane_pass(True)
+            a, _ = score_plane_pass(False)
+        if a < t_off:
+            t_off = a
+        if b_ < t_on:
+            t_on, plane_on = b_, plane_on_i
+    score_plane_overhead_pct = max(0.0, (t_on - t_off) / dt * 100.0) if dt > 0 else 0.0
+    drift_findings = plane_on.drift_events
+    print(
+        f"# score plane A/B: on={t_on*1e3:.1f}ms off={t_off*1e3:.1f}ms "
+        f"overhead={score_plane_overhead_pct:.2f}% of ingest wall; "
+        f"drift_findings={drift_findings}",
         file=sys.stderr,
     )
     # ABI parity rides along like the compile count: the measured binary
@@ -681,6 +731,11 @@ def bench_ingest(args) -> dict:
         "race_runtime_s": race_runtime_s,
         "stage_latency": stage_latency,
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        # score-plane cost + clean-trace drift silence (ISSUE 13): the
+        # plane's per-window pass as a share of the ingest wall
+        # (expected ≤2) and drift events on the clean seed (expected 0)
+        "score_plane_overhead_pct": round(score_plane_overhead_pct, 2),
+        "drift_findings": drift_findings,
         # bucket-padding waste of the headline pipeline (ISSUE 11): the
         # share of assembled edge slots that were pad — the TPU-native
         # efficiency number the bucketed-CSR/Pallas work will be judged

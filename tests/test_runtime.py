@@ -437,9 +437,12 @@ class TestColumnarScoreLeg:
             edge_type=rng.integers(1, 9, n).astype(np.int32),
             window_start_ms=1000,
         )
+        # _annotate takes [0,1] scores since ISSUE 13 (the sigmoid is
+        # computed once in record_window, shared with the score plane)
         logits = rng.normal(size=n).astype(np.float32)
+        scores = (1.0 / (1.0 + np.exp(-logits))).astype(np.float32)
         t0 = time.perf_counter()
-        out = svc._annotate(batch, logits)
+        out = svc._annotate(batch, scores)
         dt = time.perf_counter() - t0
         assert dt < 1.0, f"annotate took {dt:.3f}s for 1M edges"
         # threshold filters: sigmoid(x) >= 0.9 is rare for N(0,1) logits

@@ -1,0 +1,714 @@
+"""Score-plane observability tests (ISSUE 13, alaz_tpu/obs/scores.py).
+
+Covers the acceptance list: sketch merge associativity/commutativity in
+score space, bucketizer parity with the Histogram bisect, PSI/L∞
+hysteresis (no flap at the threshold), churn-triggered rebaselining,
+top-K attribution ledger boundedness under the 500k hot-key fan-in,
+serial-vs-ShardedIngest identical score-plane accounting, the /scores
+endpoint discipline (404 disabled, 400 malformed, bounded responses),
+and the absent-not-zero registration contract.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from bisect import bisect_left
+
+import numpy as np
+import pytest
+
+import jax
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.aggregator.sharded import ShardedIngest
+from alaz_tpu.config import ModelConfig, RuntimeConfig, TraceConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.obs.histogram import Histogram
+from alaz_tpu.obs.recorder import FlightRecorder
+from alaz_tpu.obs.scores import (
+    DRIFTED,
+    SCORE_BOUNDS,
+    STABLE,
+    DriftDetector,
+    ScorePlane,
+    cdf_linf,
+    feature_scores,
+    psi,
+    score_bucket_counts,
+)
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.runtime.metrics import Metrics
+from alaz_tpu.runtime.service import Service
+
+
+def _mk_batch(uids, n_edges, seed=0, window_start_ms=1000, err_rate=0.0):
+    """A GraphBatch over nodes `uids` with n_edges random edges and
+    edge features shaped like assembly's (count in col 0, err in 3)."""
+    rng = np.random.default_rng(seed)
+    n = len(uids)
+    node_feats = rng.normal(size=(n, 32)).astype(np.float32)
+    node_type = np.zeros(n, dtype=np.int32)
+    src = rng.integers(0, n, n_edges).astype(np.int32)
+    dst = rng.integers(0, n, n_edges).astype(np.int32)
+    etype = rng.integers(1, 9, n_edges).astype(np.int32)
+    ef = np.zeros((n_edges, 16), dtype=np.float32)
+    ef[:, 0] = np.log1p(rng.integers(50, 150, n_edges)).astype(np.float32)
+    ef[:, 1] = 0.5
+    ef[:, 3] = err_rate
+    return GraphBatch.build(
+        node_feats=node_feats,
+        node_type=node_type,
+        edge_src=src,
+        edge_dst=dst,
+        edge_type=etype,
+        edge_feats=ef,
+        node_uids=np.asarray(uids, dtype=np.int32),
+        window_start_ms=window_start_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The score-space ladder + sketch
+# ---------------------------------------------------------------------------
+
+
+class TestScoreLadder:
+    def test_bounds_strictly_increasing_and_closed_on_unit_interval(self):
+        assert all(b2 > b1 for b1, b2 in zip(SCORE_BOUNDS, SCORE_BOUNDS[1:]))
+        assert SCORE_BOUNDS[0] > 0.0
+        assert SCORE_BOUNDS[-1] == 1.0
+
+    def test_bucketizer_parity_with_bisect_over_rungs_and_randoms(self):
+        """The table bucketizer IS bisect_left on the ladder for every
+        in-domain value: the rungs themselves, their float neighbors on
+        both sides, their float32 roundings, and a random sweep."""
+        rng = np.random.default_rng(0)
+        vals = [0.0, 1.0, 0.5]
+        for b in SCORE_BOUNDS:
+            vals += [
+                b,
+                float(np.nextafter(b, 0.0)),
+                float(np.nextafter(min(b, 1.0), 1.0)),
+                min(float(np.float32(b)), 1.0),
+            ]
+        vals = np.array(vals + list(rng.random(20_000)), dtype=np.float64)
+        expect = np.bincount(
+            [bisect_left(SCORE_BOUNDS, v) for v in vals],
+            minlength=len(SCORE_BOUNDS) + 1,
+        )
+        assert (score_bucket_counts(vals) == expect).all()
+
+    def test_bucketizer_parity_float32(self):
+        rng = np.random.default_rng(1)
+        v32 = rng.random(20_000).astype(np.float32)
+        expect = np.bincount(
+            [bisect_left(SCORE_BOUNDS, float(np.float64(v))) for v in v32],
+            minlength=len(SCORE_BOUNDS) + 1,
+        )
+        assert (score_bucket_counts(v32) == expect).all()
+
+    def test_out_of_domain_clamps_into_end_buckets(self):
+        counts = score_bucket_counts(np.array([-0.5, 2.0]))
+        assert counts[0] == 1  # negative → bottom bucket
+        assert counts[len(SCORE_BOUNDS) - 1] == 1  # >1 → the 1.0 bucket
+        assert counts.sum() == 2
+
+    def test_add_counts_equals_per_value_observe(self):
+        rng = np.random.default_rng(2)
+        vals = rng.random(5_000)
+        h_one = Histogram("a", bounds=SCORE_BOUNDS)
+        for v in vals:
+            h_one.observe(v)
+        h_bulk = Histogram("b", bounds=SCORE_BOUNDS)
+        h_bulk.add_counts(
+            score_bucket_counts(vals).tolist(), float(vals.sum())
+        )
+        assert h_bulk.bucket_counts() == h_one.bucket_counts()
+        assert h_bulk.total_count == h_one.total_count
+        assert h_bulk.total_sum == pytest.approx(h_one.total_sum)
+
+    def test_add_counts_rejects_wrong_length(self):
+        with pytest.raises(ValueError):
+            Histogram("x", bounds=SCORE_BOUNDS).add_counts([1, 2, 3], 0.5)
+
+    def test_sketch_merge_associative_and_commutative_in_score_space(self):
+        """The Histogram merge contract holds on the score ladder: any
+        merge order over per-window sketches gives one fleet view."""
+        rng = np.random.default_rng(3)
+        parts = []
+        for i in range(4):
+            h = Histogram(f"w{i}", bounds=SCORE_BOUNDS)
+            vals = rng.random(1000) ** (i + 1)  # different shapes
+            h.add_counts(score_bucket_counts(vals).tolist(), float(vals.sum()))
+            parts.append(h)
+
+        def fold(order):
+            out = Histogram("m", bounds=SCORE_BOUNDS)
+            for i in order:
+                out.merge(parts[i])
+            return out
+
+        a = fold([0, 1, 2, 3])
+        b = fold([3, 1, 0, 2])
+        # associativity: ((0+1)+(2+3)) vs the linear folds
+        left = Histogram("l", bounds=SCORE_BOUNDS).merge(parts[0]).merge(parts[1])
+        right = Histogram("r", bounds=SCORE_BOUNDS).merge(parts[2]).merge(parts[3])
+        c = left.merge(right)
+        assert a.bucket_counts() == b.bucket_counts() == c.bucket_counts()
+        assert a.snapshot() == b.snapshot() == c.snapshot()
+
+    def test_score_ladder_rejects_merge_with_latency_ladder(self):
+        with pytest.raises(ValueError):
+            Histogram("s", bounds=SCORE_BOUNDS).merge(Histogram("t"))
+
+
+# ---------------------------------------------------------------------------
+# Distribution distances + drift state machine
+# ---------------------------------------------------------------------------
+
+
+class TestDriftDetector:
+    def test_psi_and_ks_zero_on_identical_large_on_shifted(self):
+        a = np.zeros(29, dtype=np.int64)
+        a[5] = 800
+        a[10] = 200
+        b = np.zeros(29, dtype=np.int64)
+        b[20] = 800
+        b[24] = 200
+        assert psi(a, a) == pytest.approx(0.0, abs=1e-9)
+        assert cdf_linf(a, a) == 0.0
+        assert psi(a, b) > 2.0
+        assert cdf_linf(a, b) == pytest.approx(1.0, abs=0.01)
+        assert cdf_linf(np.zeros(29), a) == 0.0  # empty ref: no signal
+
+    def test_min_ref_warmup_before_any_compare(self):
+        d = DriftDetector(window=3)
+        c = np.zeros(29, dtype=np.int64)
+        c[5] = 100
+        for _ in range(3):
+            assert d.update(c)["compared"] is False
+        assert d.update(c)["compared"] is True
+
+    def _counts(self, bucket, n=1000):
+        c = np.zeros(29, dtype=np.int64)
+        c[bucket] = n
+        return c
+
+    def test_flip_on_sustained_shift_and_recovery(self):
+        d = DriftDetector(window=2, min_ref=2, hysteresis=2)
+        for _ in range(2):
+            d.update(self._counts(5))
+        r = d.update(self._counts(20))
+        assert r["compared"] and r["flipped"] is None  # hysteresis 1/2
+        r = d.update(self._counts(20))
+        assert r["flipped"] == "drifted" and d.state == DRIFTED
+        assert d.flips == 1
+        # the trailing reference absorbs the new regime → recovery
+        flips = [d.update(self._counts(20))["flipped"] for _ in range(4)]
+        assert "stable" in flips and d.state == STABLE
+
+    def test_no_flap_hovering_at_the_threshold(self):
+        """A PSI alternating just above/below the enter threshold never
+        reaches `hysteresis` consecutive over-windows → no flip; inside
+        the hysteresis band (under enter, over exit) nothing moves
+        either direction."""
+        # long reference window: 40 base windows dominate it, so the
+        # alternating windows barely move it — each hot window reads
+        # over the enter threshold, each base window under it
+        d = DriftDetector(window=40, min_ref=10, hysteresis=2)
+        base = self._counts(5, 1000)
+        hot = self._counts(5, 1000)
+        hot[6] = 600  # reshapes ~40% of mass one rung over
+        for _ in range(40):
+            d.update(base)
+        psis = []
+        for c in [hot, base, hot, base, hot, base]:
+            psis.append(d.update(c)["psi"])
+        assert max(psis) > d.enter_psi  # over-threshold windows happened
+        assert min(psis) < d.enter_psi  # ...interleaved with clean ones
+        assert d.state == STABLE and d.flips == 0  # never 2-in-a-row
+        # the same shift SUSTAINED does flip: hysteresis delays, not
+        # deafens
+        d.update(hot)
+        d.update(hot)
+        assert d.state == DRIFTED and d.flips == 1
+
+    def test_rebaseline_resets_reference_state_and_counters(self):
+        d = DriftDetector(window=2, min_ref=2, hysteresis=1)
+        for _ in range(2):
+            d.update(self._counts(5))
+        d.update(self._counts(20))
+        assert d.state == DRIFTED
+        d.rebaseline()
+        assert d.state == STABLE
+        assert d.reference_windows == 0
+        assert d.rebaselines == 1
+        # post-rebaseline: accumulates min_ref before judging again
+        assert d.update(self._counts(20))["compared"] is False
+
+
+# ---------------------------------------------------------------------------
+# ScorePlane: observe, churn, drift events, attribution, registration
+# ---------------------------------------------------------------------------
+
+
+class TestScorePlane:
+    def test_disabled_plane_is_inert_and_registers_nothing(self):
+        m = Metrics()
+        plane = ScorePlane(metrics=m, enabled=False, model="x")
+        plane.observe_window(_mk_batch(range(100, 120), 50), np.full(50, 0.3))
+        assert plane.windows == 0
+        snap = m.snapshot()
+        assert not any(k.startswith("scores.") for k in snap)
+        assert "scores" not in m.render_prometheus()
+
+    def test_sketch_absent_until_first_window_then_present(self):
+        m = Metrics()
+        plane = ScorePlane(metrics=m, enabled=True, model="m1")
+        # sparse sketch: absent while empty (gauges/counters register
+        # eagerly like the device plane's — at their zero values)
+        assert "scores.dist.m1.count" not in m.snapshot()
+        assert "alaz_tpu_scores_dist_m1_bucket" not in m.render_prometheus()
+        b = _mk_batch(range(100, 130), 200)
+        plane.observe_window(b, feature_scores(b))
+        snap = m.snapshot()
+        assert snap["scores.dist.m1.count"] == 200
+        assert snap["scores.windows"] == 1
+        assert "alaz_tpu_scores_dist_m1_bucket" in m.render_prometheus()
+
+    def test_summary_gauges_track_last_window(self):
+        m = Metrics()
+        plane = ScorePlane(metrics=m, enabled=True, model="m2")
+        b = _mk_batch(range(50, 90), 300)
+        s = np.linspace(0.1, 0.9, 300).astype(np.float32)
+        plane.observe_window(b, s)
+        snap = m.snapshot()
+        assert snap["scores.window_mean"] == pytest.approx(float(s.mean()), abs=1e-3)
+        assert snap["scores.window_max"] == pytest.approx(0.9, abs=1e-4)
+        # p99 is sketch-resolution: within the containing rung's band
+        assert 0.75 <= snap["scores.window_p99"] <= 1.0
+        assert snap["scores.scored_nodes"] > 0
+        assert snap["scores.drift_state"] == 0.0
+
+    def test_distribution_shift_raises_drift_event_and_recorder_trail(self):
+        rec = FlightRecorder(capacity=64)
+        m = Metrics()
+        plane = ScorePlane(
+            metrics=m, recorder=rec, enabled=True, model="m3",
+            drift_windows=2, min_ref=2, hysteresis=1,
+        )
+        uids = range(200, 260)
+        for w in range(3):
+            b = _mk_batch(uids, 400, seed=w, window_start_ms=1000 * (w + 1))
+            plane.observe_window(b, feature_scores(b))
+        assert plane.drift_events == 0  # steady traffic: silent
+        hot = _mk_batch(uids, 400, seed=9, window_start_ms=5000, err_rate=1.0)
+        plane.observe_window(hot, feature_scores(hot))
+        assert plane.drift_events == 1
+        assert m.snapshot()["scores.drift_events"] == 1
+        assert m.snapshot()["scores.drift_state"] == 1.0
+        evs = [e for e in rec.events() if e["kind"] == "score_drift"]
+        assert len(evs) == 1 and evs[0]["state"] == "drifted"
+        assert evs[0]["psi"] > 0.25
+
+    def test_node_churn_rebaselines_instead_of_paging(self):
+        rec = FlightRecorder(capacity=64)
+        m = Metrics()
+        plane = ScorePlane(
+            metrics=m, recorder=rec, enabled=True, model="m4",
+            drift_windows=2, min_ref=2, hysteresis=1,
+        )
+        for w in range(3):
+            b = _mk_batch(range(100, 160), 300, seed=w, window_start_ms=1000 * w)
+            plane.observe_window(b, feature_scores(b))
+        # rollout: every uid replaced, identical traffic shape
+        b = _mk_batch(range(900, 960), 300, seed=1, window_start_ms=9000)
+        plane.observe_window(b, feature_scores(b))
+        assert plane.rebaselines == 1
+        assert plane.drift_events == 0
+        assert m.snapshot()["scores.rebaselines"] == 1
+        evs = [e for e in rec.events() if e["kind"] == "score_rebaseline"]
+        assert len(evs) == 1 and evs[0]["churn"] > 0.9
+        # reference refills before judging resumes: the next (new-uid)
+        # windows stay silent even though they differ from pre-rollout
+        for w in range(2):
+            b = _mk_batch(range(900, 960), 300, seed=w, window_start_ms=11000 + w)
+            plane.observe_window(b, feature_scores(b))
+        assert plane.drift_events == 0
+
+    def test_rollout_across_an_empty_window_still_rebaselines(self):
+        """Review regression: a traffic gap (zero-edge window) between
+        the old and new regimes must not become the churn baseline —
+        the rollout on its far side still compares old-vs-new uids and
+        rebaselines instead of paging as drift."""
+        plane = ScorePlane(
+            enabled=True, model="m5", drift_windows=2, min_ref=2, hysteresis=1,
+        )
+        for w in range(3):
+            b = _mk_batch(range(100, 160), 300, seed=w, window_start_ms=1000 * w)
+            plane.observe_window(b, feature_scores(b))
+        # the cutover gap: a window with no edges at all
+        gap = _mk_batch(range(100, 101), 1, seed=0, window_start_ms=4000)
+        gap.n_edges = 0
+        plane.observe_window(gap, np.empty(0, dtype=np.float32))
+        # the new regime: every uid replaced
+        b = _mk_batch(range(900, 960), 300, seed=1, window_start_ms=5000)
+        plane.observe_window(b, feature_scores(b))
+        assert plane.rebaselines == 1
+        assert plane.drift_events == 0
+
+    def test_resolver_failure_falls_back_to_uid(self):
+        def bad_resolve(uid):
+            raise KeyError(uid)
+
+        plane = ScorePlane(enabled=True, top_k=3, resolve=bad_resolve)
+        b = _mk_batch(range(10, 30), 100)
+        plane.observe_window(b, feature_scores(b))
+        top = plane.top_snapshot(1)
+        assert top and all(isinstance(n["uid"], int) for n in top[0]["nodes"])
+
+
+class TestTopKLedger:
+    def test_bounded_under_500k_hot_key_fanin(self):
+        """The acceptance bound: one dst with 500k in-edges — the entry
+        stays K nodes × top_edges edges, the ring stays `ledger_windows`
+        deep, and the pass completes in interactive time."""
+        n_edges = 500_000
+        n_nodes = 1000
+        rng = np.random.default_rng(0)
+        node_feats = rng.normal(size=(n_nodes, 32)).astype(np.float32)
+        src = rng.integers(0, n_nodes, n_edges).astype(np.int32)
+        dst = np.full(n_edges, 7, dtype=np.int32)  # the hot key
+        dst[: n_nodes * 4] = rng.integers(0, n_nodes, n_nodes * 4)
+        ef = np.zeros((n_edges, 16), dtype=np.float32)
+        ef[:, 0] = 0.7
+        batch = GraphBatch.build(
+            node_feats=node_feats,
+            node_type=np.zeros(n_nodes, dtype=np.int32),
+            edge_src=src,
+            edge_dst=dst,
+            edge_type=np.ones(n_edges, dtype=np.int32),
+            edge_feats=ef,
+            node_uids=np.arange(1, n_nodes + 1, dtype=np.int32),
+            window_start_ms=1000,
+        )
+        plane = ScorePlane(enabled=True, top_k=10, top_edges=3, ledger_windows=4)
+        scores = rng.random(n_edges).astype(np.float32)
+        t0 = time.perf_counter()
+        for w in range(6):  # more windows than the ring holds
+            batch.window_start_ms = 1000 * (w + 1)
+            plane.observe_window(batch, scores)
+        dt = time.perf_counter() - t0
+        assert dt < 5.0, f"500k-fan-in ledger pass took {dt:.2f}s"
+        top = plane.top_snapshot(100)  # ask for more than the ring holds
+        assert len(top) == 4  # bounded by ledger_windows
+        for entry in top:
+            assert len(entry["nodes"]) <= 10
+            for node in entry["nodes"]:
+                assert len(node["top_in_edges"]) <= 3
+        # the hot key is the top node, its true fan-in reported
+        hot = top[0]["nodes"][0]
+        assert hot["in_edges_seen"] > 400_000
+        # newest first
+        assert top[0]["window_start_ms"] > top[-1]["window_start_ms"]
+
+    def test_sorted_fast_path_matches_unsorted_general_path(self):
+        """GraphBatch edges arrive dst-sorted (reduceat path); a
+        hand-built unsorted batch must attribute identically through
+        the maximum.at fallback."""
+        rng = np.random.default_rng(4)
+        b = _mk_batch(range(100, 140), 500, seed=4)
+        scores = rng.random(500).astype(np.float32)
+        plane_sorted = ScorePlane(enabled=True, top_k=5)
+        plane_sorted.observe_window(b, scores)
+
+        perm = rng.permutation(500)
+        shuffled = GraphBatch.build(
+            node_feats=b.node_feats[: b.n_nodes].copy(),
+            node_type=b.node_type[: b.n_nodes].copy(),
+            edge_src=b.edge_src[:500][perm].copy(),
+            edge_dst=b.edge_dst[:500][perm].copy(),
+            edge_type=b.edge_type[:500][perm].copy(),
+            edge_feats=b.edge_feats[:500][perm].copy(),
+            node_uids=b.node_uids[: b.n_nodes].copy(),
+            window_start_ms=b.window_start_ms,
+            sort_by_dst=False,  # leaves the edge list unsorted
+        )
+        assert np.any(np.diff(shuffled.edge_dst[:500]) < 0)
+        plane_unsorted = ScorePlane(enabled=True, top_k=5)
+        plane_unsorted.observe_window(shuffled, scores[perm])
+        a = plane_sorted.top_snapshot(1)[0]
+        c = plane_unsorted.top_snapshot(1)[0]
+        assert [n["uid"] for n in a["nodes"]] == [n["uid"] for n in c["nodes"]]
+        assert [n["score"] for n in a["nodes"]] == [n["score"] for n in c["nodes"]]
+        assert [n["in_edges_seen"] for n in a["nodes"]] == [
+            n["in_edges_seen"] for n in c["nodes"]
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Serial vs ShardedIngest: one score-plane accounting
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineEquivalence:
+    def _drive_serial(self, ev, msgs, interner):
+        closed = []
+        store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        for i in range(0, ev.shape[0], 1 << 14):
+            agg.process_l7(ev[i : i + (1 << 14)], now_ns=10_000_000_000)
+        store.flush()
+        return closed
+
+    def _drive_sharded(self, ev, msgs, interner, n):
+        closed = []
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            n, interner=interner, cluster=cluster, window_s=1.0,
+            on_batch=closed.append, queue_events=1 << 20,
+        )
+        try:
+            for i in range(0, ev.shape[0], 1 << 14):
+                pipe.process_l7(ev[i : i + (1 << 14)], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        return closed
+
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_sharded_plane_accounting_identical_to_serial(self, workers):
+        """Windows are bit-identical serial vs sharded (the PR 5
+        property), so the plane folding them must agree EXACTLY:
+        sketch bucket counts, drift trajectory, summary, ledger."""
+        ev, msgs = make_ingest_trace(60_000, windows=6)
+
+        def plane_over(closed):
+            plane = ScorePlane(
+                enabled=True, model="eq", drift_windows=2, min_ref=2,
+                hysteresis=1, top_k=5,
+            )
+            trail = []
+            for b in closed:
+                plane.observe_window(b, feature_scores(b))
+                d = plane.snapshot()["drift"]
+                trail.append((d["psi"], d["state"], d["events"]))
+            return plane, trail
+
+        s_closed = self._drive_serial(ev, msgs, Interner())
+        p_serial, t_serial = plane_over(s_closed)
+        w_closed = self._drive_sharded(ev, msgs, Interner(), workers)
+        p_shard, t_shard = plane_over(w_closed)
+        assert len(s_closed) == len(w_closed)
+        assert p_serial.hist.bucket_counts() == p_shard.hist.bucket_counts()
+        assert t_serial == t_shard
+        a, b = p_serial.snapshot(), p_shard.snapshot()
+        assert a["last_window"] == b["last_window"]
+        assert a["dist"] == b["dist"]
+        assert a["drift"] == b["drift"]
+
+
+# ---------------------------------------------------------------------------
+# Scenario drift gates (the fixed-seed contract `make scenarios` runs)
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioDriftGates:
+    def test_retry_storm_trips_drift_within_lag(self):
+        from alaz_tpu.replay.incidents import run_host_leg
+
+        findings = []
+        rec = run_host_leg("retry_storm", seed=0, findings=findings)
+        assert findings == []
+        sp = rec["score_plane"]
+        assert sp["drift_events"] >= 1
+        assert sp["first_drift_window"] <= 4
+
+    def test_deploy_rollout_rebaselines_without_false_alarm(self):
+        from alaz_tpu.replay.incidents import run_host_leg
+
+        findings = []
+        rec = run_host_leg("deploy_rollout", seed=0, findings=findings)
+        assert findings == []
+        sp = rec["score_plane"]
+        assert sp["rebaselines"] >= 1
+        assert sp["drift_events"] == 0
+
+    def test_clean_traffic_stays_drift_silent(self):
+        """The bench's drift_findings gate in miniature: steady
+        synthetic traffic through the plane raises nothing."""
+        ev, msgs = make_ingest_trace(40_000, windows=6)
+        interner = Interner()
+        closed = []
+        store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        for i in range(0, 40_000, 1 << 14):
+            agg.process_l7(ev[i : i + (1 << 14)], now_ns=10_000_000_000)
+        store.flush()
+        plane = ScorePlane(
+            enabled=True, drift_windows=2, min_ref=1, hysteresis=1
+        )
+        for b in closed:
+            plane.observe_window(b, feature_scores(b))
+        assert plane.drift_events == 0
+        assert plane.rebaselines == 0
+
+
+# ---------------------------------------------------------------------------
+# The scoring Service end to end + endpoint discipline
+# ---------------------------------------------------------------------------
+
+
+def _scoring_service(hidden: int, score_enabled: bool = True) -> Service:
+    cfg = RuntimeConfig(
+        model=ModelConfig(model="graphsage", hidden_dim=hidden, use_pallas=False),
+        trace=TraceConfig(score_enabled=score_enabled, score_drift_windows=4),
+    )
+    init, _ = get_model("graphsage")
+    params = init(jax.random.PRNGKey(0), cfg.model)
+    return Service(
+        config=cfg, interner=Interner(), model_state=params, score_threshold=0.0
+    )
+
+
+def _drive_windows(svc: Service, n_windows: int = 3) -> None:
+    svc.start()
+    try:
+        w_ms = 1000
+        for w in range(n_windows):
+            b = _mk_batch(range(100, 150), 300, seed=w, window_start_ms=w_ms)
+            svc.window_queue.put_nowait_drop([b])
+            w_ms += 1000
+        svc.drain(timeout_s=30)
+    finally:
+        svc.stop()
+
+
+class TestServiceEndToEnd:
+    def test_plane_accounting_matches_scorer_and_rides_surfaces(self):
+        svc = _scoring_service(hidden=36)
+        assert svc.scores.enabled
+        _drive_windows(svc, 3)
+        assert svc.scored_batches == 3
+        assert svc.scores.windows == 3
+        snap = svc.metrics.snapshot()
+        assert snap["scores.windows"] == 3
+        # sketch count == every scored edge (the plane sees what the
+        # export leg sees)
+        assert snap[f"scores.dist.{svc.config.model.model}.count"] == svc.scored_edges
+        # degraded snapshot carries the drift state for health PUTs
+        deg = svc.degraded_snapshot()
+        assert deg["scores"]["windows"] == 3
+        assert deg["scores"]["drift_state"] in ("stable", "drifted")
+        top = svc.scores.top_snapshot(1)
+        assert top and top[0]["nodes"], "attribution ledger empty"
+        # uid resolution went through the interner-or-fallback path
+        assert all(
+            isinstance(n["uid"], (int, str)) for n in top[0]["nodes"]
+        )
+
+    def test_kill_switches_disable_the_plane(self):
+        svc = _scoring_service(hidden=37, score_enabled=False)
+        assert not svc.scores.enabled
+        _drive_windows(svc, 1)
+        assert svc.scored_batches == 1
+        assert svc.scores.windows == 0
+        assert not any(k.startswith("scores.") for k in svc.metrics.snapshot())
+        # master switch: TRACE_ENABLED=0 silences the score plane too
+        cfg = RuntimeConfig(
+            model=ModelConfig(model="graphsage", hidden_dim=38, use_pallas=False),
+            trace=TraceConfig(enabled=False),
+        )
+        init, _ = get_model("graphsage")
+        params = init(jax.random.PRNGKey(0), cfg.model)
+        svc2 = Service(config=cfg, interner=Interner(), model_state=params)
+        assert not svc2.scores.enabled
+        # no model ⇒ nothing to watch ⇒ disabled
+        assert not Service(interner=Interner()).scores.enabled
+
+
+class TestScoresEndpoints:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_scores_endpoints_discipline(self):
+        from alaz_tpu.runtime.debug_http import DebugServer
+
+        svc = _scoring_service(hidden=39)
+        _drive_windows(svc, 3)
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            code, body = self._get(port, "/scores")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["windows"] == 3
+            assert snap["drift"]["state"] in ("stable", "drifted")
+            assert snap["dist"]["count"] == svc.scored_edges
+            code, body = self._get(port, "/scores/top?windows=2")
+            assert code == 200
+            entries = json.loads(body)
+            assert len(entries) == 2
+            # malformed params 400 BEFORE side effects; response bounded
+            before = svc.recorder.recorded
+            for bad in ("banana", "1.5", "-3"):
+                code, _ = self._get(port, f"/scores/top?windows={bad}")
+                assert code == 400, bad
+            assert svc.recorder.recorded == before
+            # an oversized ask is bounded by the ledger ring
+            code, body = self._get(port, "/scores/top?windows=1000000")
+            assert code == 200
+            assert len(json.loads(body)) <= 32
+            # /stats carries the plane summary beside the device plane
+            code, body = self._get(port, "/stats")
+            assert json.loads(body)["scores"]["windows"] == 3
+        finally:
+            server.stop()
+            # service already stopped by _drive_windows
+
+    def test_disabled_plane_404s(self):
+        from alaz_tpu.runtime.debug_http import DebugServer
+
+        svc = Service(interner=Interner())  # no model → plane disabled
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            assert self._get(port, "/scores")[0] == 404
+            assert self._get(port, "/scores/top")[0] == 404
+            code, body = self._get(port, "/stats")
+            assert code == 200
+            assert "scores" not in json.loads(body)
+        finally:
+            server.stop()
+
+
+class TestFeatureScores:
+    def test_deterministic_and_monotone_in_error_rate(self):
+        b = _mk_batch(range(10, 40), 200, seed=7)
+        s1, s2 = feature_scores(b), feature_scores(b)
+        assert (s1 == s2).all()
+        assert s1.dtype == np.float32
+        assert float(s1.min()) >= 0.0 and float(s1.max()) <= 1.0
+        hot = _mk_batch(range(10, 40), 200, seed=7, err_rate=1.0)
+        assert float(feature_scores(hot).mean()) > float(s1.mean()) + 0.2
