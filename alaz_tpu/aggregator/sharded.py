@@ -362,7 +362,7 @@ class ShardedIngest:
             self.ledger.recorder = recorder
         # chaos seam: called as fault_hook(worker_idx, kind) at item
         # boundaries on the worker thread; may raise WorkerCrash or stall
-        self.fault_hook = fault_hook
+        self.fault_hook = fault_hook  # lockless-ok: attach-once chaos seam (wiring or harness, before traffic flows); workers null-check an atomic reference read
         # scatter backpressure bound: a producer blocks at most this long
         # on a backlogged shard queue before the rows shed to the ledger
         # (a stalled/dead worker must not wedge the submitting thread)

@@ -36,6 +36,14 @@ def main(argv=None) -> int:
         "named incident scenario's host leg with the chaos seams armed "
         "on top — 'hot-key during a degraded delivery'. 'none' skips it",
     )
+    p.add_argument(
+        "--tenants", action="store_true",
+        help="also run the two-tenant incident+worker-kill composition "
+        "(ISSUE 14): K=2 tenants, sharded partitions, hot_key incident "
+        "AND chaos crashes on ONE tenant's pool — per-tenant ledger "
+        "conservation must hold EXACTLY through the kills, and the "
+        "clean tenant's latency/drift gates stay ON",
+    )
     args = p.parse_args(argv)
 
     failed = 0
@@ -62,6 +70,30 @@ def main(argv=None) -> int:
         )
         print(json.dumps(srep.as_dict(), sort_keys=True))
         if not srep.ok:
+            failed += 1
+    if args.tenants:
+        from alaz_tpu.replay.tenants import run_isolation_scenario
+
+        trep = run_isolation_scenario(
+            tenants=2,
+            seed=args.seeds[0],
+            incident="hot_key",
+            ingest_workers=args.workers,
+            # paced (default): the clean tenant's latency/drift gates
+            # stay ON — incident + chaos on one fleet must not move the
+            # other (the ISSUE 14 acceptance combination); kills arm
+            # only on the perturbed tenant's pool
+            chaos=ChaosConfig(
+                enabled=True,
+                seed=args.seeds[0],
+                # boosted crash pressure: the composition exists to
+                # prove conservation THROUGH kills, so make them likely
+                worker_crash_prob=0.05,
+                worker_max_crashes=4,
+            ),
+        )
+        print(json.dumps(trep.as_dict(), sort_keys=True))
+        if not trep.ok:
             failed += 1
     if failed:
         print(f"# {failed} seed(s) with findings", file=sys.stderr)

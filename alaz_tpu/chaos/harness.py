@@ -97,13 +97,10 @@ class ChaosReport:
 
 
 def emitted_rows(batches) -> int:
-    """Rows aggregated into emitted GraphBatches: edge feature 0 is
-    log1p(request count), so the inverse transform recovers the exact
-    integer row count per edge (the sanitize suite's accounting trick)."""
-    return sum(
-        int(np.rint(np.expm1(b.edge_feats[: b.n_edges, 0])).sum())
-        for b in batches
-    )
+    """Rows aggregated into emitted GraphBatches — the shared
+    ``GraphBatch.aggregated_rows`` inverse-log1p measure, summed (the
+    sanitize suite's accounting trick)."""
+    return sum(b.aggregated_rows() for b in batches)
 
 
 def _run_pipeline_leg(
@@ -283,14 +280,14 @@ class _CountingSink:
         self.ledger = ledger
         self.rows = 0
 
-    def submit_l7(self, batch) -> bool:
+    def submit_l7(self, batch, tenant: int = 0) -> bool:
         self.rows += int(batch.shape[0])
         return True
 
-    def submit_tcp(self, batch) -> bool:
+    def submit_tcp(self, batch, tenant: int = 0) -> bool:
         return True
 
-    def submit_proc(self, batch) -> bool:
+    def submit_proc(self, batch, tenant: int = 0) -> bool:
         return True
 
 

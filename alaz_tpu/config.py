@@ -458,6 +458,19 @@ class RuntimeConfig:
     # by cores and the GIL-held fraction of process_l7 (ARCHITECTURE
     # §3f); size to physical cores, not hyperthreads.
     ingest_workers: int = 1
+    # multi-tenant serving plane (ISSUE 14, runtime/tenancy.py): >1
+    # partitions the HOST plane per tenant — each tenant gets its own
+    # interner namespace, drop ledger, source queues, watermarks and
+    # windowed pipeline (serial or sharded per ingest_workers), so one
+    # tenant's backlog, malformed stream or hot key cannot stall or
+    # corrupt another's windows — while all tenants' close waves share
+    # ONE scorer: same-bucket windows from different tenants pack into
+    # the bucketed staging arenas (score_batch_windows groups), so the
+    # device never idles between tenants. Frames carry the tenant id in
+    # the header (sources/ingest_server.py); legacy frames are tenant 0.
+    # 1 = today's single-tenant wiring, bit-identical (the K=1 parity
+    # contract). Bounded by the header byte: ≤ events.schema.MAX_TENANTS.
+    tenants: int = 1
     # scatter backpressure bound (aggregator/sharded.py, ISSUE 6): a
     # producer blocks at most this long on a backlogged shard queue
     # before the rows SHED to the drop ledger — a stalled or dead worker
@@ -509,6 +522,7 @@ class RuntimeConfig:
             renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
             ingest_workers=env_int("INGEST_WORKERS", 1),
+            tenants=env_int("TENANTS", 1),
             shed_block_s=env_float("SHED_BLOCK_S", 5.0),
             degree_cap=env_int("DEGREE_CAP", 0),
             sample_seed=env_int("SAMPLE_SEED", 0),

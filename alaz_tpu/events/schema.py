@@ -25,6 +25,19 @@ import numpy as np
 
 MAX_PAYLOAD_SIZE = 256
 
+# ---------------------------------------------------------------------------
+# Tenancy (ISSUE 14): the frame header carries a one-byte tenant id so N
+# agent fleets can multiplex onto one scoring backend. The byte sits in
+# what was header padding (sources/ingest_server.py FRAME_HEADER), so a
+# legacy agent — which zero-fills the pad — IS a tenant-0 agent byte for
+# byte: every recorded trace replays unchanged. The width is a wire
+# contract (alazspec pins it in resources/specs/wire_layouts.json);
+# RuntimeConfig.tenants must stay ≤ MAX_TENANTS.
+# ---------------------------------------------------------------------------
+
+TENANT_WIRE_BITS = 8
+MAX_TENANTS = 1 << TENANT_WIRE_BITS
+
 
 class L7Protocol(enum.IntEnum):
     """BPF_L7_PROTOCOL_* (l7.go:19-28)."""

@@ -97,6 +97,17 @@ class GraphBatch:
         """Real-edge fraction of the edge bucket (0..1)."""
         return self.n_edges / self.e_pad if self.e_pad else 0.0
 
+    def aggregated_rows(self) -> int:
+        """Exact request-row count this window aggregated: edge feature
+        0 is log1p(request count), so the inverse recovers the integer
+        total. THE row measure of every conservation equation (chaos
+        gates, per-tenant isolation gates, window-shed attribution) —
+        one definition, so the books can never disagree about what a
+        window weighed."""
+        return int(
+            np.rint(np.expm1(self.edge_feats[: self.n_edges, 0])).sum()
+        )
+
     def device_arrays(self) -> dict:
         """The pytree the jit'd model consumes (static shapes only)."""
         if self.node_deg is None:

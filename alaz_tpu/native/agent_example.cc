@@ -43,7 +43,8 @@ struct AlzRecord {  // mirrors ingest.cc / NATIVE_RECORD_DTYPE (32 bytes)
 struct FrameHeader {  // little-endian; matches ingest_server.FRAME_HEADER
   uint32_t magic;
   uint8_t kind;
-  uint8_t pad[3];
+  uint8_t tenant;  // fleet id (ISSUE 14); zero-init = the legacy tenant
+  uint8_t pad[2];
   uint32_t count;
   uint32_t length;
 };

@@ -269,6 +269,23 @@ NODE_STAT_COLS = {
 }
 
 
+def feature_logits(edge_feats: np.ndarray) -> np.ndarray:
+    """The fixed feature-space read in LOGIT form, vectorized over any
+    leading shape — the ONE definition of the deterministic scorer's
+    weights. :func:`feature_scores` is its sigmoid; the tenancy replay
+    harness (replay/tenants.py) drives the service scorer loop with
+    this directly, so the per-tenant planes see EXACTLY the
+    feature_scores distribution by construction."""
+    ef = np.asarray(edge_feats)
+    return (
+        6.0 * ef[..., 3]  # 5xx/error rate
+        + 3.0 * ef[..., 4]  # 4xx rate
+        + 2.0 * ef[..., 1]  # log mean latency (scaled /20 by assembly)
+        + 0.5 * ef[..., 0]  # log1p request count
+        - 4.0
+    ).astype(np.float32)
+
+
 def feature_scores(batch) -> np.ndarray:
     """The deterministic feature-space scorer the scenario drift gates
     and the bench A/B share: a FIXED logistic read of the aggregated
@@ -277,15 +294,7 @@ def feature_scores(batch) -> np.ndarray:
     distribution moves iff the stats move, with no trained model (and no
     accelerator) in the loop. NOT a detection model: the real models
     score the service, this scores the *plane*."""
-    n = batch.n_edges
-    ef = batch.edge_feats[:n]
-    z = (
-        6.0 * ef[:, 3]  # 5xx/error rate
-        + 3.0 * ef[:, 4]  # 4xx rate
-        + 2.0 * ef[:, 1]  # log mean latency (scaled /20 by assembly)
-        + 0.5 * ef[:, 0]  # log1p request count
-        - 4.0
-    )
+    z = feature_logits(batch.edge_feats[: batch.n_edges])
     return (1.0 / (1.0 + np.exp(-z))).astype(np.float32)
 
 
@@ -323,11 +332,19 @@ class ScorePlane:
         min_ref: Optional[int] = None,
         rebaseline_frac: float = 0.25,
         resolve: Optional[Callable[[int], str]] = None,
+        metric_suffix: str = "",
     ):
         self.enabled = bool(enabled)
         self.metrics = metrics if self.enabled else None
         self.recorder = recorder
         self.model = str(model) or "default"
+        # tenancy (ISSUE 14): a per-tenant plane registers every series
+        # under its own ``.t<k>`` suffix so K planes on one registry
+        # never share a gauge/counter instance (same-name registration
+        # returns the existing object — K unsuffixed planes would
+        # silently sum their counters and last-write their gauges). ""
+        # keeps the single-tenant names bit-for-bit.
+        self._suffix = str(metric_suffix)
         self.top_k = max(0, int(top_k))
         self.top_edges = max(1, int(top_edges))
         self.rebaseline_frac = float(rebaseline_frac)
@@ -352,24 +369,32 @@ class ScorePlane:
             # sparse: the sketch is absent from /metrics and snapshot
             # until the first scored window (the empty-series rule)
             self.hist = self.metrics.histogram(
-                f"scores.dist.{self.model}", sparse=True, bounds=SCORE_BOUNDS
+                f"scores.dist.{self.model}{self._suffix}",
+                sparse=True,
+                bounds=SCORE_BOUNDS,
             )
-            self._c_windows = self.metrics.counter("scores.windows")
-            self._c_drift = self.metrics.counter("scores.drift_events")
-            self._c_rebase = self.metrics.counter("scores.rebaselines")
+            self._c_windows = self.metrics.counter(f"scores.windows{self._suffix}")
+            self._c_drift = self.metrics.counter(
+                f"scores.drift_events{self._suffix}"
+            )
+            self._c_rebase = self.metrics.counter(
+                f"scores.rebaselines{self._suffix}"
+            )
             # set-style gauges (no callbacks): the registry never calls
             # back into the plane, so no lock-order edge toward the
             # plane lock can form (the device plane's ABBA lesson)
-            self._g_mean = self.metrics.gauge("scores.window_mean")
-            self._g_p99 = self.metrics.gauge("scores.window_p99")
-            self._g_max = self.metrics.gauge("scores.window_max")
-            self._g_nodes = self.metrics.gauge("scores.scored_nodes")
-            self._g_state = self.metrics.gauge("scores.drift_state")
-            self._g_psi = self.metrics.gauge("scores.drift_psi")
-            self._g_ks = self.metrics.gauge("scores.drift_ks")
+            self._g_mean = self.metrics.gauge(f"scores.window_mean{self._suffix}")
+            self._g_p99 = self.metrics.gauge(f"scores.window_p99{self._suffix}")
+            self._g_max = self.metrics.gauge(f"scores.window_max{self._suffix}")
+            self._g_nodes = self.metrics.gauge(
+                f"scores.scored_nodes{self._suffix}"
+            )
+            self._g_state = self.metrics.gauge(f"scores.drift_state{self._suffix}")
+            self._g_psi = self.metrics.gauge(f"scores.drift_psi{self._suffix}")
+            self._g_ks = self.metrics.gauge(f"scores.drift_ks{self._suffix}")
         else:
             self.hist = Histogram(
-                f"scores.dist.{self.model}", bounds=SCORE_BOUNDS
+                f"scores.dist.{self.model}{self._suffix}", bounds=SCORE_BOUNDS
             )
             self._c_windows = self._c_drift = self._c_rebase = None
             self._g_mean = self._g_p99 = self._g_max = None

@@ -44,6 +44,17 @@ def main(argv=None) -> int:
         "--stress", action="store_true",
         help="also run the hot_key 500k-fan-in acceptance bound (host leg)",
     )
+    p.add_argument(
+        "--isolation", action="store_true",
+        help="also run the multi-tenant isolation gate (ISSUE 14): K=3 "
+        "tenants on one service, one perturbed by an incident — clean "
+        "tenants must hold latency vs solo, stay drift-silent, and "
+        "conserve rows exactly per tenant",
+    )
+    p.add_argument(
+        "--isolation-tenants", type=int, default=3,
+        help="tenant count for the isolation gate",
+    )
     args = p.parse_args(argv)
 
     failed = 0
@@ -58,6 +69,15 @@ def main(argv=None) -> int:
             print(json.dumps(rep.as_dict(), sort_keys=True), flush=True)
             if not rep.ok:
                 failed += 1
+    if args.isolation:
+        from alaz_tpu.replay.tenants import run_isolation_scenario
+
+        trep = run_isolation_scenario(
+            tenants=args.isolation_tenants, seed=args.seeds[0]
+        )
+        print(json.dumps(trep.as_dict(), sort_keys=True), flush=True)
+        if not trep.ok:
+            failed += 1
     if args.stress:
         rep = run_incident_scenario(
             "hot_key",

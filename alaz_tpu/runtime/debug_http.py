@@ -124,10 +124,38 @@ class DebugServer:
                             "overwritten": recorder.overwritten,
                             "capacity": recorder.capacity,
                         }
+                    if getattr(svc, "tenants", 1) > 1:
+                        # per-tenant breakdown (ISSUE 14): queue lag,
+                        # ledger, windows and drift state per fleet —
+                        # the isolation diagnosis surface
+                        stats["tenants"] = svc.tenants_snapshot()
                     self._send(200, json.dumps(stats, indent=2), "application/json")
                 elif self.path == "/scores":
                     plane = getattr(svc, "scores", None)
-                    if plane is None or not plane.enabled:
+                    if getattr(svc, "tenants", 1) > 1:
+                        # multi-tenant service: per-tenant planes (ISSUE
+                        # 14), keyed by tenant id; a tenant absent from
+                        # the dict has not scored a window yet
+                        if not getattr(svc, "_scores_enabled", False):
+                            self._send(404, "score plane disabled")
+                        else:
+                            self._send(
+                                200,
+                                json.dumps(
+                                    {
+                                        "tenants": {
+                                            str(t): p.snapshot()
+                                            for t, p in sorted(
+                                                svc.score_planes().items()
+                                            )
+                                            if p.enabled
+                                        }
+                                    },
+                                    indent=2,
+                                ),
+                                "application/json",
+                            )
+                    elif plane is None or not plane.enabled:
                         # absent-not-zero (ISSUE 13): a disabled plane
                         # has no surface, it does not serve empty JSON
                         self._send(404, "score plane disabled")
@@ -140,13 +168,28 @@ class DebugServer:
                 elif self.path == "/scores/top" or self.path.startswith(
                     "/scores/top?"
                 ):
+                    from urllib.parse import parse_qs, urlparse
+
+                    # one parse for every query param this endpoint reads
+                    qs = parse_qs(urlparse(self.path).query)
                     plane = getattr(svc, "scores", None)
+                    if getattr(svc, "tenants", 1) > 1:
+                        # ?tenant=T selects the fleet's ledger (default
+                        # 0 — the primary tenant); 404 until that
+                        # tenant has scored a window (absent-not-zero)
+                        try:
+                            tid = int(qs.get("tenant", ["0"])[0])
+                        except ValueError:
+                            self._send(
+                                400,
+                                '{"error": "tenant must be an integer"}',
+                                "application/json",
+                            )
+                            return
+                        plane = svc.tenant_scores(tid)
                     if plane is None or not plane.enabled:
                         self._send(404, "score plane disabled")
                         return
-                    from urllib.parse import parse_qs, urlparse
-
-                    qs = parse_qs(urlparse(self.path).query)
                     raw = qs.get("windows", ["1"])[0]
                     # malformed params 400 BEFORE any side effect (the
                     # /profile discipline); the ledger ring bounds the

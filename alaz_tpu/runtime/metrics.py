@@ -81,7 +81,7 @@ class Gauge:
 class Metrics:
     def __init__(self) -> None:
         self._counters: Dict[str, Counter] = {}  # guarded-by: self._lock
-        self._gauges: Dict[str, Gauge] = {}
+        self._gauges: Dict[str, Gauge] = {}  # guarded-by: self._lock
         self._infos: Dict[str, Dict[str, str]] = {}
         self._histograms: Dict[str, Histogram] = {}  # guarded-by: self._lock
         # sparse histograms (ISSUE 11, the per-bucket labeled series):

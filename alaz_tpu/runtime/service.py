@@ -22,7 +22,6 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
-from alaz_tpu.aggregator.engine import Aggregator
 from alaz_tpu.config import RuntimeConfig
 from alaz_tpu.datastore.interface import BaseDataStore, DataStore
 from alaz_tpu.events.intern import Interner
@@ -34,6 +33,7 @@ from alaz_tpu.obs.recorder import FlightRecorder
 from alaz_tpu.obs.scores import ScorePlane
 from alaz_tpu.obs.spans import SpanTracer
 from alaz_tpu.runtime.metrics import Metrics, device_gauges, host_gauges, ledger_gauges
+from alaz_tpu.runtime.tenancy import TenantPartition, validate_tenants
 from alaz_tpu.utils.ledger import DropLedger
 from alaz_tpu.utils.queues import BatchQueue
 
@@ -194,11 +194,29 @@ class Service:
         model_state: Any = None,  # params; None = scoring disabled
         score_threshold: float = 0.5,  # only annotate edges scoring above
         use_native_ingest: bool = False,  # C++ window accumulator when built
+        score_fn: Optional[Callable] = None,  # host scorer override (see below)
+        score_many_fn: Optional[Callable] = None,  # its vmapped-group twin
     ):
         self.score_threshold = score_threshold
         self.use_native_ingest = use_native_ingest
         self.config = config if config is not None else RuntimeConfig()
         self.interner = interner if interner is not None else Interner()
+        # host scorer override (ISSUE 14): ``score_fn(params, graph) ->
+        # {"edge_logits": ...}`` replaces the jit'd model — the tenancy
+        # replay harness and ``bench.py --tenants`` drive the WHOLE
+        # service plane (queues → partitions → window queue → scorer →
+        # per-tenant score planes) with a deterministic numpy scorer, so
+        # isolation gates measure the plane, not XLA compile jitter.
+        # When set, graphs stay numpy (no device transfer, no compile
+        # plane) and ``score_many_fn(params, stacked)`` — if given —
+        # serves the micro-batch group path over the stacked arenas.
+        # OWNERSHIP: ``stacked`` is a REUSED double-buffered staging
+        # arena — score_many_fn must return arrays it owns (any
+        # arithmetic copies; a bare view would be clobbered by the next
+        # group's arena fill before the result is read).
+        self._host_score = score_fn is not None
+        self.tenants = validate_tenants(self.config, model_state, use_native_ingest)
+        self.score_observer: Optional[Callable] = None  # (batch, tenant, latency_s)  # lockless-ok: attach-once harness hook published before windows flow; the scorer null-checks an atomic reference read
         self.metrics = Metrics()
         device_gauges(self.metrics)
         host_gauges(self.metrics)
@@ -225,6 +243,19 @@ class Service:
         self.ledger = DropLedger()
         self.ledger.recorder = self.recorder
         ledger_gauges(self.metrics, self.ledger)
+        # rows refused at the door for an UNKNOWN tenant id (ISSUE 14)
+        # get their own ledger: they belong to no partition, and folding
+        # them into tenant 0's books (self.ledger aliases partition 0)
+        # would break that tenant's exact conservation equation with
+        # rows it never saw. Reported apart in degraded_snapshot.
+        self.refused_ledger = DropLedger()
+        self.refused_ledger.recorder = self.recorder
+        # warn-once latch per refused tenant id (the _warned_no_native
+        # pattern): a hostile/misconfigured agent streaming thousands of
+        # mis-tagged frames per second must cost a counter bump, not an
+        # unbounded log flood. Bounded: wire ids fit a byte; API callers
+        # past the cap stay silent (the counter carries the signal).
+        self._warned_tenants: set = set()  # lockless-ok: best-effort warn-once latch; a duplicate warning under a racy add is cosmetic
         # spans complete at emit when no scorer runs behind the store;
         # with a model they stay open through stage/score/export
         self.tracer = SpanTracer(
@@ -251,8 +282,14 @@ class Service:
         # programs, so the hookup rides model_state.
         self.compile_plane: Optional[CompileEventPlane] = None
         # same gate as DeviceTelemetry: TRACE_ENABLED=0 is the master
-        # obs kill switch and must silence the compile capture too
-        if model_state is not None and tcfg.enabled and tcfg.device_enabled:
+        # obs kill switch and must silence the compile capture too. A
+        # host-score service compiles nothing — no capture to run.
+        if (
+            model_state is not None
+            and not self._host_score
+            and tcfg.enabled
+            and tcfg.device_enabled
+        ):
             self.compile_plane = CompileEventPlane(
                 metrics=self.metrics, recorder=self.recorder
             ).start()
@@ -262,17 +299,36 @@ class Service:
         # service has no scores to watch) and registers NOTHING when
         # disabled (absent-not-zero). Serial + ShardedIngest paths share
         # one accounting: both feed through record_window.
-        self.scores = ScorePlane(
-            metrics=self.metrics,
-            recorder=self.recorder,
-            enabled=(
-                model_state is not None and tcfg.enabled and tcfg.score_enabled
-            ),
-            model=self.config.model.model,
-            drift_windows=tcfg.score_drift_windows,
-            top_k=tcfg.score_top_k,
-            resolve=self.interner.lookup,
+        #
+        # Tenancy (ISSUE 14): with one tenant the plane is the eager
+        # singleton it always was. With K > 1, sketches/drift/top-K must
+        # stay PER-TENANT (one fleet's incident must not page — or
+        # mask — another's), so planes are created lazily at each
+        # tenant's first scored window under a ``.t<k>`` metric suffix:
+        # an idle tenant is absent from the scrape, never a zero render.
+        self._scores_enabled = (
+            model_state is not None and tcfg.enabled and tcfg.score_enabled
         )
+        self._trace_cfg = tcfg
+        # per-tenant plane map: inserts happen on the scorer thread only
+        # but under a lock (dict resize is not GIL-atomic against the
+        # read side); readers (/scores handlers, snapshots) take a
+        # dict() copy without the lock — the blessed locked-writes +
+        # lockless-reads shape
+        self._planes_lock = threading.Lock()
+        self._score_planes: dict = {}  # tenant -> ScorePlane  # lockless-ok: locked writes (scorer thread under _planes_lock) + lockless dict-copy reads
+        self.scores: Optional[ScorePlane] = None
+        if self.tenants == 1:
+            self.scores = ScorePlane(
+                metrics=self.metrics,
+                recorder=self.recorder,
+                enabled=self._scores_enabled,
+                model=self.config.model.model,
+                drift_windows=tcfg.score_drift_windows,
+                top_k=tcfg.score_top_k,
+                resolve=self.interner.lookup,
+            )
+            self._score_planes[0] = self.scores
         self._export_backend = export_backend
         if export_backend is not None and getattr(
             export_backend, "ledger", None
@@ -286,16 +342,14 @@ class Service:
             # chaos gates check); degraded_snapshot surfaces it apart.
             export_backend.ledger = DropLedger()
 
-        q = self.config.queues
-        self.l7_queue = BatchQueue(q.l7_events, "l7", ledger=self.ledger)
-        self.tcp_queue = BatchQueue(q.tcp_events, "tcp", ledger=self.ledger)
-        self.proc_queue = BatchQueue(q.proc_events, "proc", ledger=self.ledger)
-        self.k8s_queue = BatchQueue(q.kube_events, "k8s", ledger=self.ledger)
         # the window queue is interior backpressure, not a source edge —
         # a drop there is the pipeline choosing to shed. NOT ledger-wired
         # at the queue mouth: its items are [GraphBatch] lists (size 1),
         # and the ledger's contract is ROWS — _enqueue_window attributes
-        # the batch's true aggregated row count on drop instead
+        # the batch's true aggregated row count on drop instead. ONE
+        # queue for all tenants: this is where cross-tenant batching
+        # happens — close waves from every partition interleave here and
+        # the scorer packs same-bucket windows into shared arenas.
         self.window_queue = BatchQueue(10_000_000, "windows")
 
         renumber = getattr(self.config, "renumber_nodes", False)
@@ -306,105 +360,63 @@ class Service:
                 "renumber_nodes is incompatible with model=tgn "
                 "(cross-window slot-indexed memory); disable one of the two"
             )
-        self.graph_store = None
-        self.sharded = None
-        ingest_workers = max(1, int(getattr(self.config, "ingest_workers", 1)))
-        degree_cap = max(0, int(getattr(self.config, "degree_cap", 0)))
-        sample_seed = int(getattr(self.config, "sample_seed", 0))
-        if use_native_ingest:
-            from alaz_tpu.graph import native as native_mod
-
-            if native_mod.available():
-                if ingest_workers > 1:
-                    log.warning(
-                        "ingest_workers > 1 ignored with use_native_ingest: "
-                        "the C++ window accumulator is its own ingest plane"
-                    )
-                if degree_cap:
-                    # the C++ accumulator assembles features in its own
-                    # close pass (alz_close_window_feats) — the cap rides
-                    # the GraphBuilder paths only; a silent no-op here
-                    # would let a hot key through a "capped" deployment
-                    log.warning(
-                        "degree_cap is not applied by the native window "
-                        "accumulator; use the sharded or numpy ingest "
-                        "plane for hot-key protection"
-                    )
-                self.graph_store = native_mod.NativeWindowedStore(
-                    window_s=self.config.window_s,
-                    on_batch=self._enqueue_window,
-                    renumber=renumber,
-                )
-            else:
-                log.warning("native ingest requested but library unavailable; using numpy store")
-        if self.graph_store is None and ingest_workers > 1:
-            # sharded multi-worker ingest (aggregator/sharded.py): the
-            # pipeline IS both the aggregator (ingestion surface) and
-            # the windowed store (flush/drop gauges) — one object plays
-            # both roles the serial pair splits
-            from alaz_tpu.aggregator.sharded import ShardedIngest
-
-            # soak mode (CHAOS_ENABLED=1): the worker seam injects
-            # config-intensity crashes/stalls into the LIVE pool so a
-            # staging deployment continuously proves its self-healing;
-            # the other seams are driven externally (harness/bench)
-            fault_hook = None
-            ccfg = getattr(self.config, "chaos", None)
-            if ccfg is not None and ccfg.enabled:
-                from alaz_tpu.chaos.injectors import WorkerChaos
-
-                fault_hook = WorkerChaos(
-                    seed=ccfg.seed,
-                    crash_prob=ccfg.worker_crash_prob,
-                    stall_prob=ccfg.worker_stall_prob,
-                    stall_s=ccfg.worker_stall_s,
-                    max_crashes=ccfg.worker_max_crashes,
-                )
-                log.warning("chaos soak enabled: worker-seam fault injection live")
-            self.sharded = ShardedIngest(
-                ingest_workers,
-                interner=self.interner,
-                config=self.config,
-                window_s=self.config.window_s,
-                on_batch=self._enqueue_window,
-                renumber=renumber,
-                tee=export_backend,
-                ledger=self.ledger,
-                shed_block_s=self.config.shed_block_s,
-                fault_hook=fault_hook,
-                degree_cap=degree_cap,
-                sample_seed=sample_seed,
-                tracer=self.tracer,
-                recorder=self.recorder,
+        # per-tenant host-plane partitions (ISSUE 14, runtime/tenancy.py):
+        # partition 0 owns the service-level interner/ledger/tracer (the
+        # K=1 wiring is bit-identical to the pre-tenancy service); later
+        # partitions get fresh namespaces. Every partition's on_batch
+        # lands in the ONE window queue, tenant-stamped.
+        if export_backend is not None and self.tenants > 1:
+            # the export tee resolves interned uids against the ONE
+            # interner the backend was built with (partition 0's):
+            # teeing other fleets' rows through it would resolve their
+            # uids in the WRONG namespace and export tenant A's traffic
+            # under tenant B's service names. Until the per-tenant
+            # export leg lands (ROADMAP follow-on), only the primary
+            # tenant exports — loudly, not silently.
+            log.warning(
+                "export backend attached with tenants > 1: only tenant "
+                "0 (the primary) exports — the backend resolves uids in "
+                "one interner namespace; per-tenant export is a roadmap "
+                "follow-on"
             )
-            self.graph_store = self.sharded
-        if self.graph_store is None:
-            self.graph_store = WindowedGraphStore(
-                self.interner,
-                window_s=self.config.window_s,
-                on_batch=self._enqueue_window,
-                renumber=renumber,
-                ledger=self.ledger,
-                degree_cap=degree_cap,
-                sample_seed=sample_seed,
-                tracer=self.tracer,
+        self.partitions: List[TenantPartition] = []
+        for t in range(self.tenants):
+            self.partitions.append(
+                TenantPartition(
+                    t,
+                    self.config,
+                    on_batch=functools.partial(self._enqueue_window, tenant=t),
+                    interner=self.interner if t == 0 else None,
+                    ledger=self.ledger if t == 0 else None,
+                    tracer=self.tracer if t == 0 else None,
+                    recorder=self.recorder,
+                    export_backend=export_backend if t == 0 else None,
+                    use_native_ingest=use_native_ingest and t == 0,
+                    scoring=model_state is not None,
+                    metrics=self.metrics,
+                )
             )
-        if self.sharded is not None:
-            self.datastore = None  # worker sinks fan out inside the pipeline
-            self.aggregator = self.sharded
-        else:
-            sinks: List[DataStore] = [self.graph_store]
-            if export_backend is not None:
-                sinks.append(export_backend)
-            self.datastore = FanoutDataStore(sinks)
-            self.aggregator = Aggregator(
-                self.datastore,
-                interner=self.interner,
-                config=self.config,
-                # semantic (filtered) drops join the service ledger so
-                # conservation needs no side-channel term (ISSUE 8)
-                ledger=self.ledger,
-                recorder=self.recorder,
+        p0 = self.partitions[0]
+        # partition-0 aliases: the single-tenant surface every existing
+        # consumer (gauges below, /stats, tests, the ingest socket's
+        # native-store probe) keys on. With K > 1 the unsuffixed series
+        # describe tenant 0 — the primary/legacy tenant — and the
+        # ``.t<k>`` series carry the per-tenant breakdown.
+        self.l7_queue = p0.l7_queue
+        self.tcp_queue = p0.tcp_queue
+        self.proc_queue = p0.proc_queue
+        self.k8s_queue = p0.k8s_queue
+        self.graph_store = p0.graph_store
+        self.sharded = p0.sharded
+        self.aggregator = p0.aggregator
+        self.datastore = p0.datastore
+        if self.tenants > 1:
+            # trace.live: each partition's SpanTracer registered the
+            # gauge in turn (last write wins) — rebind it to the fleet
+            # sum so the scrape reads live spans across ALL tenants
+            parts = list(self.partitions)
+            self.metrics.gauge(
+                "trace.live", lambda: sum(p.tracer.live_count for p in parts)
             )
 
         self.score_sink = score_sink
@@ -414,7 +426,11 @@ class Service:
         self.model_state = model_state
         self._score_fn = None
         self._tgn_memory = None  # temporal model node memory (tgn only)
-        if model_state is not None:
+        if model_state is not None and score_fn is not None:
+            # host scorer override: no registry import, no jit, no jax —
+            # the scorer loop runs the callable over numpy graphs
+            self._score_fn = score_fn
+        elif model_state is not None:
             if self.config.model.model == "tgn":
                 from alaz_tpu.models import tgn
 
@@ -449,16 +465,25 @@ class Service:
             and self._batch_windows > 1
             and self.config.model.model != "tgn"
         ):
-            self._score_many_fn = _batched_score_fn(self.config.model)
+            if self._host_score:
+                # group scoring only when the override supplies its
+                # stacked twin; otherwise windows score serially
+                self._score_many_fn = score_many_fn
+            else:
+                self._score_many_fn = _batched_score_fn(self.config.model)
+        # cross-tenant batching accounting (ISSUE 14): dispatches vs
+        # windows is the group-occupancy number `bench.py --tenants`
+        # publishes (K fleets on one backend should fill groups that K
+        # serial backends would dispatch one window at a time). Scorer
+        # thread only.
+        self.score_dispatches = 0  # role-private: scorer thread only
+        self.multi_tenant_groups = 0  # role-private: scorer thread only
 
         self.housekeeping_interval_s = 120.0  # reference ticker cadence
-        self.scored_batches = 0
-        self.scored_edges = 0
+        self.scored_batches = 0  # lockless-ok: single-writer GIL-atomic counter (scorer thread); racy reads are stats gauges
+        self.scored_edges = 0  # lockless-ok: single-writer GIL-atomic counter (scorer thread); racy reads are stats gauges
         self._paused = threading.Event()
         self._stop = threading.Event()
-        # persist timestamp the idle flush already drained (liveness
-        # flush fires once per idle period, not every housekeeping tick)
-        self._idle_flushed_for: float | None = None
         self._threads: List[threading.Thread] = []
 
         self.metrics.gauge("l7.pending", lambda: self.l7_queue.pending_events)
@@ -529,53 +554,107 @@ class Service:
 
     # -- ingestion surface (what sources call) ------------------------------
 
-    def submit_l7(self, batch: np.ndarray) -> bool:
+    def _tenant_known(self, tenant: int, rows: int) -> bool:
+        """True iff this service has a partition for ``tenant``. A
+        mis-tagged or hostile frame is refused at the door (accounted
+        below) — routing it into another tenant's stream would corrupt
+        that tenant's windows, which is the exact failure tenancy
+        exists to prevent."""
+        if 0 <= tenant < self.tenants:
+            return True
+        self._refuse_unknown_tenant(tenant, rows)
+        return False
+
+    def _refuse_unknown_tenant(self, tenant: int, rows: int) -> None:
+        """Account rows refused for an unknown tenant id: attributed to
+        the service's REFUSED ledger (the rows belong to no partition —
+        inventing one per hostile byte would be an allocation DoS, and
+        folding them into any tenant's books would corrupt that
+        tenant's exact conservation equation)."""
+        if rows:
+            self.refused_ledger.add("filtered", rows, reason="unknown_tenant")
+        # one unit, always: the counter counts refusal EVENTS (frames /
+        # submits — row-less k8s refusals included); lost ROWS ride the
+        # refused ledger, so the two series never mix units
+        self.metrics.counter("ingest.unknown_tenant").inc()
+        if tenant not in self._warned_tenants and len(self._warned_tenants) < 300:
+            self._warned_tenants.add(tenant)
+            log.warning(
+                f"refused frame for unknown tenant {tenant} "
+                f"(service runs {self.tenants}); further refusals for this "
+                "id count silently into ingest.unknown_tenant"
+            )
+
+    def submit_l7(self, batch: np.ndarray, tenant: int = 0) -> bool:
         if self._paused.is_set():
             return False
-        ok = self.l7_queue.put_nowait_drop(batch)
+        if not self._tenant_known(tenant, int(batch.shape[0])):
+            return False
+        ok = self.partitions[tenant].l7_queue.put_nowait_drop(batch)
         self.metrics.counter("l7.in").inc(batch.shape[0])
         return ok
 
-    def submit_tcp(self, batch: np.ndarray) -> bool:
+    def submit_tcp(self, batch: np.ndarray, tenant: int = 0) -> bool:
         if self._paused.is_set():
             return False
-        return self.tcp_queue.put_nowait_drop(batch)
+        if not self._tenant_known(tenant, int(batch.shape[0])):
+            return False
+        return self.partitions[tenant].tcp_queue.put_nowait_drop(batch)
 
-    def submit_proc(self, batch: np.ndarray) -> bool:
+    def submit_proc(self, batch: np.ndarray, tenant: int = 0) -> bool:
         if self._paused.is_set():
             return False
-        return self.proc_queue.put_nowait_drop(batch)
+        if not self._tenant_known(tenant, int(batch.shape[0])):
+            return False
+        return self.partitions[tenant].proc_queue.put_nowait_drop(batch)
 
-    def submit_k8s(self, msg) -> bool:
+    def submit_k8s(self, msg, tenant: int = 0) -> bool:
         if self._paused.is_set():
             return False
-        return self.k8s_queue.put_nowait_drop([msg])
+        if not self._tenant_known(tenant, 0):
+            return False
+        return self.partitions[tenant].k8s_queue.put_nowait_drop([msg])
 
     # -- workers -------------------------------------------------------------
 
-    def _enqueue_window(self, batch: GraphBatch) -> None:
+    def _enqueue_window(self, batch: GraphBatch, tenant: int = 0) -> None:
+        part = self.partitions[tenant]
+        # tenant attribution rides the batch through the SHARED window
+        # queue (ISSUE 14): record_window routes sketches/drift/top-K to
+        # the right per-tenant plane, and the close→score latency stamp
+        # is what the per-tenant p99 gate measures
+        batch.tenant = tenant
+        batch.closed_monotonic = time_module.monotonic()
+        part.windows_closed += 1
+        if self.tenants > 1:
+            # first-window gauge registration: per-tenant ledger series
+            # appear when the tenant first produces, never before
+            part.register_tenant_gauges(self.metrics)
         if not self.window_queue.put_nowait_drop([batch]):
-            # ledger in ROWS, not batches: edge feature 0 is
-            # log1p(request count), so the inverse recovers the exact
-            # aggregated row count this shed window carried
-            rows = int(
-                np.rint(np.expm1(batch.edge_feats[: batch.n_edges, 0])).sum()
-            )
-            self.ledger.add("shed", rows, reason="windows")
+            # ledger in ROWS, not batches (GraphBatch.aggregated_rows —
+            # the one conservation row measure). The shed attributes to
+            # the EMITTING tenant's ledger — per-tenant conservation is
+            # the isolation gate's invariant.
+            part.ledger.add("shed", batch.aggregated_rows(), reason="windows")
             # a shed window never reaches the scorer: drop its live span
             # (an eviction tick, not a leak) instead of leaving it open
-            self.tracer.discard(batch.window_start_ms)
+            part.tracer.discard(batch.window_start_ms)
         self.metrics.counter("windows.closed").inc()
         # the banded src-gather's cost models on live traffic: lets an
         # operator read off whether SRC_GATHER=banded would pay here.
         # The decisive gauge is the straggler fraction (<0.125, the
         # kernel's fix-up budget → banded pays; →1.0 → keep the XLA
         # gather); the [min,max] band width rides along for context.
-        band_w, strag = src_locality_gauges(
-            batch.edge_src[: batch.n_edges], n_nodes=batch.n_nodes
-        )
-        self.metrics.gauge("windows.src_band_windows").set(band_w)
-        self.metrics.gauge("windows.src_straggler_fraction").set(strag)
+        # Multi-tenant: K closing threads would race these shared
+        # set-style gauges into whichever-tenant-closed-last noise, so
+        # only the PRIMARY tenant's windows feed them (the series keeps
+        # one deterministic meaning; per-tenant locality is a follow-on)
+        if tenant == 0:
+            band_w, strag = src_locality_gauges(
+                batch.edge_src[: batch.n_edges], n_nodes=batch.n_nodes
+            )
+            self.metrics.gauge("windows.src_band_windows").set(band_w)
+            self.metrics.gauge("windows.src_straggler_fraction").set(strag)
 
     def _consume(self, queue: BatchQueue, fn: Callable[[Any], None]) -> None:
         """Worker loop: every successfully-gotten batch is matched with a
@@ -589,43 +668,46 @@ class Service:
             finally:
                 queue.task_done()
 
-    def _l7_worker(self) -> None:
+    def _l7_worker(self, part: TenantPartition) -> None:
         def handle(batch):
-            out = self.aggregator.process_l7(batch)
+            out = part.aggregator.process_l7(batch)
             if out is not None:
                 self.metrics.counter("edges.out").inc(int(out.shape[0]))
-            elif self.sharded is not None:
+            elif part.sharded is not None:
                 # sharded pipeline processes async and returns None —
                 # converge the counter onto the pipeline's authoritative
                 # emitted total so edges.out dashboards keep reading the
-                # truth (lag: at most the in-flight shard backlog). Only
-                # THIS thread syncs it, so the read-inc pair can't race.
-                c = self.metrics.counter("edges.out")
-                delta = self.sharded.stats.edges_out - c.value
+                # truth (lag: at most the in-flight shard backlog). Per
+                # partition: only THIS partition's l7 worker syncs its
+                # delta (tracked on the partition), so K workers never
+                # race a shared read-inc pair.
+                delta = part.sharded.stats.edges_out - part.edges_out_synced
                 if delta > 0:
-                    c.inc(delta)
+                    part.edges_out_synced += delta
+                    self.metrics.counter("edges.out").inc(delta)
 
-        self._consume(self.l7_queue, handle)
+        self._consume(part.l7_queue, handle)
 
-    def _tcp_worker(self) -> None:
-        self._consume(self.tcp_queue, self.aggregator.process_tcp)
+    def _tcp_worker(self, part: TenantPartition) -> None:
+        self._consume(part.tcp_queue, part.aggregator.process_tcp)
 
-    def _proc_worker(self) -> None:
-        self._consume(self.proc_queue, self.aggregator.process_proc)
+    def _proc_worker(self, part: TenantPartition) -> None:
+        self._consume(part.proc_queue, part.aggregator.process_proc)
 
-    def _k8s_worker(self) -> None:
+    def _k8s_worker(self, part: TenantPartition) -> None:
         def handle(msgs):
             for m in msgs:
-                self.aggregator.process_k8s(m)
+                part.aggregator.process_k8s(m)
 
-        self._consume(self.k8s_queue, handle)
+        self._consume(part.k8s_queue, handle)
 
     def _housekeeping_worker(self) -> None:
         """Periodic gc: socket lines, h2 stream reaping, DNS purge — the
         reference's 2-minute ticker loops (data.go:177-219,1688)."""
         while not self._stop.wait(self.housekeeping_interval_s):
             try:
-                self.aggregator.gc()
+                for part in self.partitions:
+                    part.aggregator.gc()
                 # timer-driven retry flush: requeued events must not wait
                 # for the next L7 batch to arrive (input lulls)
                 self._flush_retries_counted()
@@ -635,25 +717,29 @@ class Service:
                 # tracked pids belong to this node — replayed/remote pids
                 # would all look dead and lose their join state.
                 if self.config.local_pids:
-                    self.aggregator.reap_zombies()
+                    for part in self.partitions:
+                        part.aggregator.reap_zombies()
                 # traffic-lull liveness: with no newer event the watermark
                 # never advances, so the last window would sit open
                 # forever. Ingest idleness (not event time — replay clocks
-                # are synthetic) triggers the flush. The grace knob trades
+                # are synthetic) triggers the flush, PER TENANT: one
+                # fleet going quiet must flush its last window even while
+                # another fleet streams on. The grace knob trades
                 # staleness against upstream delivery stalls: rows that
                 # arrive after their window was idle-flushed drop as late.
-                last = getattr(self.graph_store, "last_persist_monotonic", None)
                 grace_s = max(self.config.idle_flush_grace_s, 2 * self.config.window_s)
-                if (
-                    last is not None
-                    and last != self._idle_flushed_for
-                    and time_module.monotonic() - last > grace_s
-                ):
-                    self.graph_store.flush()
-                    # one flush per idle period: until a new persist moves
-                    # the timestamp there is nothing more to drain, so
-                    # don't re-take the store lock every tick
-                    self._idle_flushed_for = last
+                for part in self.partitions:
+                    last = getattr(part.graph_store, "last_persist_monotonic", None)
+                    if (
+                        last is not None
+                        and last != part.idle_flushed_for
+                        and time_module.monotonic() - last > grace_s
+                    ):
+                        part.graph_store.flush()
+                        # one flush per idle period: until a new persist
+                        # moves the timestamp there is nothing more to
+                        # drain, so don't re-take the store lock per tick
+                        part.idle_flushed_for = last
                 # channel-lag log (data.go:177-186 cadence)
                 lag = {
                     q.name: q.stats()
@@ -664,9 +750,12 @@ class Service:
                 log.warning(f"housekeeping failed: {exc}")
 
     def _scorer_worker(self) -> None:
-        import jax.numpy as jnp
+        if self._host_score:
+            jnp = None  # host scorer: numpy end to end, jax never imports
+        else:
+            import jax.numpy as jnp
 
-        from alaz_tpu.models.registry import get_model  # noqa: F401 (jit cache warm)
+            from alaz_tpu.models.registry import get_model  # noqa: F401 (jit cache warm)
 
         # double buffering (SURVEY §2.3 P3): window N+1's host→device
         # transfer is staged (JAX transfers are async) before window N is
@@ -692,35 +781,58 @@ class Service:
             Computes the sigmoid ONCE for the score plane and the export
             leg, times the export-ack leg and COMPLETES the window's
             span — the last lifecycle stage, so completion lives here
-            and only here."""
+            and only here. Tenancy (ISSUE 14): the batch's tenant stamp
+            routes sketches/drift/top-K to the tenant's OWN plane and
+            feeds the per-tenant close→score latency series — the
+            isolation gate's p99."""
+            t = int(getattr(batch, "tenant", 0))
+            part = self.partitions[t]
             self.scored_batches += 1
             self.scored_edges += batch.n_edges
             self.metrics.counter("scored.edges").inc(batch.n_edges)
+            plane = self._scores_for(t)
             scores = None
-            if self.scores.enabled or self.score_sink is not None:
+            if plane.enabled or self.score_sink is not None:
                 n = batch.n_edges
                 scores = (1.0 / (1.0 + np.exp(-logits[:n]))).astype(np.float32)
             # score plane (ISSUE 13): sketch + drift compare + top-K
             # attribution, one vectorized pass per window — BOTH scorer
             # paths (serial and vmapped group) land here, so the plane's
             # accounting is identical under serial and sharded ingest
-            if scores is not None:
-                self.scores.observe_window(batch, scores)
+            if scores is not None and plane.enabled:
+                plane.observe_window(batch, scores)
+            closed = getattr(batch, "closed_monotonic", None)
+            if closed is not None:
+                # close→score latency, attributed per tenant (sparse —
+                # the series appears with the tenant's first window)
+                lat = time_module.monotonic() - closed
+                self.metrics.histogram(
+                    f"latency.close_to_score_s.t{t}", sparse=True
+                ).observe(lat)
+                if self.score_observer is not None:
+                    # harness hook (replay/tenants.py): exact per-window
+                    # latencies — histogram rungs are factor-2 banded,
+                    # too coarse for a ±10% isolation gate
+                    try:
+                        self.score_observer(batch, t, lat)
+                    except Exception as exc:  # alazlint: disable=ALZ043 -- telemetry hook, not a row holder: the window's rows continue to the export leg below; a raising observer costs its own sample only
+                        log.warning(f"score observer failed: {exc!r}")
             te0 = time_module.perf_counter()
             if self.score_sink is not None:
-                annotated = self._annotate(batch, scores)
+                annotated = self._annotate(batch, scores, part.interner)
                 if len(annotated):
                     self.score_sink(annotated)
-            self.tracer.observe(
+            part.tracer.observe(
                 batch.window_start_ms, "export",
                 time_module.perf_counter() - te0,
             )
-            self.tracer.complete(batch.window_start_ms)
+            part.tracer.complete(batch.window_start_ms)
 
         def score_one(batch, graph) -> None:
             """Score one window; always settles its task_done."""
             try:
                 t0 = time_module.perf_counter()
+                self.score_dispatches += 1
                 with self._bucket_ctx(batch):
                     out = self._score_fn(self.model_state, graph)
                     logits = np.asarray(out["edge_logits"])
@@ -734,7 +846,7 @@ class Service:
                     )
                 dt = time_module.perf_counter() - t0
                 self._scorer_busy_s += dt
-                self.tracer.observe(batch.window_start_ms, "score", dt)
+                self._tracer_for(batch).observe(batch.window_start_ms, "score", dt)
                 # device plane: the same duration, attributed per bucket
                 self.device.observe_score(batch, dt)
                 record_window(batch, logits)
@@ -762,6 +874,12 @@ class Service:
             try/except gives a single window)."""
             try:
                 t0 = time_module.perf_counter()
+                self.score_dispatches += 1
+                # cross-tenant batching (ISSUE 14): the group was packed
+                # purely by bucket shape — windows from different fleets
+                # share one arena fill and one dispatch
+                if len({int(getattr(b, "tenant", 0)) for b in batches}) > 1:
+                    self.multi_tenant_groups += 1
                 cols = [b.device_arrays() for b in batches]
                 target = 1
                 while target < len(cols):
@@ -777,7 +895,12 @@ class Service:
                 )
                 t_arena = time_module.perf_counter()
                 with self._bucket_ctx(batches[0]):
-                    stacked = {k: jnp.asarray(v) for k, v in arena.items()}
+                    if self._host_score:
+                        # host scorer: the arena IS the stacked input —
+                        # no device transfer exists to dispatch
+                        stacked = arena
+                    else:
+                        stacked = {k: jnp.asarray(v) for k, v in arena.items()}
                     t_xfer = time_module.perf_counter()
                     stage_s = t_xfer - t0
                     out = self._score_many_fn(self.model_state, stacked)
@@ -786,7 +909,7 @@ class Service:
                 # each member's span carries the shared staging time
                 # (critical-path semantics — observe keeps the max)
                 for b in batches:
-                    self.tracer.observe(b.window_start_ms, "stage", stage_s)
+                    self._tracer_for(b).observe(b.window_start_ms, "stage", stage_s)
                     # occupancy per REAL window — the group's
                     # power-of-two padding re-ships the last member's
                     # columns, but that's a dispatch artifact (its
@@ -820,7 +943,7 @@ class Service:
                 for i, batch in enumerate(batches):
                     # shared device time for the vmapped group — each
                     # window's `score` stage carries the group dispatch
-                    self.tracer.observe(batch.window_start_ms, "score", dt)
+                    self._tracer_for(batch).observe(batch.window_start_ms, "score", dt)
                     self.device.observe_score(batch, dt)
                     record_window(batch, logits[i])
             finally:
@@ -898,11 +1021,14 @@ class Service:
                     cols = batch.device_arrays()
                     t_arena = time_module.perf_counter()
                     with self._bucket_ctx(batch):
-                        graph = {k: jnp.asarray(v) for k, v in cols.items()}
+                        if self._host_score:
+                            graph = cols  # numpy stays numpy, no transfer
+                        else:
+                            graph = {k: jnp.asarray(v) for k, v in cols.items()}
                     t_xfer = time_module.perf_counter()
                     dt = t_xfer - t0
                     self._scorer_busy_s += dt
-                    self.tracer.observe(batch.window_start_ms, "stage", dt)
+                    self._tracer_for(batch).observe(batch.window_start_ms, "stage", dt)
                     self.device.observe_staged(batch)
                     self.device.observe_transfer(
                         sum(v.nbytes for v in cols.values()),
@@ -928,6 +1054,46 @@ class Service:
             if carry is not None:
                 self.window_queue.task_done()
 
+    def _tracer_for(self, batch: GraphBatch):
+        """The span tracer owning this batch's window: its emitting
+        partition's (window ids collide across tenants — same wall
+        clock, different fleets — so spans must stay partitioned)."""
+        return self.partitions[int(getattr(batch, "tenant", 0))].tracer
+
+    def _scores_for(self, tenant: int) -> ScorePlane:
+        """The tenant's score plane, created lazily at its first scored
+        window (scorer thread only — the single writer of the plane
+        map). Per-tenant planes register under a ``.t<k>`` suffix so an
+        idle tenant never renders zeros; K=1 keeps the eager unsuffixed
+        singleton, bit-identical to the pre-tenancy plane."""
+        plane = self._score_planes.get(tenant)
+        if plane is None:
+            tcfg = self._trace_cfg
+            plane = ScorePlane(
+                metrics=self.metrics,
+                recorder=self.recorder,
+                enabled=self._scores_enabled,
+                model=self.config.model.model,
+                metric_suffix=f".t{tenant}",
+                drift_windows=tcfg.score_drift_windows,
+                top_k=tcfg.score_top_k,
+                resolve=self.partitions[tenant].interner.lookup,
+            )
+            with self._planes_lock:
+                self._score_planes[tenant] = plane
+        return plane
+
+    def tenant_scores(self, tenant: int) -> Optional[ScorePlane]:
+        """Read-side accessor: the tenant's plane if it has scored at
+        least one window (None before — absent, not empty)."""
+        return self._score_planes.get(tenant)
+
+    def score_planes(self) -> dict:
+        """Read-side copy of the per-tenant plane map ({tenant id →
+        ScorePlane}) — the /scores surface for K > 1; tenants that
+        have not scored are absent."""
+        return dict(self._score_planes)
+
     def _bucket_ctx(self, batch: GraphBatch):
         """Compile-attribution context (ISSUE 11): XLA compiles fired
         while staging/scoring ``batch`` — synchronously, on this
@@ -936,13 +1102,20 @@ class Service:
             return contextlib.nullcontext()
         return self.compile_plane.bucket(bucket_key(batch))
 
-    def _annotate(self, batch: GraphBatch, scores: np.ndarray) -> ScoreBatch:
+    def _annotate(
+        self,
+        batch: GraphBatch,
+        scores: np.ndarray,
+        interner: Optional[Interner] = None,
+    ) -> ScoreBatch:
         """Columnar edge annotation: no per-edge Python objects on the
         return leg — the annotate path must sustain bench-rate edge
         throughput (the export backend resolves strings per unique node
         at serialization time). ``scores`` are the window's [0,1] edge
         scores, computed ONCE in record_window and shared with the
-        score plane."""
+        score plane. ``interner`` is the EMITTING tenant's namespace —
+        resolving one fleet's uids against another's table would
+        annotate the wrong services."""
         keep = np.flatnonzero(scores >= self.score_threshold)
         uids = batch.node_uids
         return ScoreBatch(
@@ -951,7 +1124,7 @@ class Service:
             to_uid=uids[batch.edge_dst[keep]],
             protocol=batch.edge_type[keep],
             score=scores[keep],
-            interner=self.interner,
+            interner=interner if interner is not None else self.interner,
         )
 
     def degraded_snapshot(self) -> dict:
@@ -961,7 +1134,7 @@ class Service:
         so every health PUT carries it — the observable that turns
         "windows stopped arriving" from a mystery into a diagnosis."""
         out: dict = {"ledger": self.ledger.snapshot()}
-        if self.scores.enabled:
+        if self.scores is not None and self.scores.enabled:
             # drift state rides the health payload (ISSUE 13): a node
             # whose score distribution moved says so in every PUT, next
             # to what it is losing
@@ -973,6 +1146,14 @@ class Service:
                 "rebaselines": s["drift"]["rebaselines"],
                 "windows": s["windows"],
             }
+        if self.refused_ledger.total:
+            # frames refused for unknown tenant ids — kept OUT of every
+            # tenant's conservation books, surfaced on their own
+            out["refused"] = self.refused_ledger.snapshot()
+        if self.tenants > 1:
+            # per-tenant breakdown (ISSUE 14): which FLEET is losing
+            # rows / drifting — the isolation diagnosis, in every PUT
+            out["tenants"] = self.tenants_snapshot(full=False)
         if self.sharded is not None:
             out["worker_restarts"] = self.sharded.worker_restarts
             out["last_wave_age_s"] = round(self.sharded.last_wave_age_s, 3)
@@ -990,20 +1171,55 @@ class Service:
             }
         return out
 
+    def tenants_snapshot(self, full: bool = True) -> dict:
+        """Per-tenant breakdown (ISSUE 14): ledger, windows, queue lag
+        (``full``) and — for tenants that have scored — drift state.
+        Keys are tenant ids as strings (JSON-stable)."""
+        out: dict = {}
+        planes = dict(self._score_planes)  # GIL-atomic copy; scorer writes
+        for part in self.partitions:
+            if full:
+                entry = part.snapshot()
+            else:
+                entry = {
+                    "ledger": part.ledger.snapshot(),
+                    "windows_closed": part.windows_closed,
+                }
+            plane = planes.get(part.tenant)
+            if plane is not None and plane.enabled:
+                s = plane.snapshot()
+                entry["scores"] = {
+                    "drift_state": s["drift"]["state"],
+                    "psi": s["drift"]["psi"],
+                    "drift_events": s["drift"]["events"],
+                    "rebaselines": s["drift"]["rebaselines"],
+                    "windows": s["windows"],
+                }
+            out[str(part.tenant)] = entry
+        return out
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         self._stop.clear()
-        workers = [
-            ("alaz-l7", self._l7_worker),
-            ("alaz-tcp", self._tcp_worker),
-            ("alaz-proc", self._proc_worker),
-            ("alaz-k8s", self._k8s_worker),
-            ("alaz-scorer", self._scorer_worker),
-            ("alaz-housekeeping", self._housekeeping_worker),
+        # one consumer set per tenant partition (isolation: tenant A's
+        # queue backlog stalls only tenant A's workers), ONE scorer and
+        # ONE housekeeping thread for the fleet
+        workers = []
+        for part in self.partitions:
+            sfx = f"-t{part.tenant}" if part.tenant else ""
+            workers += [
+                (f"alaz-l7{sfx}", self._l7_worker, (part,)),
+                (f"alaz-tcp{sfx}", self._tcp_worker, (part,)),
+                (f"alaz-proc{sfx}", self._proc_worker, (part,)),
+                (f"alaz-k8s{sfx}", self._k8s_worker, (part,)),
+            ]
+        workers += [
+            ("alaz-scorer", self._scorer_worker, ()),
+            ("alaz-housekeeping", self._housekeeping_worker, ()),
         ]
-        for name, fn in workers:
-            t = threading.Thread(target=fn, name=name, daemon=True)
+        for name, fn, args in workers:
+            t = threading.Thread(target=fn, args=args, name=name, daemon=True)
             t.start()
             self._threads.append(t)
         log.info("service started")
@@ -1022,18 +1238,22 @@ class Service:
         import time
 
         deadline = time.monotonic() + timeout_s
-        queues = (
-            self.l7_queue, self.tcp_queue, self.proc_queue, self.k8s_queue,
-            self.window_queue,
-        )
+        queues = [self.window_queue]
+        for part in self.partitions:
+            queues.extend(part.queues)
         while time.monotonic() < deadline:
             if all(q.unfinished == 0 for q in queues):
-                # the sharded pipeline has its own in-flight queues
-                # behind the service queues; they must drain too
-                if getattr(self.aggregator, "unfinished", 0):
+                # the sharded pipelines have their own in-flight queues
+                # behind the partition queues; they must drain too
+                if any(
+                    getattr(p.aggregator, "unfinished", 0)
+                    for p in self.partitions
+                ):
                     time.sleep(0.02)
                     continue
-                if self.aggregator.pending_retries == 0:
+                if all(
+                    p.aggregator.pending_retries == 0 for p in self.partitions
+                ):
                     return
                 # flush due retries so the final window sees them; not-due
                 # entries come due within a few 20ms backoff periods
@@ -1043,20 +1263,22 @@ class Service:
     def _flush_retries_counted(self) -> None:
         import time
 
-        out = self.aggregator.flush_retries(time.time_ns())
-        if out is not None and out.shape[0]:
-            self.metrics.counter("edges.out").inc(int(out.shape[0]))
+        for part in self.partitions:
+            out = part.aggregator.flush_retries(time.time_ns())
+            if out is not None and out.shape[0]:
+                self.metrics.counter("edges.out").inc(int(out.shape[0]))
 
     def flush_windows(self) -> None:
-        self.graph_store.flush()
+        for part in self.partitions:
+            part.graph_store.flush()
 
     def stop(self) -> None:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
-        if self.sharded is not None:
-            self.sharded.stop()
+        for part in self.partitions:
+            part.stop()
         if self.compile_plane is not None:
             # detach the jax-logger capture and restore log_compiles
             self.compile_plane.stop()

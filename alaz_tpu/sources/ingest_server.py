@@ -12,10 +12,24 @@ Frame layout (little-endian, 16-byte header):
 
     u32 magic   = 0x414C5A31  ("ALZ1")
     u8  kind    = 1 l7 | 2 tcp | 3 proc | 4 native (AlzRecord rows)
-    u8  _pad[3]
+    u8  tenant  = tenant id (ISSUE 14); 0 = the primary/legacy tenant
+    u8  _pad[2]
     u32 count   = number of records
     u32 length  = payload bytes (must equal count * itemsize)
     ...payload  = `count` packed records of the kind's dtype
+
+The tenant byte occupies what was header padding, which legacy agents
+zero-fill — so a pre-tenancy frame IS a tenant-0 frame byte for byte
+and recorded traces replay unchanged. Frames route to the service's
+per-tenant ingest partition (``submit_*(…, tenant=)``); a tenant id the
+service has no partition for is refused at the door — its rows land in
+the service's dedicated REFUSED ledger (cause ``filtered``, surfaced as
+``degraded_snapshot()["refused"]`` + ``ingest.unknown_tenant``), never
+in any tenant's conservation books and never silently folded into
+another tenant's stream. The byte is unauthenticated like
+the rest of the header: deployments multiplexing mutually untrusted
+fleets must terminate per-tenant transport (one socket per fleet, or a
+TLS sidecar) in front of this listener.
 
 kind 4 bypasses the aggregator: records are the 32-byte AlzRecord wire
 format (graph/native.py) for pre-attributed edges pushed straight at the
@@ -58,8 +72,10 @@ log = get_logger("alaz_tpu.ingest_server")
 MAGIC = 0x414C5A31
 # Public: the 16-byte frame header IS the wire contract out-of-process
 # agents compile against (agent_example.cc FrameHeader). alazspec pins
-# its size/format in resources/specs/wire_layouts.json (ALZ021).
-FRAME_HEADER = struct.Struct("<IB3xII")
+# its size/format in resources/specs/wire_layouts.json (ALZ021). The
+# tenant byte (ISSUE 14) sits in the old pad region: same 16 bytes,
+# legacy zero-filled frames parse as tenant 0.
+FRAME_HEADER = struct.Struct("<IBB2xII")
 
 KIND_L7 = 1
 KIND_TCP = 2
@@ -89,10 +105,18 @@ MAX_RESYNC_BYTES_PER_CONN = 16 * 1024 * 1024
 MAX_QUARANTINED_FRAMES_PER_CONN = 64
 
 
-def pack_frame(kind: int, batch: np.ndarray) -> bytes:
-    """Client-side helper: one event batch → one wire frame."""
+def pack_frame(kind: int, batch: np.ndarray, tenant: int = 0) -> bytes:
+    """Client-side helper: one event batch → one wire frame. ``tenant``
+    names the fleet this batch belongs to (0 = primary/legacy)."""
+    from alaz_tpu.events.schema import MAX_TENANTS
+
+    if not 0 <= tenant < MAX_TENANTS:
+        raise ValueError(f"tenant must be in [0, {MAX_TENANTS}); got {tenant}")
     payload = np.ascontiguousarray(batch).tobytes()
-    return FRAME_HEADER.pack(MAGIC, kind, batch.shape[0], len(payload)) + payload
+    return (
+        FRAME_HEADER.pack(MAGIC, kind, tenant, batch.shape[0], len(payload))
+        + payload
+    )
 
 
 class IngestServer:
@@ -178,7 +202,11 @@ class IngestServer:
         # doesn't speak the wire record format
         store = getattr(service, "graph_store", None)
         self._native_store = store if hasattr(store, "push_records") else None
+        # separate warn-once latches: the two native-frame refusal modes
+        # have different operator fixes, and the first firing must not
+        # silence the other's diagnostic
         self._warned_no_native = False
+        self._warned_tenant_native = False
 
     def start(self) -> None:
         # self-register observability like every other component
@@ -375,7 +403,7 @@ class IngestServer:
                 header, carry = self._recv_exact(conn, FRAME_HEADER.size, carry)
                 if header is None:
                     return
-                magic, kind, count, length = FRAME_HEADER.unpack(header)
+                magic, kind, tenant, count, length = FRAME_HEADER.unpack(header)
                 if magic != MAGIC or length > MAX_FRAME_BYTES:
                     # header corruption: framing is lost — the count/
                     # length fields are untrustworthy, so no row count
@@ -392,7 +420,7 @@ class IngestServer:
                 payload, carry = self._recv_exact(conn, length, carry)
                 if payload is None:
                     return
-                ok = self._dispatch(kind, count, payload)
+                ok = self._dispatch(kind, count, payload, tenant)
                 if ok is None:
                     # well-formed but unsupported here (native frame on a
                     # numpy-store service): config mismatch, not protocol
@@ -420,7 +448,9 @@ class IngestServer:
         finally:
             conn.close()
 
-    def _dispatch(self, kind: int, count: int, payload: bytes | bytearray) -> bool | None:
+    def _dispatch(
+        self, kind: int, count: int, payload: bytes | bytearray, tenant: int = 0
+    ) -> bool | None:
         """True = accepted; False = malformed payload (quarantine the
         frame, keep the connection — framing held); None = well-formed
         but unsupported by this service's configuration."""
@@ -429,6 +459,18 @@ class IngestServer:
 
             if count * NATIVE_RECORD_DTYPE.itemsize != len(payload):
                 return False
+            if tenant:
+                # the C++ window accumulator is a single-tenant plane: a
+                # tenant-tagged native frame has no partition to land in
+                # (config mismatch, not protocol corruption)
+                if not self._warned_tenant_native:
+                    self._warned_tenant_native = True
+                    log.warning(
+                        "agent sent a tenant-tagged native frame; the "
+                        "native ring is single-tenant — use the event "
+                        "kinds for multi-tenant fleets"
+                    )
+                return None
             if self._native_store is None:
                 if not self._warned_no_native:
                     self._warned_no_native = True
@@ -446,12 +488,24 @@ class IngestServer:
         if dtype is None or count * dtype.itemsize != len(payload):
             return False
         batch = np.frombuffer(payload, dtype=dtype)
+        # tenant routing (ISSUE 14): tagged frames name their partition
+        # explicitly; untagged (legacy) frames take the positional path
+        # so pre-tenancy service duck-types keep working unchanged
         if kind == KIND_L7:
-            self.service.submit_l7(batch)
+            if tenant:
+                self.service.submit_l7(batch, tenant=tenant)
+            else:
+                self.service.submit_l7(batch)
         elif kind == KIND_TCP:
-            self.service.submit_tcp(batch)
+            if tenant:
+                self.service.submit_tcp(batch, tenant=tenant)
+            else:
+                self.service.submit_tcp(batch)
         else:
-            self.service.submit_proc(batch)
+            if tenant:
+                self.service.submit_proc(batch, tenant=tenant)
+            else:
+                self.service.submit_proc(batch)
         return True
 
 

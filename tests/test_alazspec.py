@@ -420,10 +420,10 @@ class TestEnumRoundTrip:
         ev["protocol"] = [int(p) for p, _ in pairs]
         ev["method"] = [int(m) for _, m in pairs]
         frame = pack_frame(KIND_L7, ev)
-        magic, kind, count, length = FRAME_HEADER.unpack(
+        magic, kind, tenant, count, length = FRAME_HEADER.unpack(
             frame[: FRAME_HEADER.size]
         )
-        assert (magic, kind, count) == (MAGIC, KIND_L7, len(pairs))
+        assert (magic, kind, tenant, count) == (MAGIC, KIND_L7, 0, len(pairs))
         back = np.frombuffer(frame[FRAME_HEADER.size :], dtype=L7_EVENT_DTYPE)
         decoded = {
             (int(r["protocol"]), int(r["method"])) for r in back

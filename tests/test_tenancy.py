@@ -1,0 +1,488 @@
+"""Multi-tenant serving plane (ISSUE 14): wire tenant routing, K=1
+parity against the raw pipelines, per-tenant isolation of ledgers /
+planes / namespaces, cross-tenant batching, sparse per-tenant
+observability, and the isolation replay gate.
+
+The K=1 parity tests reuse the PR 5/PR 12 equivalence methodology:
+windows through the tenancy plane must be bit-identical (canonical
+string-space comparison) to the raw Aggregator+WindowedGraphStore /
+ShardedIngest pipelines, and a single-tenant Service's score sketch
+must equal a plain ScorePlane folded over the same windows.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.config import ModelConfig, RuntimeConfig, TraceConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.schema import MAX_TENANTS, make_l7_events
+from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.obs.scores import ScorePlane, feature_scores
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.replay.tenants import (
+    host_score_fn,
+    host_score_many_fn,
+    run_isolation_scenario,
+    tenant_serving_bench,
+)
+from alaz_tpu.runtime.service import Service
+from alaz_tpu.runtime.tenancy import TenantPartition, validate_tenants
+from alaz_tpu.sources.ingest_server import (
+    FRAME_HEADER,
+    KIND_L7,
+    KIND_TCP,
+    MAGIC,
+    IngestServer,
+    pack_frame,
+)
+
+
+def _host_service(tenants: int = 1, batch_windows: int = 1, **cfg_kw) -> Service:
+    cfg = RuntimeConfig(
+        tenants=tenants,
+        score_batch_windows=batch_windows,
+        trace=TraceConfig(score_drift_windows=2),
+        **cfg_kw,
+    )
+    return Service(
+        config=cfg,
+        model_state={"host": True},
+        score_fn=host_score_fn,
+        score_many_fn=host_score_many_fn,
+        score_threshold=2.0,
+    )
+
+
+def _mk_batch(n_nodes, n_edges, seed=0, window_start_ms=1000):
+    rng = np.random.default_rng(seed)
+    node_feats = rng.normal(size=(n_nodes, 32)).astype(np.float32)
+    node_type = np.zeros(n_nodes, dtype=np.int32)
+    src = rng.integers(0, n_nodes, n_edges).astype(np.int32)
+    dst = rng.integers(0, n_nodes, n_edges).astype(np.int32)
+    etype = rng.integers(1, 9, n_edges).astype(np.int32)
+    ef = np.zeros((n_edges, 16), dtype=np.float32)
+    ef[:, 0] = np.log1p(rng.integers(1, 5, n_edges)).astype(np.float32)
+    ef[:, 1] = 0.5
+    ef[:, 3] = rng.random(n_edges).astype(np.float32) * 0.2
+    return GraphBatch.build(
+        node_feats=node_feats,
+        node_type=node_type,
+        edge_src=src,
+        edge_dst=dst,
+        edge_type=etype,
+        edge_feats=ef,
+        node_uids=np.arange(100, 100 + n_nodes, dtype=np.int32),
+        window_start_ms=window_start_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wire: the tenant byte in the frame header
+# ---------------------------------------------------------------------------
+
+
+class TestWire:
+    def test_legacy_frame_bytes_are_tenant_zero(self):
+        """A frame packed with the PRE-tenancy header struct (zero pad)
+        is byte-identical to a tenant-0 frame — recorded traces replay
+        unchanged."""
+        ev = make_l7_events(3)
+        new = pack_frame(KIND_L7, ev, tenant=0)
+        payload = np.ascontiguousarray(ev).tobytes()
+        legacy = struct.Struct("<IB3xII").pack(
+            MAGIC, KIND_L7, 3, len(payload)
+        ) + payload
+        assert new == legacy
+        magic, kind, tenant, count, length = FRAME_HEADER.unpack(
+            legacy[: FRAME_HEADER.size]
+        )
+        assert (magic, kind, tenant, count) == (MAGIC, KIND_L7, 0, 3)
+
+    def test_tenant_roundtrip_and_bounds(self):
+        ev = make_l7_events(2)
+        frame = pack_frame(KIND_L7, ev, tenant=7)
+        _, _, tenant, count, _ = FRAME_HEADER.unpack(frame[: FRAME_HEADER.size])
+        assert (tenant, count) == (7, 2)
+        with pytest.raises(ValueError):
+            pack_frame(KIND_L7, ev, tenant=MAX_TENANTS)
+        with pytest.raises(ValueError):
+            pack_frame(KIND_L7, ev, tenant=-1)
+
+    def test_server_routes_tenant_frames(self):
+        """Frames land in submit_* with their header tenant; legacy
+        (tenant-0) frames take the positional path so pre-tenancy duck
+        types stay compatible."""
+
+        class Sink:
+            graph_store = None
+            metrics = None
+            ledger = None
+
+            def __init__(self):
+                self.calls = []
+
+            def submit_l7(self, batch, tenant=0):
+                self.calls.append(("l7", tenant, int(batch.shape[0])))
+                return True
+
+            def submit_tcp(self, batch, tenant=0):
+                self.calls.append(("tcp", tenant, int(batch.shape[0])))
+                return True
+
+            def submit_proc(self, batch, tenant=0):
+                return True
+
+        sink = Sink()
+        server = IngestServer(sink, port=0)
+        server.start()
+        try:
+            ev = make_l7_events(5)
+            from alaz_tpu.events.schema import make_tcp_events
+
+            frames = (
+                pack_frame(KIND_L7, ev)  # legacy tenant 0
+                + pack_frame(KIND_L7, ev, tenant=3)
+                + pack_frame(KIND_TCP, make_tcp_events(2), tenant=1)
+            )
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect(server.address)
+            try:
+                s.sendall(frames)
+            finally:
+                s.close()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and len(sink.calls) < 3:
+                time.sleep(0.01)
+        finally:
+            server.stop()
+        assert sink.calls == [("l7", 0, 5), ("l7", 3, 5), ("tcp", 1, 2)]
+
+
+# ---------------------------------------------------------------------------
+# K=1 parity: the tenancy plane is bit-identical to the raw pipelines
+# ---------------------------------------------------------------------------
+
+
+def _canonical(interner, batches):
+    out = {}
+    for b in batches:
+        uids = b.node_uids
+        edges = []
+        for i in range(b.n_edges):
+            f = interner.lookup(int(uids[b.edge_src[i]]))
+            t = interner.lookup(int(uids[b.edge_dst[i]]))
+            edges.append(((f, t, int(b.edge_type[i])), b.edge_feats[i].tobytes()))
+        assert b.window_start_ms not in out, "window emitted twice"
+        out[b.window_start_ms] = sorted(edges)
+    return out
+
+
+def _run_raw_serial(ev, msgs, chunk=1 << 14):
+    interner = Interner()
+    closed = []
+    store = WindowedGraphStore(interner, window_s=1.0, on_batch=closed.append)
+    cluster = ClusterInfo(interner)
+    for m in msgs:
+        cluster.handle_msg(m)
+    agg = Aggregator(store, interner=interner, cluster=cluster)
+    for i in range(0, ev.shape[0], chunk):
+        agg.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+    store.flush()
+    return interner, closed
+
+
+def _run_partition(ev, msgs, workers, chunk=1 << 14):
+    """Drive ONE TenantPartition — the tenancy plane's host unit —
+    exactly as the service's workers would."""
+    closed = []
+    cfg = RuntimeConfig(ingest_workers=workers)
+    part = TenantPartition(0, cfg, on_batch=closed.append)
+    try:
+        for m in msgs:
+            part.aggregator.process_k8s(m)
+        for i in range(0, ev.shape[0], chunk):
+            part.aggregator.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        if part.sharded is not None:
+            assert part.sharded.flush(timeout_s=60.0)
+        else:
+            part.graph_store.flush()
+    finally:
+        part.stop()
+    return part.interner, closed
+
+
+class TestSingleTenantParity:
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_partition_matches_raw_serial_exactly(self, workers):
+        """Windows through a TenantPartition (serial and sharded
+        N∈{1,2}) equal the raw serial pipeline bit for bit — the PR 5
+        equivalence property, re-proven through the tenancy plane."""
+        n_rows = 30_000
+        ev, msgs = make_ingest_trace(n_rows, pods=60, svcs=10, windows=4, seed=5)
+        si, sb = _run_raw_serial(ev, msgs)
+        pi, pb = _run_partition(ev, msgs, workers)
+        ref, got = _canonical(si, sb), _canonical(pi, pb)
+        assert set(got) == set(ref)
+        for w in ref:
+            assert got[w] == ref[w], f"window {w} differs through the partition"
+
+    def test_single_tenant_service_sketch_matches_plain_plane(self):
+        """A K=1 Service driven through submit_l7 produces the same
+        score sketch (bucket counts — the PR 12 accounting) as a plain
+        ScorePlane folded over the raw pipeline's windows with the same
+        deterministic scorer."""
+        n_rows = 30_000
+        ev, msgs = make_ingest_trace(n_rows, windows=4, seed=6)
+        _, closed = _run_raw_serial(ev, msgs)
+        ref_plane = ScorePlane(enabled=True, model="ref", drift_windows=2)
+        for b in closed:
+            ref_plane.observe_window(b, feature_scores(b))
+
+        svc = _host_service(tenants=1)
+        svc.start()
+        try:
+            for m in msgs:
+                assert svc.submit_k8s(m)
+            deadline = time.monotonic() + 10
+            while svc.k8s_queue.unfinished and time.monotonic() < deadline:
+                time.sleep(0.005)
+            for i in range(0, n_rows, 1 << 14):
+                svc.submit_l7(ev[i : i + (1 << 14)])
+            svc.drain(30)
+            svc.flush_windows()
+            svc.drain(30)
+        finally:
+            svc.stop()
+        assert svc.scores is not None and svc.scores.enabled
+        assert svc.scores.windows == len(closed)
+        assert (
+            svc.scores.hist.bucket_counts() == ref_plane.hist.bucket_counts()
+        ), "tenancy-plane sketch diverged from the raw pipeline's"
+        # tenancy must stay invisible at K=1: no per-tenant suffixed
+        # series appear on the single-tenant scrape
+        assert not any(
+            ".t0" in k or ".t1" in k
+            for k in svc.metrics.snapshot()
+            if not k.startswith("latency.close_to_score_s")
+        )
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant isolation: namespaces, ledgers, planes
+# ---------------------------------------------------------------------------
+
+
+class TestMultiTenantIsolation:
+    def test_unknown_tenant_refused_and_ledgered(self):
+        svc = _host_service(tenants=2)
+        ev = make_l7_events(10)
+        assert not svc.submit_l7(ev, tenant=5)
+        assert not svc.submit_l7(ev, tenant=-1)
+        snap = svc.refused_ledger.snapshot()
+        assert snap["filtered"] == 20
+        assert snap["reasons"]["filtered/unknown_tenant"] == 20
+        # refusals never leak into ANY tenant's conservation books —
+        # not even tenant 0's (self.ledger aliases partition 0)
+        assert svc.ledger.total == 0
+        assert svc.partitions[1].ledger.total == 0
+        assert svc.degraded_snapshot()["refused"]["filtered"] == 20
+
+    def test_validation_guards(self):
+        with pytest.raises(ValueError):
+            validate_tenants(RuntimeConfig(tenants=MAX_TENANTS + 1), None, False)
+        with pytest.raises(ValueError):
+            validate_tenants(RuntimeConfig(tenants=2), None, True)  # native
+        cfg = RuntimeConfig(tenants=2, model=ModelConfig(model="tgn"))
+        with pytest.raises(ValueError):
+            validate_tenants(cfg, {"params": 1}, False)
+        # tgn without a model state is fine (no scorer, no memory)
+        assert validate_tenants(cfg, None, False) == 2
+
+    def test_per_tenant_planes_ledgers_and_sparse_series(self):
+        """Each tenant's windows land in ITS plane/ledger only; the
+        per-tenant metric series are absent until the tenant's first
+        window (no phantom zero scrapes)."""
+        svc = _host_service(tenants=3)
+        snap0 = svc.metrics.snapshot()
+        assert not any(".t1" in k or ".t2" in k for k in snap0)
+        svc.start()
+        try:
+            # only tenants 0 and 2 produce
+            for t, seed in ((0, 1), (2, 2)):
+                for w in range(3):
+                    svc._enqueue_window(
+                        _mk_batch(40, 200, seed=seed + w, window_start_ms=1000 * (w + 1)),
+                        tenant=t,
+                    )
+            svc.drain(20)
+        finally:
+            svc.stop()
+        assert svc.scored_batches == 6
+        p0, p2 = svc.tenant_scores(0), svc.tenant_scores(2)
+        assert p0 is not None and p0.windows == 3
+        assert p2 is not None and p2.windows == 3
+        assert svc.tenant_scores(1) is None  # idle tenant: absent, not zero
+        snap = svc.metrics.snapshot()
+        assert "scores.windows.t0" in snap and "scores.windows.t2" in snap
+        assert not any(".t1" in k for k in snap)
+        # per-tenant breakdown rides degraded_snapshot (health PUTs)
+        deg = svc.degraded_snapshot()
+        assert set(deg["tenants"]) == {"0", "1", "2"}
+        assert deg["tenants"]["0"]["scores"]["windows"] == 3
+        assert "scores" not in deg["tenants"]["1"]
+
+    def test_queue_isolation_drops_stay_per_tenant(self):
+        """Flooding one tenant's l7 queue sheds ITS rows into ITS
+        ledger; the other tenant's queue and ledger never move."""
+        cfg = RuntimeConfig(tenants=2, trace=TraceConfig(score_drift_windows=2))
+        cfg.queues.l7_events = 100
+        svc = Service(config=cfg)  # not started: queues fill, nothing drains
+        ev = make_l7_events(80)
+        assert svc.submit_l7(ev, tenant=1)
+        assert not svc.submit_l7(ev, tenant=1)  # over capacity: shed
+        assert svc.partitions[1].ledger.count("dropped") == 80
+        assert svc.partitions[0].ledger.total == 0
+        assert svc.partitions[0].l7_queue.pending_events == 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-tenant batching: one scorer, shared arenas, per-tenant books
+# ---------------------------------------------------------------------------
+
+
+class TestCrossTenantBatching:
+    def test_same_bucket_windows_pack_across_tenants(self):
+        """Same-bucket windows from K tenants collapse into shared
+        vmapped groups (dispatches < windows, at least one group mixes
+        tenants) while sketches, attribution and window order stay
+        per-tenant exact."""
+        svc = _host_service(tenants=3, batch_windows=4)
+        order = []
+        svc.score_observer = lambda b, t, lat: order.append(
+            (t, b.window_start_ms)
+        )
+        # enqueue 4 windows per tenant BEFORE the scorer starts: the
+        # backlog is then deterministic (a started scorer can race the
+        # enqueue loop and legitimately score groups of 1)
+        for w in range(4):
+            for t in range(3):
+                svc._enqueue_window(
+                    _mk_batch(40, 200, seed=10 * t + w,
+                              window_start_ms=1000 * (w + 1)),
+                    tenant=t,
+                )
+        svc.start()
+        try:
+            svc.drain(20)
+        finally:
+            svc.stop()
+        assert svc.scored_batches == 12
+        assert svc.score_dispatches < 12, "no grouping happened"
+        assert svc.multi_tenant_groups >= 1, "no group mixed tenants"
+        for t in range(3):
+            plane = svc.tenant_scores(t)
+            assert plane is not None and plane.windows == 4
+            wins = [w for tt, w in order if tt == t]
+            assert wins == sorted(wins) and len(wins) == 4
+        # plane contents match a per-tenant replay of the same windows
+        for t in range(3):
+            ref = ScorePlane(enabled=True, model="ref", drift_windows=2)
+            for w in range(4):
+                b = _mk_batch(40, 200, seed=10 * t + w,
+                              window_start_ms=1000 * (w + 1))
+                ref.observe_window(b, feature_scores(b))
+            assert (
+                svc.tenant_scores(t).hist.bucket_counts()
+                == ref.hist.bucket_counts()
+            )
+
+
+# ---------------------------------------------------------------------------
+# Endpoints: per-tenant /stats + /scores discipline
+# ---------------------------------------------------------------------------
+
+
+class TestTenantEndpoints:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_stats_and_scores_carry_tenant_breakdown(self):
+        from alaz_tpu.runtime.debug_http import DebugServer
+
+        svc = _host_service(tenants=2)
+        svc.start()
+        try:
+            for w in range(2):
+                svc._enqueue_window(
+                    _mk_batch(30, 100, seed=w, window_start_ms=1000 * (w + 1)),
+                    tenant=1,
+                )
+            svc.drain(20)
+        finally:
+            svc.stop()
+        server = DebugServer(svc, port=0)
+        port = server.start()
+        try:
+            code, body = self._get(port, "/stats")
+            assert code == 200
+            stats = json.loads(body)
+            assert set(stats["tenants"]) == {"0", "1"}
+            assert stats["tenants"]["1"]["windows_closed"] == 2
+            code, body = self._get(port, "/scores")
+            assert code == 200
+            scores = json.loads(body)
+            # tenant 0 never scored: absent from the dict, not zeroed
+            assert list(scores["tenants"]) == ["1"]
+            assert scores["tenants"]["1"]["windows"] == 2
+            code, body = self._get(port, "/scores/top?windows=1&tenant=1")
+            assert code == 200 and json.loads(body)
+            code, _ = self._get(port, "/scores/top?windows=1&tenant=0")
+            assert code == 404  # absent-not-zero
+            code, _ = self._get(port, "/scores/top?tenant=nope")
+            assert code == 400
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# The isolation gate + bench leg (scaled down for tier-1)
+# ---------------------------------------------------------------------------
+
+
+class TestIsolationScenario:
+    def test_isolation_gate_clean(self):
+        """Two tenants, one perturbed (retry storm): conservation exact
+        per tenant, clean tenant silent and inside its latency bound —
+        the `make scenarios` gate in miniature."""
+        rep = run_isolation_scenario(
+            tenants=2, seed=0, n_windows=6, pace_scale=0.1
+        )
+        assert rep.findings == [], rep.findings
+        clean = rep.per_tenant["0"]
+        assert clean["gap"] == 0 and clean["drift_events"] == 0
+        assert rep.per_tenant["1"]["perturbed"]
+
+    @pytest.mark.slow
+    def test_serving_bench_smoke(self):
+        out = tenant_serving_bench(2, n_rows=40_000, windows=4, seed=0)
+        assert out["windows_scored"] > 0
+        assert out["group_occupancy"] >= 1.0
+        assert set(out["per_tenant_p99_ms"]) == {"0", "1"}

@@ -44,6 +44,9 @@ ROW_PLANE_PREFIXES = (
     "alaz_tpu.utils.ledger",
     "alaz_tpu.graph.builder",
     "alaz_tpu.runtime.service",
+    # the tenancy plane (ISSUE 14) wires per-tenant queues/stores —
+    # row-holding construction, in scope like the service it partitions
+    "alaz_tpu.runtime.tenancy",
     # the export leg joined the ledger in ISSUE 12 (breaker sheds
     # attribute as the closed `shed` cause), so its drops are in scope
     # for ALZ040/043 like every other row holder's

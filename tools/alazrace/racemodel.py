@@ -88,6 +88,29 @@ _ROLE_PRIVATE_RE = re.compile(r"#\s*role-private(?::\s*(?P<why>\S.*))?")
 _MUTATING_SUBSCRIPT_WRITE = "container-write"
 
 
+def _unwrap_optional(ann: ast.AST) -> ast.AST:
+    """``Optional[X]`` / ``X | None`` → ``X`` — the common nullable
+    parameter shapes; anything else passes through unchanged."""
+    if isinstance(ann, ast.Subscript):
+        head = ann.value
+        name = getattr(head, "id", None) or getattr(head, "attr", None)
+        if name == "Optional":
+            inner = ann.slice
+            # py<3.9 wraps the slice in ast.Index
+            inner = getattr(inner, "value", inner)
+            return inner
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        parts = [ann.left, ann.right]
+        non_none = [
+            p
+            for p in parts
+            if not (isinstance(p, ast.Constant) and p.value is None)
+        ]
+        if len(non_none) == 1:
+            return non_none[0]
+    return ann
+
+
 @dataclass(frozen=True)
 class Role:
     name: str  # root qualname, or "main" for the folded entry surface
@@ -178,13 +201,32 @@ class RaceModel:
 
     def _infer_element_types(self) -> None:
         """attr -> element class for container attrs: ``self._streams =
-        {k: _Stream(...)}`` / ``[Cls(...) for ...]`` / ``[Cls(...)]`` —
-        the alazflow queue-element idea generalized to any project
-        class, so ``stream.sent`` on a dict-valued local resolves."""
+        {k: _Stream(...)}`` / ``[Cls(...) for ...]`` / ``[Cls(...)]`` /
+        ``self.partitions.append(Cls(...))`` (the grow-in-a-loop wiring
+        shape, ISSUE 14) — the alazflow queue-element idea generalized
+        to any project class, so ``stream.sent`` on a dict-valued local
+        resolves. An attr whose initializers/appends name more than one
+        class stays untyped (conservative)."""
         for cqn, cinfo in self.model.classes.items():
             mod = self.model.module_of[id(cinfo.ctx)]
-            out: Dict[str, str] = {}
+            candidates: Dict[str, set] = {}
             for node in ast.walk(cinfo.node):
+                if isinstance(node, ast.Call):
+                    # self.<attr>.append(Cls(...)) — element type via the
+                    # grower call, not the (often empty-[]) initializer
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr == "append"
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Call)
+                    ):
+                        attr = _self_attr(f.value)
+                        if attr is not None:
+                            t = self.model.resolve_class(mod, node.args[0].func)
+                            if t is not None:
+                                candidates.setdefault(attr, set()).add(t)
+                    continue
                 targets: List[ast.AST] = []
                 if isinstance(node, ast.Assign):
                     targets, v = node.targets, node.value
@@ -209,13 +251,14 @@ class RaceModel:
                         t = self.model.resolve_class(mod, e.func)
                         if t is not None:
                             classes.add(t)
-                if len(classes) != 1:
+                if not classes:
                     continue
                 for t in targets:
                     attr = _self_attr(t)
                     if attr is not None:
-                        out[attr] = classes.pop()
+                        candidates.setdefault(attr, set()).update(classes)
                         break
+            out = {a: cs.pop() for a, cs in candidates.items() if len(cs) == 1}
             if out:
                 self._elem_types[cqn] = out
 
@@ -232,7 +275,14 @@ class RaceModel:
           ``self.<attr> = <param>`` stores. This is what lets the
           per-process singletons (Interner, Metrics, recorder/ledger
           planes) that are constructed at wiring time and THREADED
-          through constructors join the escape closure.
+          through constructors join the escape closure;
+        - ``self.<attr> = <typed expr>`` stores — the expr typed through
+          the same local/param/attr-chain resolver the summaries use
+          (``self.graph_store = p0.graph_store`` with ``p0 = self.
+          partitions[0]``, ``self.tracer = tracer`` after a
+          ``tracer = SpanTracer(...)`` branch): the ISSUE 14 partition
+          aliasing shape, without which whole planes (SpanTracer,
+          FlightRecorder) fall out of the escape closure.
         """
 
         def branch_type(mod: str, value: ast.AST) -> Optional[str]:
@@ -266,8 +316,9 @@ class RaceModel:
                     if attr is not None and attr not in cinfo.attr_types:
                         cinfo.attr_types[attr] = t
 
-        # fixpoint: ctor-arg Name/self.attr typing (each round can
-        # unlock the next hop of an interner-style threading chain)
+        # fixpoint: ctor-arg Name/self.attr typing + typed-expr stores
+        # (each round can unlock the next hop of an interner-style
+        # threading chain, or the next alias in a partition chain)
         for _ in range(6):
             changed = False
             for ctx in self.ctxs:
@@ -294,8 +345,62 @@ class RaceModel:
                         if t is not None:
                             tinfo.attr_types[attr] = t
                             changed = True
+            for cqn, cinfo in self.model.classes.items():
+                mod = self.model.module_of[id(cinfo.ctx)]
+                for node in ast.walk(cinfo.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    attr = (
+                        _self_attr(node.targets[0])
+                        if len(node.targets) == 1
+                        else None
+                    )
+                    if attr is None or attr in cinfo.attr_types:
+                        continue
+                    t = self._stored_expr_type(cinfo, mod, node)
+                    if t is not None:
+                        cinfo.attr_types[attr] = t
+                        changed = True
             if not changed:
                 break
+
+    def _stored_expr_type(self, cinfo, mod: str, node: ast.Assign) -> Optional[str]:
+        """Type of the value in a ``self.<attr> = <expr>`` store, via
+        the enclosing method's typed locals/params and attr chains."""
+        encl_qn, encl_cls = self._enclosing(cinfo.ctx, node)
+        if encl_qn is None:
+            return None
+        info = self.model.functions.get(encl_qn)
+        if info is None:
+            return None
+        local_types = self._local_types(info, mod, encl_cls)
+
+        def rc(base: ast.AST) -> Optional[str]:
+            if isinstance(base, ast.Name):
+                if base.id == "self" and encl_cls is not None:
+                    return f"{mod}:{encl_cls.name}"
+                return local_types.get(base.id)
+            if isinstance(base, ast.Attribute):
+                owner = rc(base.value)
+                if owner is not None:
+                    oinfo = self.model.classes.get(owner)
+                    if oinfo is not None:
+                        return oinfo.attr_types.get(base.attr)
+            if isinstance(base, ast.Subscript):
+                owner = rc(base.value) if not isinstance(
+                    base.value, ast.Attribute
+                ) else None
+                attr = _self_attr(base.value)
+                if attr is not None and encl_cls is not None:
+                    elem = self._elem_types.get(f"{mod}:{encl_cls.name}", {})
+                    return elem.get(attr)
+                return owner
+            return None
+
+        v = node.value
+        if isinstance(v, (ast.Name, ast.Attribute, ast.Subscript)):
+            return rc(v)
+        return None
 
     def _expr_type(
         self,
@@ -546,6 +651,20 @@ class RaceModel:
                     oinfo = self.model.classes.get(owner)
                     if oinfo is not None:
                         return oinfo.methods.get(fn.attr)
+            if isinstance(fn, ast.Name):
+                # SIBLING nested defs: a worker's helper closures call
+                # each other by bare name (``finish`` → ``score_one`` in
+                # the scorer loop); resolve up the enclosing FUNCTION
+                # chain only — stopping at the class boundary keeps a
+                # bare global/builtin call from aliasing a method name
+                parts = qn.split(".")
+                for i in range(len(parts) - 1, 0, -1):
+                    prefix = ".".join(parts[:i])
+                    if prefix not in self.model.functions:
+                        break
+                    cand = f"{prefix}.{fn.id}"
+                    if cand in self.model.functions:
+                        return cand
             return None
 
         def callback_targets(node: ast.Call) -> List[str]:
@@ -648,8 +767,54 @@ class RaceModel:
     ) -> Dict[str, str]:
         """Locals with an evident project class: ``x = Cls(...)``,
         ``x = self.<attr>`` (typed attr), ``x = self.<container attr>[k]``
-        (element type), and ``for x in self.<container>.values()``."""
+        (element type), ``for x in self.<container>.values()`` — and
+        ANNOTATED PARAMETERS (``def _l7_worker(self, part:
+        TenantPartition)``): worker entry points handed their state as a
+        typed argument (the ISSUE 14 partition shape) must stay visible
+        to the escape closure, or every field behind the parameter
+        silently leaves the analysis."""
         out: Dict[str, str] = {}
+        # closure inheritance: a nested def sees the enclosing
+        # function's typed locals (the ``part`` a worker's ``handle``
+        # closes over) exactly as ``_eff_cls`` lets it see ``self`` —
+        # without this, the whole partition object vanishes from the
+        # nested summary's escape closure. Own bindings override.
+        encl = None
+        for anc in info.ctx.ancestors(info.node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                encl = anc
+                break
+        if encl is not None:
+            encl_qn = self._fn_of_node.get(id(encl))
+            einfo = (
+                self.model.functions.get(encl_qn) if encl_qn is not None else None
+            )
+            if einfo is not None:
+                out.update(
+                    self._local_types(einfo, mod, self._eff_cls.get(encl_qn))
+                )
+        fnode = info.node
+        if isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = fnode.args
+            for a in (
+                list(getattr(args, "posonlyargs", []))
+                + list(args.args)
+                + list(args.kwonlyargs)
+            ):
+                ann = a.annotation
+                if ann is None:
+                    continue
+                if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    # quoted forward reference: parse the name expression
+                    try:
+                        ann = ast.parse(ann.value, mode="eval").body
+                    except SyntaxError:
+                        continue
+                ann = _unwrap_optional(ann)
+                if isinstance(ann, (ast.Name, ast.Attribute)):
+                    ty = self.model.resolve_class(mod, ann)
+                    if ty is not None:
+                        out[a.arg] = ty
         cinfo = (
             self.model.classes.get(f"{mod}:{cls.name}") if cls is not None else None
         )

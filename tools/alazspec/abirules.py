@@ -402,6 +402,11 @@ def wire_layout_table() -> dict:
             "header_format": srv.FRAME_HEADER.format,
             "magic": f"0x{srv.MAGIC:08X}",
             "max_frame_bytes": srv.MAX_FRAME_BYTES,
+            # tenancy contract (ISSUE 14): the tenant byte rides the old
+            # pad region — a width or offset change here desyncs every
+            # fleet-tagged agent, so both sides are pinned
+            "tenant_bits": int(schema.TENANT_WIRE_BITS),
+            "max_tenants": int(schema.MAX_TENANTS),
             "kinds": {
                 str(srv.KIND_L7): "L7_EVENT_DTYPE",
                 str(srv.KIND_TCP): "TCP_EVENT_DTYPE",
