@@ -25,8 +25,8 @@ flow:  ## alazflow: whole-program row-conservation + blocking-discipline dataflo
 race:  ## alazrace: whole-program thread-escape + lockset race detection (ALZ050-ALZ054), incl. golden concurrency-map drift (resources/specs/threads.json)
 	python -m tools.alazrace --json
 
-chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates + one composed scenario×chaos case + the two-tenant worker-kill conservation composition (no accelerator needed)
-	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2 --composed hot_key --tenants
+chaos:  ## chaos suite sweep: fixed seeds, all four fault seams, invariant gates + one composed scenario×chaos case + the two-tenant worker-kill conservation composition + the process-backend pipeline leg (SIGKILL mid-wave, ISSUE 15) — no accelerator needed
+	env JAX_PLATFORMS=cpu python -m alaz_tpu.chaos --seeds 0 1 2 --workers 2 --composed hot_key --tenants --ingest-backend both
 
 scenarios:  ## incident scenario sweep (ISSUE 7): fixed seeds, all five scenarios, host-plane + detection gates, the hot_key 500k-fan-in stress bound, plus the K=3 multi-tenant isolation gate (ISSUE 14)
 	env JAX_PLATFORMS=cpu python -m alaz_tpu.replay --seeds 0 --workers 2 --stress --isolation

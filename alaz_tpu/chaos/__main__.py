@@ -27,6 +27,15 @@ def main(argv=None) -> int:
         "--legs", nargs="+", default=["pipeline", "frames", "backend"],
         choices=["pipeline", "frames", "backend"],
     )
+    p.add_argument(
+        "--ingest-backend", default="thread",
+        choices=["thread", "process", "both"],
+        help="sharded-ingest backend for the pipeline leg (ISSUE 15): "
+        "'process' SIGKILLs real shard processes mid-wave instead of "
+        "raising in threads; 'both' runs the thread suite as usual and "
+        "then a process-mode pipeline leg per seed (same conservation/"
+        "monotonic/self-healing gates through the kills)",
+    )
     from alaz_tpu.replay.incidents import SCENARIO_NAMES
 
     p.add_argument(
@@ -47,6 +56,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     failed = 0
+    first_backend = (
+        "process" if args.ingest_backend == "process" else "thread"
+    )
     for seed in args.seeds:
         cfg = ChaosConfig(enabled=True, seed=seed)
         rep = run_chaos_suite(
@@ -54,10 +66,29 @@ def main(argv=None) -> int:
             n_workers=args.workers,
             n_rows=args.rows,
             legs=tuple(args.legs),
+            ingest_backend=first_backend,
         )
         print(json.dumps(rep.as_dict(), sort_keys=True))
         if not rep.ok:
             failed += 1
+    if args.ingest_backend == "both" and "pipeline" in args.legs:
+        # process-mode pipeline leg per seed (ISSUE 15): the same
+        # worker-seam faults land as SIGKILLs on real shard processes;
+        # the conservation/monotonic/self-healing gates must hold
+        # through the kill (frames/backend legs are backend-independent
+        # and already ran above)
+        for seed in args.seeds:
+            cfg = ChaosConfig(enabled=True, seed=seed)
+            rep = run_chaos_suite(
+                cfg,
+                n_workers=args.workers,
+                n_rows=args.rows,
+                legs=("pipeline",),
+                ingest_backend="process",
+            )
+            print(json.dumps(rep.as_dict(), sort_keys=True))
+            if not rep.ok:
+                failed += 1
     if args.composed and args.composed != "none":
         from alaz_tpu.replay.incidents import run_incident_scenario
 

@@ -110,14 +110,12 @@ def _run_pipeline_leg(
     n_windows: int,
     findings: List[str],
     recorder: Optional[FlightRecorder] = None,
+    backend: str = "thread",
 ) -> dict:
     ev, msgs = make_ingest_trace(
         n_rows, pods=60, svcs=10, windows=n_windows, seed=cfg.seed
     )
     interner = Interner()
-    cluster = ClusterInfo(interner)
-    for m in msgs:
-        cluster.handle_msg(m)
     ledger = DropLedger()
     closed: List = []
     wchaos = WorkerChaos(
@@ -168,17 +166,42 @@ def _run_pipeline_leg(
                     item_kind=kind, effect=effect,
                 )
 
-    pipe = ShardedIngest(
-        n_workers,
-        interner=interner,
-        cluster=cluster,
-        window_s=1.0,
-        on_batch=closed.append,
-        ledger=ledger,
-        fault_hook=fault_hook,
-        shed_block_s=0.5,
-        recorder=recorder,
-    )
+    if backend == "process":
+        # process-mode pipeline (ISSUE 15): SAME seams, SAME gates. The
+        # worker seam's WorkerCrash verdicts become SIGKILLs of real
+        # shard processes mid-wave — conservation must hold through a
+        # kill that freezes the worker's books mid-flight. Topology goes
+        # through process_k8s (the ring broadcast): a pre-folded shared
+        # ClusterInfo cannot cross the spawn boundary.
+        from alaz_tpu.shm.process_pool import ProcessShardedIngest
+
+        pipe = ProcessShardedIngest(
+            n_workers,
+            interner=interner,
+            window_s=1.0,
+            on_batch=closed.append,
+            ledger=ledger,
+            fault_hook=fault_hook,
+            shed_block_s=0.5,
+            recorder=recorder,
+        )
+        for m in msgs:
+            pipe.process_k8s(m)
+    else:
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        pipe = ShardedIngest(
+            n_workers,
+            interner=interner,
+            cluster=cluster,
+            window_s=1.0,
+            on_batch=closed.append,
+            ledger=ledger,
+            fault_hook=fault_hook,
+            shed_block_s=0.5,
+            recorder=recorder,
+        )
     t0 = time.perf_counter()
     try:
         for c in delivery:
@@ -249,6 +272,7 @@ def _run_pipeline_leg(
             "pipeline: late delivery injected but nothing ledgered as late"
         )
     return {
+        "backend": backend,
         "delivered_rows": delivered,
         "emitted_rows": emitted,
         "windows": len(closed),
@@ -500,6 +524,7 @@ def run_chaos_suite(
     n_rows: int = 48_000,
     n_windows: int = 5,
     legs: tuple = ("pipeline", "frames", "backend"),
+    ingest_backend: str = "thread",
 ) -> ChaosReport:
     """One full chaos run at ``cfg`` intensity (default intensities with
     ``seed`` when only a seed is given). Deterministic per (cfg, seed)
@@ -531,7 +556,7 @@ def run_chaos_suite(
     if "pipeline" in legs:
         report.pipeline = _run_pipeline_leg(
             cfg, n_workers, n_rows, n_windows, report.findings,
-            recorder=recorder,
+            recorder=recorder, backend=ingest_backend,
         )
     if "frames" in legs:
         report.frames = _run_frame_leg(cfg, report.findings, recorder=recorder)

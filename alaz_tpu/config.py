@@ -458,6 +458,22 @@ class RuntimeConfig:
     # by cores and the GIL-held fraction of process_l7 (ARCHITECTURE
     # §3f); size to physical cores, not hyperthreads.
     ingest_workers: int = 1
+    # sharded-ingest backend (ISSUE 15, ARCHITECTURE §3r): "thread" runs
+    # the shard workers as threads over the shared interner (GIL-bound —
+    # measured 1.22× at 2 workers); "process" runs them as spawned
+    # PROCESSES over shared-memory rings (alaz_tpu/shm) with a
+    # per-process interner and id-exchange at merge — the out-of-GIL
+    # path. Bit-identical output either way (property-tested); process
+    # mode refuses an export tee (worker rows carry local interner ids)
+    # and needs a picklable label_fn. "process" also applies at
+    # ingest_workers == 1 (ingest leaves the serving process's GIL).
+    ingest_backend: str = "thread"
+    # shm ring geometry (process backend only; alazspec pins the layout
+    # in wire_layouts.json `shm_ring`): bytes per fixed slot and slots
+    # per ring. A scattered chunk must fit in ring_slots - 1 slots;
+    # per-worker cost is 2 rings × slot_bytes × ring_slots of /dev/shm.
+    shm_slot_bytes: int = 65_536
+    shm_ring_slots: int = 512
     # multi-tenant serving plane (ISSUE 14, runtime/tenancy.py): >1
     # partitions the HOST plane per tenant — each tenant gets its own
     # interner namespace, drop ledger, source queues, watermarks and
@@ -522,6 +538,9 @@ class RuntimeConfig:
             renumber_nodes=env_bool("RENUMBER_NODES", False),
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
             ingest_workers=env_int("INGEST_WORKERS", 1),
+            ingest_backend=env_str("INGEST_BACKEND", "thread"),
+            shm_slot_bytes=env_int("SHM_SLOT_BYTES", 65_536),
+            shm_ring_slots=env_int("SHM_RING_SLOTS", 512),
             tenants=env_int("TENANTS", 1),
             shed_block_s=env_float("SHED_BLOCK_S", 5.0),
             degree_cap=env_int("DEGREE_CAP", 0),

@@ -123,3 +123,11 @@ class Interner:
     def snapshot(self) -> List[str]:
         with self._lock:
             return list(self._strings)
+
+    def strings_since(self, start: int) -> List[str]:
+        """Rows ``[start, len)`` of the string table — the interner
+        DELTA a process-mode shard worker ships at merge so the parent
+        can fold its locally-assigned ids into the shared table
+        (alaz_tpu/shm id-exchange, ISSUE 15)."""
+        with self._lock:
+            return self._strings[start:]

@@ -132,25 +132,30 @@ class SpanTracer:
             self._live[w] = span  # alazlint: disable=ALZ010 -- caller holds self._lock (_locked contract)
         return span
 
-    def first_row(self, window_start_ms: int) -> None:
+    def first_row(self, window_start_ms: int, t: Optional[float] = None) -> None:
         """First row of the window seen at the persist mouth; idempotent
-        (only the first call sets the span's origin)."""
+        (only the first call sets the span's origin). ``t`` lets a
+        cross-process pipeline (alaz_tpu/shm, ISSUE 15) backdate the
+        origin to the shard worker's own CLOCK_MONOTONIC stamp — the
+        clock is system-wide, so the residency math still closes."""
         if not self.enabled:
             return
         w = int(window_start_ms)
-        now = time.perf_counter()
+        now = time.perf_counter() if t is None else float(t)
         with self._lock:
             self._get_or_create_locked(w, now)
 
-    def close_start(self, window_start_ms: int) -> None:
+    def close_start(self, window_start_ms: int, t: Optional[float] = None) -> None:
         """The close wave reached this window: the elapsed time since
         first_row becomes the ``scatter`` stage (open-window residency —
         ingest, queueing, watermark wait). First caller wins; the other
-        shards' close pops are covered by ``shard_close``."""
+        shards' close pops are covered by ``shard_close``. ``t`` as in
+        :meth:`first_row` — the process backend stamps close time on the
+        worker's clock."""
         if not self.enabled:
             return
         w = int(window_start_ms)
-        now = time.perf_counter()
+        now = time.perf_counter() if t is None else float(t)
         with self._lock:
             span = self._get_or_create_locked(w, now)
             if "scatter" not in span.stages:

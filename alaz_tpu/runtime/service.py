@@ -524,6 +524,22 @@ class Service:
                 "ingest.sampled_edges",
                 lambda: self.sharded.builder.sampled_edges,
             )
+            # process backend only (ISSUE 15, alaz_tpu/shm): shared-
+            # memory ring occupancy — slots committed but not yet
+            # consumed, summed across workers per direction. A climbing
+            # request-side number is a worker falling behind; a climbing
+            # response side is the merge thread falling behind.
+            if hasattr(self.sharded, "shm_req_pending"):
+                # lock-free cursor reads per scrape (never the per-ring
+                # put_lock the scatter path contends on)
+                self.metrics.gauge(
+                    "ingest.shm_req_pending_slots",
+                    lambda: self.sharded.shm_req_pending(),
+                )
+                self.metrics.gauge(
+                    "ingest.shm_resp_pending_slots",
+                    lambda: self.sharded.shm_resp_pending(),
+                )
         elif isinstance(self.graph_store, WindowedGraphStore):
             self.metrics.gauge(
                 "ingest.sampled_edges",
@@ -1158,6 +1174,11 @@ class Service:
             out["worker_restarts"] = self.sharded.worker_restarts
             out["last_wave_age_s"] = round(self.sharded.last_wave_age_s, 3)
             out["shard_backlog"] = self.sharded.unfinished
+            if hasattr(self.sharded, "ring_stats"):
+                # process backend (ISSUE 15): per-worker ring occupancy
+                # and respawn generations — which shard is behind, and
+                # whether its process has been dying
+                out["shm_rings"] = self.sharded.ring_stats()
         be = self._export_backend
         if be is not None and getattr(be, "ledger", None) is not None:
             # the export leg's OWN ledger (breaker sheds) — reported

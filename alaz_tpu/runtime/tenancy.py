@@ -118,6 +118,14 @@ class TenantPartition:
 
         renumber = getattr(config, "renumber_nodes", False)
         ingest_workers = max(1, int(getattr(config, "ingest_workers", 1)))
+        ingest_backend = str(
+            getattr(config, "ingest_backend", "thread") or "thread"
+        ).lower()
+        if ingest_backend not in ("thread", "process"):
+            raise ValueError(
+                f"ingest_backend must be 'thread' or 'process', got "
+                f"{ingest_backend!r} (INGEST_BACKEND)"
+            )
         degree_cap = max(0, int(getattr(config, "degree_cap", 0)))
         sample_seed = int(getattr(config, "sample_seed", 0))
 
@@ -153,12 +161,19 @@ class TenantPartition:
                     "native ingest requested but library unavailable; "
                     "using numpy store"
                 )
-        if self.graph_store is None and ingest_workers > 1:
-            # sharded multi-worker ingest (aggregator/sharded.py): the
-            # pipeline IS both the aggregator (ingestion surface) and
-            # the windowed store — one object plays both roles. Each
-            # tenant gets its OWN pool: worker threads, shard queues and
-            # close waves are never shared across fleets.
+        if self.graph_store is None and (
+            ingest_workers > 1 or ingest_backend == "process"
+        ):
+            # sharded multi-worker ingest: the pipeline IS both the
+            # aggregator (ingestion surface) and the windowed store —
+            # one object plays both roles. Each tenant gets its OWN
+            # pool: shard workers, queues/rings and close waves are
+            # never shared across fleets. Backend per config
+            # (ISSUE 15): "thread" = aggregator/sharded.py over the
+            # shared interner; "process" = alaz_tpu/shm spawn workers
+            # over shared-memory rings with id-exchange at merge (the
+            # out-of-GIL path; applies even at ingest_workers == 1 so
+            # ingest leaves the serving process's GIL).
             from alaz_tpu.aggregator.sharded import ShardedIngest
 
             # soak mode (CHAOS_ENABLED=1): per-partition injector so
@@ -178,22 +193,52 @@ class TenantPartition:
                 log.warning(
                     "chaos soak enabled: worker-seam fault injection live"
                 )
-            self.sharded = ShardedIngest(
-                ingest_workers,
-                interner=self.interner,
-                config=config,
-                window_s=config.window_s,
-                on_batch=on_batch,
-                renumber=renumber,
-                tee=export_backend,
-                ledger=self.ledger,
-                shed_block_s=config.shed_block_s,
-                fault_hook=self.fault_hook,
-                degree_cap=degree_cap,
-                sample_seed=sample_seed,
-                tracer=self.tracer,
-                recorder=recorder,
-            )
+            if ingest_backend == "process":
+                from alaz_tpu.shm.process_pool import ProcessShardedIngest
+
+                if export_backend is not None:
+                    # worker REQUEST rows carry process-LOCAL interner
+                    # ids — an export tee would resolve them against the
+                    # wrong table and ship another fleet's names. Refuse
+                    # loudly; the thread backend keeps the tee.
+                    raise ValueError(
+                        "ingest_backend=process cannot drive the export "
+                        "backend tee (worker rows carry process-local "
+                        "interner ids); use INGEST_BACKEND=thread with "
+                        "the export backend, or export from scores"
+                    )
+                self.sharded = ProcessShardedIngest(
+                    ingest_workers,
+                    interner=self.interner,
+                    config=config,
+                    window_s=config.window_s,
+                    on_batch=on_batch,
+                    renumber=renumber,
+                    ledger=self.ledger,
+                    shed_block_s=config.shed_block_s,
+                    fault_hook=self.fault_hook,
+                    degree_cap=degree_cap,
+                    sample_seed=sample_seed,
+                    tracer=self.tracer,
+                    recorder=recorder,
+                )
+            else:
+                self.sharded = ShardedIngest(
+                    ingest_workers,
+                    interner=self.interner,
+                    config=config,
+                    window_s=config.window_s,
+                    on_batch=on_batch,
+                    renumber=renumber,
+                    tee=export_backend,
+                    ledger=self.ledger,
+                    shed_block_s=config.shed_block_s,
+                    fault_hook=self.fault_hook,
+                    degree_cap=degree_cap,
+                    sample_seed=sample_seed,
+                    tracer=self.tracer,
+                    recorder=recorder,
+                )
             self.graph_store = self.sharded
         if self.graph_store is None:
             self.graph_store = WindowedGraphStore(

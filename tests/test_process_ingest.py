@@ -1,0 +1,706 @@
+"""Process-mode sharded ingest (ISSUE 15): the shm ring plane, the
+id-exchange merge, and the headline property —
+
+    serial ≡ thread-mode ShardedIngest ≡ ProcessShardedIngest
+
+for N ∈ {1, 2, 4}: same windows, same edges, bit-exact features, via
+the PR 5 interner-string canonicalization (worker interners number
+independently per PROCESS now, so the exchange is what's under test).
+Plus: exact row conservation through SIGKILLed shard processes
+(replay-or-attribute, never lose silently), degree-cap parity across
+the id-exchange (priorities are uid-pure and the parent interner is the
+priority domain), the tenancy smoke, the shm ABI golden, and the
+alazrace process-role carve-out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator
+from alaz_tpu.aggregator.sharded import ShardedIngest
+from alaz_tpu.config import RuntimeConfig
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.schema import L7_EVENT_DTYPE
+from alaz_tpu.graph.builder import WindowedGraphStore
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.shm import codec
+from alaz_tpu.shm.process_pool import ProcessShardedIngest
+from alaz_tpu.shm.ring import (
+    K_L7,
+    K_STOP,
+    RingClosed,
+    RingConsumer,
+    RingProducer,
+    ShmRing,
+)
+from tests.test_sharded_ingest import _canonical, _node_stats, _run_serial
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# ring units
+# ---------------------------------------------------------------------------
+
+
+class TestShmRing:
+    def test_roundtrip_wrap_and_capacity(self):
+        r = ShmRing(slot_bytes=256, n_slots=16, create=True)
+        try:
+            p, c = RingProducer(r), RingConsumer(r)
+            for k in range(200):  # many laps around a 16-slot ring
+                payload = bytes([k % 251]) * (k * 37 % 800)
+                assert p.put(K_L7, payload, rows=k, now_ns=k, timeout=1.0)
+                rec = c.get(timeout=1.0)
+                assert rec is not None and rec.kind == K_L7
+                assert rec.rows == k and rec.now_ns == k
+                assert bytes(rec.payload) == payload
+            # fill to capacity, then drain exactly that many
+            big = b"x" * 500
+            put = 0
+            while p.try_put(K_L7, big, rows=1):
+                put += 1
+            assert put > 0
+            got = 0
+            while c.try_get() is not None:
+                got += 1
+            assert got == put
+        finally:
+            r.detach()
+            r.unlink()
+
+    def test_view_commit_defers_slot_reuse(self):
+        """The zero-copy contract: an uncommitted record's slots are
+        RESERVED — the producer cannot overwrite them — and the
+        persisted tail replays the record to a fresh consumer (the
+        SIGKILL-mid-record semantics)."""
+        r = ShmRing(slot_bytes=128, n_slots=8, create=True)
+        try:
+            p, c = RingProducer(r), RingConsumer(r)
+            assert p.put(K_L7, b"a" * 300, rows=3, timeout=1.0)
+            rec = c.try_get_view()
+            assert rec is not None and bytes(rec.payload) == b"a" * 300
+            # slots reserved: a record that would need them won't fit
+            fills = 0
+            while p.try_put(K_L7, b"b" * 300, rows=1):
+                fills += 1
+            assert fills < 8
+            # a second view before commit is a protocol error
+            with pytest.raises(RuntimeError):
+                c.try_get_view()
+            # a FRESH consumer from the persisted tail REPLAYS the
+            # uncommitted record — exactly what a respawned worker sees
+            c2 = RingConsumer(r)
+            rec2 = c2.try_get_view()
+            assert bytes(rec2.payload) == b"a" * 300
+            c2.commit()
+            assert r.tail > 0
+            # drop the zero-copy views BEFORE detach: an exported
+            # pointer would pin the segment mapping open
+            rec = rec2 = None
+        finally:
+            r.detach()
+            r.unlink()
+
+    def test_wrap_pad_big_record_cannot_livelock(self):
+        """A record near ring capacity arriving at a mid-ring position:
+        pad + span exceeds the WHOLE ring, so reserving both at once
+        can never succeed — the pad must commit independently (cursor
+        advances to slot 0) or the put retries forever at the same
+        position (the review-caught livelock)."""
+        r = ShmRing(slot_bytes=128, n_slots=16, create=True)
+        try:
+            p, c = RingProducer(r), RingConsumer(r)
+            assert p.put(K_L7, b"x" * 400, rows=1, timeout=1.0)  # span 4
+            assert c.get(timeout=1.0) is not None  # tail = 4
+            big = b"y" * (128 * 13)  # span 14 of 15 usable
+            # first attempt commits the wrap pad (cursor → slot 0) and
+            # reports no room for the record yet
+            assert not p.try_put(K_L7, big, rows=1)
+            assert p.cursor % r.n_slots == 0
+            # consumer skips the pad, freeing the whole ring
+            assert c.try_get() is None  # only the pad was pending
+            assert p.try_put(K_L7, big, rows=1)
+            rec = c.get(timeout=1.0)
+            assert rec is not None and bytes(rec.payload) == big
+        finally:
+            r.detach()
+            r.unlink()
+
+    def test_closed_latch_raises_on_put(self):
+        r = ShmRing(slot_bytes=128, n_slots=8, create=True)
+        try:
+            p = RingProducer(r)
+            r.close_ring()
+            with pytest.raises(RingClosed):
+                p.try_put(K_STOP, b"")
+        finally:
+            r.detach()
+            r.unlink()
+
+    def test_oversized_record_refused_loudly(self):
+        r = ShmRing(slot_bytes=128, n_slots=8, create=True)
+        try:
+            with pytest.raises(ValueError, match="SHM_SLOT_BYTES"):
+                RingProducer(r).try_put(K_L7, b"z" * (128 * 8), rows=1)
+        finally:
+            r.detach()
+            r.unlink()
+
+    def test_put_rows_gathers_into_the_slot(self):
+        ev = np.zeros(64, dtype=L7_EVENT_DTYPE)
+        ev["pid"] = np.arange(64)
+        idx = np.flatnonzero(ev["pid"] % 2 == 0)
+        r = ShmRing(slot_bytes=4096, n_slots=16, create=True)
+        try:
+            p, c = RingProducer(r), RingConsumer(r)
+            assert p.try_put_rows(K_L7, ev, idx)
+            rec = c.get(timeout=1.0)
+            out = codec.decode_events(rec.payload, L7_EVENT_DTYPE)
+            assert rec.rows == idx.shape[0]
+            assert np.array_equal(out["pid"], ev["pid"][idx])
+            assert out.tobytes() == ev[idx].tobytes()
+        finally:
+            r.detach()
+            r.unlink()
+
+
+class TestCodec:
+    def test_window_frame_roundtrip(self):
+        from alaz_tpu.graph.builder import EdgePartial
+
+        P = 7
+        partial = EdgePartial(
+            from_uid=np.arange(P, dtype=np.int32),
+            to_uid=np.arange(P, dtype=np.int32) + 100,
+            from_type=np.ones(P, dtype=np.uint8),
+            to_type=np.full(P, 2, dtype=np.uint8),
+            proto=np.full(P, 3, dtype=np.int32),
+            count=np.arange(P, dtype=np.float64) + 1,
+            lat_sum=np.full(P, 9.0),
+            lat_max=np.full(P, 4.0),
+            err5_sum=np.zeros(P),
+            err4_sum=np.ones(P),
+            tls_sum=np.zeros(P),
+            label_sum=np.ones(P),
+            rows=123,
+        )
+        blob = codec.encode_window(
+            5, partial, 17, ["svc-a", "pod-β", ""], 1.5, 2.5, 0.25
+        )
+        w, got, base, strings, t0, tc, dur = codec.decode_window(blob)
+        assert (w, base, strings) == (5, 17, ["svc-a", "pod-β", ""])
+        assert (t0, tc, dur) == (1.5, 2.5, 0.25)
+        assert got.rows == 123
+        for name, _ in codec.PARTIAL_COLUMNS:
+            assert np.array_equal(getattr(got, name), getattr(partial, name))
+        assert np.array_equal(got.label_sum, partial.label_sum)
+
+    def test_close_frame_none_roundtrip(self):
+        assert codec.decode_close(codec.encode_close(3, None)) == (3, None)
+        assert codec.decode_close(codec.encode_close(4, -2)) == (4, -2)
+
+
+# ---------------------------------------------------------------------------
+# equivalence: serial ≡ thread ≡ process
+# ---------------------------------------------------------------------------
+
+
+def _run_process(ev, msgs, n_rows, n_workers, chunk=1 << 13, **kw):
+    interner = Interner()
+    closed = []
+    pipe = ProcessShardedIngest(
+        n_workers, interner=interner, window_s=1.0,
+        on_batch=closed.append, **kw,
+    )
+    try:
+        for m in msgs:
+            pipe.process_k8s(m)
+        for i in range(0, n_rows, chunk):
+            pipe.process_l7(ev[i : i + chunk], now_ns=10_000_000_000)
+        assert pipe.flush(timeout_s=60.0), "process flush timed out"
+    finally:
+        pipe.stop()
+    return interner, closed, pipe
+
+
+class TestProcessEquivalence:
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_matches_serial_path_exactly(self, n_workers):
+        n_rows = 30_000
+        ev, msgs = make_ingest_trace(n_rows, pods=80, svcs=12, windows=5, seed=3)
+        si, sb, _ = _run_serial(ev, msgs, n_rows)
+        pi, pb, pipe = _run_process(ev, msgs, n_rows, n_workers)
+        ref, got = _canonical(si, sb), _canonical(pi, pb)
+        assert set(got) == set(ref), "window partition differs"
+        for w in ref:
+            assert got[w] == ref[w], f"window {w} edges/features differ"
+        ref_nodes, got_nodes = _node_stats(si, sb), _node_stats(pi, pb)
+        for w in ref_nodes:
+            assert got_nodes[w] == ref_nodes[w], f"window {w} node rows differ"
+        assert pipe.ledger.total == 0
+        assert pipe.request_count == n_rows
+
+    def test_matches_thread_backend_exactly(self):
+        """The three-way anchor: process ≡ thread over the SAME trace
+        (serial equivalence above makes it transitive, but the direct
+        comparison is the acceptance sentence)."""
+        n_rows = 24_000
+        ev, msgs = make_ingest_trace(n_rows, pods=60, svcs=10, windows=4, seed=7)
+        ti = Interner()
+        tclosed = []
+        tcluster = ClusterInfo(ti)
+        for m in msgs:
+            tcluster.handle_msg(m)
+        tpipe = ShardedIngest(
+            2, interner=ti, cluster=tcluster, window_s=1.0,
+            on_batch=tclosed.append,
+        )
+        try:
+            for i in range(0, n_rows, 1 << 13):
+                tpipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            assert tpipe.flush(timeout_s=60.0)
+        finally:
+            tpipe.stop()
+        pi, pb, _ = _run_process(ev, msgs, n_rows, 2)
+        assert _canonical(ti, tclosed) == _canonical(pi, pb)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_randomized_chunking(self, seed):
+        rng = np.random.default_rng(seed)
+        n_rows = 12_000
+        ev, msgs = make_ingest_trace(n_rows, pods=50, svcs=8, windows=3, seed=seed)
+        si, sb, _ = _run_serial(ev, msgs, n_rows)
+        interner = Interner()
+        closed = []
+        pipe = ProcessShardedIngest(
+            3, interner=interner, window_s=1.0, on_batch=closed.append
+        )
+        try:
+            for m in msgs:
+                pipe.process_k8s(m)
+            i = 0
+            while i < n_rows:
+                step = int(rng.integers(1, 4000))
+                pipe.process_l7(ev[i : i + step], now_ns=10_000_000_000)
+                i += step
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert _canonical(si, sb) == _canonical(interner, closed)
+
+    @pytest.mark.parametrize("n_workers", [1, 2])
+    def test_degree_cap_parity_survives_id_exchange(self, n_workers):
+        """uid-pure sampling priorities: the cap applies parent-side
+        over SHARED-interner uids, and the parent interner numbers
+        CLUSTER uid strings in the serial order (the k8s fold runs
+        before traffic) — so a capped process run selects the SAME
+        edges as a capped serial run. In-cluster destinations only:
+        outbound dst uids are interned mid-processing, so their
+        NUMBERING is a documented per-run degree of freedom in every
+        backend (serial included) and uid-keyed priorities legitimately
+        differ there — the same freedom the thread-mode equivalence
+        contract documents for interner ids."""
+        n_all = 40_000
+        ev, msgs = make_ingest_trace(n_all, pods=40, svcs=6, windows=3, seed=11)
+        ev = ev[ev["dport"] == 80]  # in-cluster (service) dsts only
+        n_rows = int(ev.shape[0])
+        cap = 3  # small enough to bite on a 40-pod → 6-svc fan-in
+        interner = Interner()
+        closed = []
+        store = WindowedGraphStore(
+            interner, window_s=1.0, on_batch=closed.append, degree_cap=cap,
+            sample_seed=5,
+        )
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=interner, cluster=cluster)
+        for i in range(0, n_rows, 1 << 13):
+            agg.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+        store.flush()
+        assert store.builder.sampled_edges > 0, "cap never bit — vacuous"
+        pi, pb, pipe = _run_process(
+            ev, msgs, n_rows, n_workers, degree_cap=cap, sample_seed=5
+        )
+        assert pipe.builder.sampled_edges > 0
+        assert _canonical(interner, closed) == _canonical(pi, pb)
+
+    def test_label_fn_survival(self):
+        n_rows = 16_000
+        ev, msgs = make_ingest_trace(n_rows, pods=40, svcs=6, windows=3, seed=2)
+
+        si = Interner()
+        sclosed = []
+        store = WindowedGraphStore(
+            si, window_s=1.0, on_batch=sclosed.append, label_fn=_label_fn
+        )
+        cluster = ClusterInfo(si)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(store, interner=si, cluster=cluster)
+        for i in range(0, n_rows, 1 << 13):
+            agg.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+        store.flush()
+        assert any(
+            b.edge_label is not None and b.edge_label.sum() > 0 for b in sclosed
+        ), "labels never fired — vacuous"
+        pi, pb, _ = _run_process(ev, msgs, n_rows, 2, label_fn=_label_fn)
+        ref = {
+            b.window_start_ms: _labels_by_edge(si, b) for b in sclosed
+        }
+        got = {
+            b.window_start_ms: _labels_by_edge(pi, b) for b in pb
+        }
+        assert got == ref
+
+    def test_non_picklable_label_fn_refused(self):
+        with pytest.raises(ValueError, match="picklable"):
+            ProcessShardedIngest(
+                1, label_fn=lambda rows: None, autostart=False
+            )
+
+    def test_tee_refused(self):
+        class Sink:
+            pass
+
+        with pytest.raises(ValueError, match="tee"):
+            ProcessShardedIngest(1, tee=Sink(), autostart=False)
+
+
+def _label_fn(rows):
+    """Module-level (picklable by construction): flag 5xx rows."""
+    return (rows["status_code"] >= 500).astype(np.float64)
+
+
+def _labels_by_edge(interner, b):
+    uids = b.node_uids
+    out = {}
+    for i in range(b.n_edges):
+        key = (
+            interner.lookup(int(uids[b.edge_src[i]])),
+            interner.lookup(int(uids[b.edge_dst[i]])),
+            int(b.edge_type[i]),
+        )
+        out[key] = None if b.edge_label is None else float(b.edge_label[i])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# supervision: SIGKILL conservation (the chaos process-kill seam)
+# ---------------------------------------------------------------------------
+
+
+class TestProcessKills:
+    def test_sigkill_mid_wave_conserves_exactly(self):
+        from alaz_tpu.chaos.harness import emitted_rows
+        from alaz_tpu.chaos.injectors import WorkerChaos
+
+        n_rows = 24_000
+        ev, msgs = make_ingest_trace(n_rows, pods=60, svcs=10, windows=4, seed=0)
+        wchaos = WorkerChaos(
+            seed=0, crash_prob=0.02, max_crashes=2, ensure_crash=True
+        )
+        interner = Interner()
+        closed = []
+        pipe = ProcessShardedIngest(
+            2, interner=interner, window_s=1.0, on_batch=closed.append,
+            fault_hook=wchaos, shed_block_s=0.5,
+        )
+        try:
+            for m in msgs:
+                pipe.process_k8s(m)
+            for i in range(0, n_rows, 2048):
+                pipe.process_l7(ev[i : i + 2048], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert wchaos.crashes > 0, "kill never fired — vacuous"
+        assert pipe.worker_restarts > 0, "kill observed but no respawn"
+        gap = pipe.ledger.conservation_gap(n_rows, emitted_rows(closed))
+        assert gap == 0, (
+            f"conservation broken through SIGKILL: gap={gap} "
+            f"ledger={pipe.ledger.snapshot()}"
+        )
+        starts = [b.window_start_ms for b in closed]
+        assert all(b > a for a, b in zip(starts, starts[1:])), starts
+
+    def test_direct_kill_with_backlog_attributes_loss(self):
+        """Kill a worker while rows sit in its private store: the
+        residual books (consumed − partials − mirror) must land in the
+        ledger as ``dropped`` — the crash-surviving accounting path,
+        exercised with a GUARANTEED nonzero loss."""
+        n_rows = 16_000
+        ev, msgs = make_ingest_trace(n_rows, pods=40, svcs=6, windows=3, seed=4)
+        interner = Interner()
+        closed = []
+        pipe = ProcessShardedIngest(
+            1, interner=interner, window_s=1.0, on_batch=closed.append
+        )
+        try:
+            for m in msgs:
+                pipe.process_k8s(m)
+            for i in range(0, n_rows, 2048):
+                pipe.process_l7(ev[i : i + 2048], now_ns=10_000_000_000)
+            # wait until the worker has PROCESSED rows into pending
+            # windows (request_count mirrors its store), then kill
+            deadline = time.monotonic() + 20
+            while pipe.request_count == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert pipe.request_count > 0
+            h = pipe.workers[0]
+            os.kill(h.proc.pid, signal.SIGKILL)
+            # supervision respawns and settles the books
+            deadline = time.monotonic() + 30
+            while pipe.worker_restarts == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pipe.worker_restarts == 1
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        from alaz_tpu.chaos.harness import emitted_rows
+
+        snap = pipe.ledger.snapshot()
+        assert snap["reasons"].get("dropped/shm0_kill", 0) > 0, snap
+        gap = pipe.ledger.conservation_gap(n_rows, emitted_rows(closed))
+        assert gap == 0, f"gap={gap} ledger={snap}"
+
+    def test_chaos_harness_process_leg_green(self):
+        from alaz_tpu.chaos.harness import run_chaos_suite
+        from alaz_tpu.config import ChaosConfig
+
+        rep = run_chaos_suite(
+            ChaosConfig(enabled=True, seed=0),
+            n_workers=2, n_rows=16_000, n_windows=3,
+            legs=("pipeline",), ingest_backend="process",
+        )
+        assert rep.ok, rep.findings
+        assert rep.pipeline["backend"] == "process"
+        assert rep.pipeline["crashes"] > 0
+        assert rep.pipeline["worker_restarts"] > 0
+
+
+# ---------------------------------------------------------------------------
+# wiring: config / tenancy / service surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestWiring:
+    def test_tenant_partition_selects_process_backend(self):
+        cfg = RuntimeConfig()
+        cfg.ingest_workers = 2
+        cfg.ingest_backend = "process"
+        from alaz_tpu.runtime.tenancy import TenantPartition
+
+        n_rows = 12_000
+        ev, msgs = make_ingest_trace(n_rows, pods=40, svcs=6, windows=3, seed=1)
+        si, sb, _ = _run_serial(ev, msgs, n_rows)
+        closed = []
+        part = TenantPartition(0, cfg, on_batch=closed.append)
+        assert isinstance(part.sharded, ProcessShardedIngest)
+        try:
+            for m in msgs:
+                part.aggregator.process_k8s(m)
+            for i in range(0, n_rows, 1 << 13):
+                part.aggregator.process_l7(
+                    ev[i : i + (1 << 13)], now_ns=10_000_000_000
+                )
+            assert part.sharded.flush(timeout_s=60.0)
+        finally:
+            part.sharded.stop()
+        assert _canonical(si, sb) == _canonical(part.interner, closed)
+        # per-tenant conservation stays exact through the process plane
+        assert part.ledger.total == 0
+
+    def test_backend_applies_at_one_worker(self):
+        """INGEST_BACKEND=process with ingest_workers=1 still builds the
+        process pipeline — ingest leaves the serving process's GIL."""
+        cfg = RuntimeConfig()
+        cfg.ingest_backend = "process"
+        from alaz_tpu.runtime.tenancy import TenantPartition
+
+        part = TenantPartition(0, cfg, on_batch=lambda b: None)
+        try:
+            assert isinstance(part.sharded, ProcessShardedIngest)
+            assert part.sharded.n == 1
+        finally:
+            part.sharded.stop()
+
+    def test_unknown_backend_refused(self):
+        cfg = RuntimeConfig()
+        cfg.ingest_backend = "fork"
+        from alaz_tpu.runtime.tenancy import TenantPartition
+
+        with pytest.raises(ValueError, match="ingest_backend"):
+            TenantPartition(0, cfg, on_batch=lambda b: None)
+
+    def test_export_tee_refused_with_process_backend(self):
+        cfg = RuntimeConfig()
+        cfg.ingest_workers = 2
+        cfg.ingest_backend = "process"
+        from alaz_tpu.runtime.tenancy import TenantPartition
+
+        class FakeBackend:
+            pass
+
+        with pytest.raises(ValueError, match="export"):
+            TenantPartition(
+                0, cfg, on_batch=lambda b: None, export_backend=FakeBackend()
+            )
+
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.setenv("ALAZ_TPU_INGEST_BACKEND", "process")
+        monkeypatch.setenv("ALAZ_TPU_SHM_SLOT_BYTES", "131072")
+        monkeypatch.setenv("ALAZ_TPU_SHM_RING_SLOTS", "64")
+        cfg = RuntimeConfig.from_env()
+        assert cfg.ingest_backend == "process"
+        assert cfg.shm_slot_bytes == 131072
+        assert cfg.shm_ring_slots == 64
+
+    def test_ring_stats_and_degraded_surface(self):
+        interner = Interner()
+        pipe = ProcessShardedIngest(2, interner=interner, window_s=1.0)
+        try:
+            rs = pipe.ring_stats()
+            assert set(rs) == {"0", "1"}
+            for w in rs.values():
+                assert w["ring_slots"] == pipe.ring_slots
+                assert w["generation"] == 0
+        finally:
+            pipe.stop()
+        assert pipe.ring_stats() == {}  # post-stop: segments are gone
+        assert pipe.unfinished == 0
+
+
+# ---------------------------------------------------------------------------
+# shm ABI golden (alazspec satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestShmAbiGolden:
+    def test_golden_matches_live_constants(self):
+        from tools.alazspec.abirules import _shm_ring_section
+
+        golden = json.loads(
+            (REPO / "resources" / "specs" / "wire_layouts.json").read_text()
+        )
+        assert golden.get("shm_ring") == _shm_ring_section(), (
+            "shm ring ABI drifted from the golden wire table — "
+            "run `make specs` and review the diff"
+        )
+
+    def test_tampered_golden_is_an_alz021_finding(self, tmp_path):
+        from tools.alazspec.abirules import check_wire_layouts
+
+        golden = json.loads(
+            (REPO / "resources" / "specs" / "wire_layouts.json").read_text()
+        )
+        golden["shm_ring"]["slot_header"] = golden["shm_ring"][
+            "slot_header"
+        ].replace("seq:0:8", "seq:0:4")
+        bad = tmp_path / "wire_layouts.json"
+        bad.write_text(json.dumps(golden))
+        findings = check_wire_layouts(golden_path=bad)
+        assert any(
+            f.code == "ALZ021" and "shm_ring" in f.message for f in findings
+        ), [f.message for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# alazrace: the process-role carve-out (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+_PROC_SRC = '''
+import threading
+import multiprocessing
+
+def child_entry(spec):
+    s = Shared()
+    s.counter += 1  # own address space: no shared-memory pairing
+
+class Shared:
+    def __init__(self):
+        self.counter = 0
+
+class Owner:
+    def __init__(self):
+        self.shared = Shared()
+
+    def start(self):
+        multiprocessing.get_context("spawn").Process(
+            target=child_entry, args=(1,)
+        ).start()
+        threading.Thread(target=self._pump_loop).start()
+
+    def _pump_loop(self):
+        self.shared.counter += 1
+'''
+
+_THREAD_TWIN = _PROC_SRC.replace(
+    'multiprocessing.get_context("spawn").Process(\n            target=child_entry, args=(1,)\n        ).start()',
+    "threading.Thread(target=child_entry, args=(1,)).start()",
+)
+
+
+class TestProcessRoleCarveOut:
+    def test_process_target_discovered_as_process_role(self):
+        from tools.alazlint.core import parse_context
+        from tools.alazrace import RaceModel
+
+        ctx = parse_context("t.py", _PROC_SRC)
+        model = RaceModel([ctx])
+        kinds = {n: r.kind for n, r in model.roles.items()}
+        assert kinds.get("t:child_entry") == "process", kinds
+
+    def test_cross_process_touch_is_not_a_shared_memory_race(self):
+        """`Shared.counter` is written by a thread role AND the process
+        target — but the process runs in its own address space, so the
+        pair is NOT a race; the same code with a second THREAD is."""
+        from tools.alazrace import race_source
+
+        proc_findings = [
+            f for f in race_source("t.py", _PROC_SRC) if f.code in ("ALZ050", "ALZ051")
+        ]
+        assert proc_findings == [], [f.render() for f in proc_findings]
+        twin_findings = [
+            f
+            for f in race_source("t.py", _THREAD_TWIN)
+            if f.code in ("ALZ050", "ALZ051")
+        ]
+        assert twin_findings, "thread twin must still flag — carve-out too wide"
+
+    def test_golden_map_covers_the_new_topology(self):
+        golden = json.loads(
+            (REPO / "resources" / "specs" / "threads.json").read_text()
+        )
+        role = golden["roles"].get("alaz_tpu.shm.worker:shard_worker_main")
+        assert role is not None and role["kind"] == "process"
+        assert (
+            "alaz_tpu.shm.process_pool:ProcessShardedIngest._merger_loop"
+            in golden["roles"]
+        )
+        # the carve-out's contract, pinned: the shm plane's parent-side
+        # classes are in the map (parent threads genuinely share them)…
+        assert "alaz_tpu.shm.process_pool:ProcessShardedIngest" in golden["shared"]
+        # …and no CHILD-private class got dragged in as shared by the
+        # process role alone (the leak the satellite forbids)
+        for cls, entry in golden["shared"].items():
+            non_proc = [
+                r
+                for r in entry["roles"]
+                if golden["roles"].get(r, {}).get("kind") != "process"
+            ]
+            assert len(non_proc) >= 2, (
+                f"{cls} is 'shared' only through a process role — "
+                "address-space isolation was not honored"
+            )

@@ -51,6 +51,11 @@ ROW_PLANE_PREFIXES = (
     # attribute as the closed `shed` cause), so its drops are in scope
     # for ALZ040/043 like every other row holder's
     "alaz_tpu.datastore.backend",
+    # the process-mode ingest plane (ISSUE 15): rings carry row-bearing
+    # records across the spawn boundary, the pool sheds/attributes at
+    # the scatter and kill seams — in scope for ALZ040/042/043 like the
+    # thread backend it mirrors
+    "alaz_tpu.shm",
 )
 
 # names that mark a value as row-bearing when they appear as parameters

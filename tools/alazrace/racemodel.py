@@ -114,7 +114,7 @@ def _unwrap_optional(ann: ast.AST) -> ast.AST:
 @dataclass(frozen=True)
 class Role:
     name: str  # root qualname, or "main" for the folded entry surface
-    kind: str  # thread | timer | executor | convention | entry
+    kind: str  # thread | timer | executor | process | convention | entry
     roots: Tuple[str, ...]  # root function qualnames
 
 
@@ -519,6 +519,16 @@ class RaceModel:
                     for kw in node.keywords:
                         if kw.arg == "target":
                             target, kind = kw.value, "thread"
+                elif name == "Process":
+                    # multiprocessing.Process / ctx.Process spawn target
+                    # (ISSUE 15): a role in the map — the topology must
+                    # show it — but its OWN ADDRESS SPACE: process-kind
+                    # roles never pair into shared-memory hazards
+                    # (FieldReport.multi_role), because nothing reaches
+                    # a spawned child except ring bytes and pickles.
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target, kind = kw.value, "process"
                 elif name == "Timer":
                     if len(node.args) > 1:
                         target, kind = node.args[1], "timer"
@@ -672,11 +682,13 @@ class RaceModel:
             to a runner may be invoked by it (``self._consume(q, handle)``
             drives the nested ``handle``; ``on_batch=self._enqueue_window``
             re-enters the service from the merge thread). Conservative
-            may-call edges — EXCEPT Thread/Timer/submit targets, which
-            run on the SPAWNED thread (they are role roots, not calls
-            from the spawner's role)."""
+            may-call edges — EXCEPT Thread/Timer/Process/submit targets,
+            which run on the SPAWNED thread or process (role roots, not
+            calls from the spawner's role — folding a spawn target into
+            the spawner would drag a whole child process's code into a
+            parent thread's lockset domain)."""
             _, name = _callee(node)
-            if name in ("Thread", "Timer") or (
+            if name in ("Thread", "Timer", "Process") or (
                 isinstance(node.func, ast.Attribute)
                 and node.func.attr == "submit"
             ):

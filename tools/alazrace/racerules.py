@@ -66,7 +66,22 @@ class FieldReport:
 
     @property
     def multi_role(self) -> bool:
-        return len(self.roles) >= 2
+        """≥2 roles that can actually RACE on one memory: process-kind
+        roles (ISSUE 15 — ``multiprocessing.Process`` spawn targets) run
+        in their own address space, so an access from a process role can
+        never pair with any other role's access through shared memory —
+        the child's objects are copies, and cross-process state is shm
+        ring bytes + pickled deltas by the alaz_tpu/shm contract. Two
+        process roles are two processes; same exclusion. (A thread
+        spawned INSIDE a worker process would surface as its own
+        thread-kind role and pair normally — the carve-out is exactly
+        the spawn boundary, nothing wider.)"""
+        same_space = [
+            r
+            for r in self.roles
+            if getattr(self.model.roles.get(r), "kind", None) != "process"
+        ]
+        return len(same_space) >= 2
 
     def own_lock_candidates(self) -> List[str]:
         """Locks in the common set that are attributes of the DECLARING

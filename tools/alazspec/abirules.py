@@ -440,6 +440,48 @@ def wire_layout_table() -> dict:
             "default": int(RuntimeConfig().degree_cap),
             "ledger_causes": list(DropLedger.CAUSES),
         },
+        # process-mode shm ring ABI (ISSUE 15): both sides of the SPAWN
+        # boundary import alaz_tpu/shm, but the layout lives in shared
+        # memory — a slot-header or stats-offset edit that only one
+        # build of the tree sees corrupts silently at runtime, so the
+        # whole contract (control block, stats mirror, slot header,
+        # record-kind map, window/delta framing, geometry defaults)
+        # anchors here at analysis time.
+        "shm_ring": _shm_ring_section(),
+    }
+
+
+def _shm_ring_section() -> dict:
+    from alaz_tpu.config import RuntimeConfig
+    from alaz_tpu.shm import codec as shm_codec
+    from alaz_tpu.shm import ring as shm_ring
+
+    cfg = RuntimeConfig()
+    return {
+        "magic": f"0x{shm_ring.SHM_MAGIC:08X}",
+        "version": int(shm_ring.SHM_VERSION),
+        "ctrl": shm_ring.ctrl_layout_string(),
+        "stats": shm_ring.stats_layout_string(),
+        "slot_header": shm_ring.slot_header_layout_string(),
+        "agg_stat_fields": list(shm_ring.AGG_STAT_FIELDS),
+        "kinds": {
+            str(k): v for k, v in sorted(shm_ring.KIND_NAMES.items())
+        },
+        "window_frame": shm_codec.win_header_layout_string(),
+        "window_columns": [
+            f"{name}:{dt}" for name, dt in shm_codec.PARTIAL_COLUMNS
+        ] + [f"{shm_codec.LABEL_COLUMN[0]}:{shm_codec.LABEL_COLUMN[1]}"],
+        "delta_framing": "lengths:u32[delta_count];utf8-blob",
+        "ack_frame": str(shm_codec.ACK_FRAME.format),
+        "close_frame": str(shm_codec.CLOSE_FRAME.format),
+        "defaults": {
+            "slot_bytes": int(shm_ring.DEFAULT_SLOT_BYTES),
+            "ring_slots": int(shm_ring.DEFAULT_RING_SLOTS),
+            "config_slot_bytes": int(cfg.shm_slot_bytes),
+            "config_ring_slots": int(cfg.shm_ring_slots),
+        },
+        "env": ["ALAZ_TPU_INGEST_BACKEND", "ALAZ_TPU_SHM_SLOT_BYTES",
+                "ALAZ_TPU_SHM_RING_SLOTS"],
     }
 
 
@@ -496,6 +538,7 @@ def check_wire_layouts(
                 REPO / "alaz_tpu" / "graph" / "native.py",
             ),
             ("sampling", REPO / "alaz_tpu" / "graph" / "builder.py"),
+            ("shm_ring", REPO / "alaz_tpu" / "shm" / "ring.py"),
         ):
             live_sec = live.get(section, {})
             gold_sec = golden.get(section)
