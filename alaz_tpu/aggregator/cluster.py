@@ -205,6 +205,16 @@ class ClusterInfo:
                 return ep_type[inverse], uid[inverse]
         return self._attribute_direct(ips)
 
+    def compiled_tables(
+        self,
+    ) -> tuple[tuple[np.ndarray, np.ndarray], tuple[np.ndarray, np.ndarray]]:
+        """((pod_ips, pod_uids), (svc_ips, svc_uids)) — the sorted-array
+        snapshots the native L7 engine binary-searches. Recompiles swap in
+        NEW arrays (never mutate in place), so handing these out without
+        holding the table locks is safe; a stale snapshot is at most one
+        k8s fold behind, same as the numpy lookup path."""
+        return self.pod_ips._compile(), self.svc_ips._compile()
+
     def _attribute_direct(self, ips: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         pod_found, pod_uid = self.pod_ips.lookup(ips)
         svc_found, svc_uid = self.svc_ips.lookup(ips)

@@ -63,7 +63,7 @@ from alaz_tpu.protocols import kafka as kafka_proto
 from alaz_tpu.protocols import mongo as mongo_proto
 from alaz_tpu.protocols import mysql as mysql_proto
 from alaz_tpu.protocols import postgres as postgres_proto
-from alaz_tpu.utils.ratelimit import TokenBucket
+from alaz_tpu.utils.ratelimit import TokenBucket, admit_batch
 
 log = get_logger("alaz_tpu.aggregator")
 
@@ -71,6 +71,27 @@ RETRY_ATTEMPT_LIMIT = 3  # data.go:109 attemptLimit
 RETRY_INTERVAL_NS = 20_000_000  # data.go:108 retryInterval (20ms)
 
 _PATH_CACHE_MAX = 65536  # per-protocol parsed-path cache bound (cleared in gc)
+
+# A/B toggle for the native L7 engine body (ISSUE 16), mirroring
+# builder.set_native_grouping: None follows RuntimeConfig.engine_backend,
+# True/False force the native/python join stage regardless of config.
+_native_engine_override: Optional[bool] = None
+
+
+def set_native_engine(enabled: Optional[bool]) -> None:
+    """Force the L7 engine backend: True = native (alz_process_l7),
+    False = python (numpy join stage), None = follow
+    ``RuntimeConfig.engine_backend``. Parity tests and the bench A/B flip
+    both backends through this one switch, like ``set_native_grouping``
+    does for the grouping stage."""
+    global _native_engine_override
+    _native_engine_override = enabled
+
+
+# sentinel: the join/fill stage ran (side effects: requeue/ledger/stats
+# done) but every row dropped — distinct from None, which means the stage
+# did NOT run and the caller may fall back without double-counting
+_EMPTY_BATCH = ()
 
 
 def _conn_keys(pid: np.ndarray, fd: np.ndarray) -> np.ndarray:
@@ -252,6 +273,12 @@ class Aggregator:
         # reference applies it on the trace path; gated off by default
         self.rate_limit: tuple[float, float] | None = None
         self._pid_buckets: dict[int, TokenBucket] = {}  # guarded-by: self._l7_lock
+        # native L7 engine (ISSUE 16): per-aggregator handle, owns the
+        # socket-line snapshot cache. Resolved lazily on the first batch
+        # so set_native_engine flips after construction still take effect;
+        # _native_l7_failed latches the miss so an absent .so logs once.
+        self._native_l7 = None  # guarded-by: self._l7_lock
+        self._native_l7_failed = False  # guarded-by: self._l7_lock
 
     def backfill_from_proc(
         self,
@@ -453,18 +480,52 @@ class Aggregator:
         return emitted
 
     def _apply_rate_limit(self, events: np.ndarray, now_ns: int) -> np.ndarray:
-        """Per-pid token buckets, vectorized per pid group: each pid admits
-        up to its bucket's allowance per batch, excess drops (rate.Limiter
-        semantics, data.go:339-353)."""
+        """Per-pid token buckets (rate.Limiter semantics, data.go:339-353).
+        The only Python walk left is over UNIQUE pids — one dict lookup
+        each to fetch/create the bucket; the admit math runs as one array
+        pass (``admit_batch``) and the keep mask scatters back without
+        per-group slicing. Drops, ledger attribution and post-batch bucket
+        state are bit-identical to ``_scalar_apply_rate_limit`` below."""
         rate, burst = self.rate_limit
         now_s = now_ns / 1e9
-        keep = np.ones(events.shape[0], dtype=bool)
+        n = events.shape[0]
         pids, inverse = np.unique(events["pid"], return_inverse=True)
         # group rows per pid in O(n log n): one sort, contiguous slices
         order = np.argsort(inverse, kind="stable")
         boundaries = np.searchsorted(inverse[order], np.arange(pids.shape[0] + 1))
-        for g, pid in enumerate(pids):
+        sizes = np.diff(boundaries)
+        buckets = []
+        for pid in pids:
             bucket = self._pid_buckets.get(int(pid))  # alazlint: disable=ALZ010 -- _l7_lock IS held here: _apply_rate_limit's only caller is process_l7 inside `with self._l7_lock` (the per-file rule can't see caller-held locks; alazrace's interprocedural lockset can and agrees)
+            if bucket is None:
+                bucket = TokenBucket(rate, burst, now_s=now_s)
+                self._pid_buckets[int(pid)] = bucket  # alazlint: disable=ALZ010 -- same caller-held _l7_lock as the get above
+            buckets.append(bucket)
+        admitted = admit_batch(buckets, sizes, now_s)
+        # keep the first admitted[g] rows of each pid group in ORIGINAL row
+        # order (argsort is stable, so within a group `order` ascends by
+        # original index): rank-within-group < allowance, scattered back
+        rank = np.arange(n, dtype=np.int64) - np.repeat(boundaries[:-1], sizes)
+        keep = np.empty(n, dtype=bool)
+        keep[order] = rank < np.repeat(admitted, sizes)
+        dropped = int(n - int(keep.sum()))
+        if dropped:
+            self.stats.l7_rate_limited += dropped
+            self.ledger.add("filtered", dropped, reason="rate_limit")
+            events = events[keep]
+        return events
+
+    def _scalar_apply_rate_limit(self, events: np.ndarray, now_ns: int) -> np.ndarray:
+        """Pre-vectorization reference (one ``bucket.admit`` per pid group)
+        — kept for the equivalence property tests."""
+        rate, burst = self.rate_limit
+        now_s = now_ns / 1e9
+        keep = np.ones(events.shape[0], dtype=bool)
+        pids, inverse = np.unique(events["pid"], return_inverse=True)
+        order = np.argsort(inverse, kind="stable")
+        boundaries = np.searchsorted(inverse[order], np.arange(pids.shape[0] + 1))
+        for g, pid in enumerate(pids):
+            bucket = self._pid_buckets.get(int(pid))  # alazlint: disable=ALZ010 -- same caller-held _l7_lock contract as _apply_rate_limit
             if bucket is None:
                 bucket = TokenBucket(rate, burst, now_s=now_s)
                 self._pid_buckets[int(pid)] = bucket  # alazlint: disable=ALZ010 -- same caller-held _l7_lock as the get above
@@ -500,6 +561,27 @@ class Aggregator:
             return None
         return np.concatenate(out) if len(out) > 1 else out[0]
 
+    def _use_native_engine(self) -> bool:
+        if _native_engine_override is not None:
+            return _native_engine_override
+        return getattr(self.config, "engine_backend", "python") == "native"
+
+    def _native_l7_engine(self):
+        """Lazy per-aggregator NativeL7Engine, or None (fallback). The
+        miss latches so an unbuildable .so logs one warning, not one per
+        batch."""
+        if self._native_l7 is None and not self._native_l7_failed:  # alazlint: disable=ALZ010 -- _l7_lock IS held on every concurrent path (process_l7/flush_retries callers); the remaining callers are single-threaded construction-time prewarms (sharded pool init, shm worker pre-ready) before any traffic thread exists
+            from alaz_tpu.aggregator import native_l7
+
+            self._native_l7 = native_l7.make_engine()  # alazlint: disable=ALZ010 -- same caller-held _l7_lock / pre-traffic prewarm contract as the check above
+            if self._native_l7 is None:  # alazlint: disable=ALZ010 -- same caller-held _l7_lock / pre-traffic prewarm contract as the check above
+                self._native_l7_failed = True  # alazlint: disable=ALZ010 -- same caller-held _l7_lock / pre-traffic prewarm contract as the check above
+                log.warning(
+                    "engine_backend=native requested but libalaz_ingest.so "
+                    "is unavailable; falling back to the python L7 engine"
+                )
+        return self._native_l7  # alazlint: disable=ALZ010 -- same caller-held _l7_lock / pre-traffic prewarm contract as the check above
+
     def _process_l7_inner(
         self, events: np.ndarray, attempts: int, now_ns: int
     ) -> np.ndarray:
@@ -507,6 +589,116 @@ class Aggregator:
         if n == 0:
             return np.zeros(0, dtype=REQUEST_DTYPE)
 
+        # join + attribution + REQUEST-row fill: one native pass when the
+        # engine backend allows, else the numpy stage. Both do their own
+        # requeue/drop bookkeeping; None means "did not run" (native
+        # unavailable — no side effects yet, python fallback is safe),
+        # _EMPTY_BATCH means "ran, every row dropped/requeued".
+        prep = None
+        if self._use_native_engine():
+            eng = self._native_l7_engine()
+            if eng is not None:
+                prep = self._native_join_fill(eng, events, attempts, now_ns)
+        if prep is None:
+            prep = self._python_join_fill(events, attempts, now_ns)
+        if prep is _EMPTY_BATCH:
+            return np.zeros(0, dtype=REQUEST_DTYPE)
+        events, out, protocol, proto_present = prep
+
+        # outbound destinations: reverse-DNS name when the gated cache has
+        # one, else the IP string (setFromToV2 fallback chain,
+        # data.go:852-866). Vectorized per UNIQUE address: name_for takes
+        # the cache lock and intern hashes a string — per-row they were
+        # the single hottest Python loop in the V2 ingest path. Stays
+        # Python on both backends (refusal surface: interner + DNS cache).
+        outbound = out["to_type"] == np.uint8(EP_OUTBOUND)
+        if outbound.any():
+            out["to_uid"][outbound] = self._outbound_uids(
+                np.ascontiguousarray(out["to_ip"][outbound])
+            )
+
+        # per-protocol payload enrichment
+        self._enrich_paths(events, out, protocol, proto_present)
+
+        # consume-side direction flips (AMQP DELIVER / Redis PUSHED_EVENT)
+        if proto_present[int(L7Protocol.AMQP)] or proto_present[int(L7Protocol.REDIS)]:
+            method = np.ascontiguousarray(events["method"])
+            flip = (
+                (protocol == L7Protocol.AMQP) & (method == AmqpMethod.DELIVER)
+            ) | (
+                (protocol == L7Protocol.REDIS) & (method == RedisMethod.PUSHED_EVENT)
+            )
+            if flip.any():
+                reverse_direction(out, flip)
+
+        # HTTP2 frames & Kafka payloads detour through their assemblers;
+        # the common all-plain batch skips the masks AND the row copy
+        has_h2 = bool(proto_present[int(L7Protocol.HTTP2)])
+        has_kafka = bool(proto_present[int(L7Protocol.KAFKA)])
+        if has_h2 or has_kafka:
+            h2_mask = protocol == L7Protocol.HTTP2
+            kafka_mask = protocol == L7Protocol.KAFKA
+            if has_h2:
+                h2_out = self._process_h2(events[h2_mask], out[h2_mask])
+                if h2_out is not None and h2_out.shape[0]:
+                    self.ds.persist_requests(h2_out)
+                    self.stats.edges_out += h2_out.shape[0]
+            if has_kafka:
+                self._process_kafka(events[kafka_mask], out[kafka_mask])
+            result = out[~h2_mask & ~kafka_mask]
+        else:
+            result = out
+        if result.shape[0]:
+            self.ds.persist_requests(result)
+            self.stats.edges_out += result.shape[0]
+            self.stats.l7_joined += result.shape[0]
+        return result
+
+    def _native_join_fill(self, eng, events: np.ndarray, attempts: int, now_ns: int):
+        """Native join/fill stage: hand the batch plus socket-line snapshot
+        and attribution tables to ``alz_process_l7``, then fold the drop
+        counts into the SAME requeue/stats/ledger bookkeeping the python
+        stage does (order pinned by ``L7_ENGINE_DROP_CAUSES``:
+        counts[0]=no_socket-or-retry, counts[1]=not_pod). Returns the
+        (events, out, protocol, proto_present) stage tuple, _EMPTY_BATCH
+        when everything dropped, or None when the call could not run (no
+        side effects — python fallback is exact)."""
+        res = eng.process(
+            events, now_ns, self.socket_lines, *self.cluster.compiled_tables()
+        )
+        if res is None:
+            return None
+        out, kept_idx, unmatched_idx, n_not_pod = res
+        if unmatched_idx.shape[0]:
+            if attempts + 1 < RETRY_ATTEMPT_LIMIT:
+                rows = events[unmatched_idx]  # fancy index -> fresh copy
+                backoff = RETRY_INTERVAL_NS * (1 << attempts)  # 20ms, 40ms
+                self._retries.append((rows, attempts + 1, now_ns + backoff))
+                self.stats.l7_requeued += rows.shape[0]
+            else:
+                lost = int(unmatched_idx.shape[0])
+                self.stats.l7_dropped_no_socket += lost
+                self.ledger.add("filtered", lost, reason="no_socket")
+        if n_not_pod:
+            self.stats.l7_dropped_not_pod += n_not_pod
+            self.ledger.add("filtered", n_not_pod, reason="not_pod")
+        if out.shape[0] == 0:
+            return _EMPTY_BATCH
+        if kept_idx.shape[0] != events.shape[0]:
+            events = events[kept_idx]
+        # else: every row survived — kept_idx is ascending-unique, so it
+        # is the identity, and the 331-byte-per-row gather is pure waste;
+        # the python stage leaves `events` un-copied on this path too, so
+        # aliasing the caller's view is the established contract
+        protocol = np.ascontiguousarray(events["protocol"])
+        proto_present = np.bincount(protocol, minlength=256)
+        return events, out, protocol, proto_present
+
+    def _python_join_fill(self, events: np.ndarray, attempts: int, now_ns: int):
+        """Numpy join/fill stage (the pre-ISSUE-16 `_process_l7_inner`
+        body, verbatim): V1 socket-line join, retry requeue, pod/outbound
+        attribution, REQUEST row fill. Returns (events, out, protocol,
+        proto_present) or _EMPTY_BATCH when every row dropped/requeued."""
         saddr = events["saddr"]
         sport = events["sport"]
         daddr = events["daddr"]
@@ -560,7 +752,7 @@ class Aggregator:
             saddr, sport = saddr[matched], sport[matched]
             daddr, dport = daddr[matched], dport[matched]
             if events.shape[0] == 0:
-                return np.zeros(0, dtype=REQUEST_DTYPE)
+                return _EMPTY_BATCH
 
         # attribution: From must be a pod, else drop (setFromToV2 contract)
         from_type, from_uid = self.cluster.attribute(saddr)
@@ -571,7 +763,7 @@ class Aggregator:
             self.ledger.add("filtered", lost, reason="not_pod")
             events = events[is_pod]
             if events.shape[0] == 0:
-                return np.zeros(0, dtype=REQUEST_DTYPE)
+                return _EMPTY_BATCH
             saddr, sport = saddr[is_pod], sport[is_pod]
             daddr, dport = daddr[is_pod], dport[is_pod]
             from_type, from_uid = from_type[is_pod], from_uid[is_pod]
@@ -603,52 +795,7 @@ class Aggregator:
         out["completed"] = True
         out["status_code"] = events["status"]
         out["method"] = events["method"]
-
-        # outbound destinations: reverse-DNS name when the gated cache has
-        # one, else the IP string (setFromToV2 fallback chain,
-        # data.go:852-866). Vectorized per UNIQUE address: name_for takes
-        # the cache lock and intern hashes a string — per-row they were
-        # the single hottest Python loop in the V2 ingest path.
-        outbound = to_type == np.uint8(EP_OUTBOUND)
-        if outbound.any():
-            out["to_uid"][outbound] = self._outbound_uids(daddr[outbound])
-
-        # per-protocol payload enrichment
-        self._enrich_paths(events, out, protocol, proto_present)
-
-        # consume-side direction flips (AMQP DELIVER / Redis PUSHED_EVENT)
-        if proto_present[int(L7Protocol.AMQP)] or proto_present[int(L7Protocol.REDIS)]:
-            method = np.ascontiguousarray(events["method"])
-            flip = (
-                (protocol == L7Protocol.AMQP) & (method == AmqpMethod.DELIVER)
-            ) | (
-                (protocol == L7Protocol.REDIS) & (method == RedisMethod.PUSHED_EVENT)
-            )
-            if flip.any():
-                reverse_direction(out, flip)
-
-        # HTTP2 frames & Kafka payloads detour through their assemblers;
-        # the common all-plain batch skips the masks AND the row copy
-        has_h2 = bool(proto_present[int(L7Protocol.HTTP2)])
-        has_kafka = bool(proto_present[int(L7Protocol.KAFKA)])
-        if has_h2 or has_kafka:
-            h2_mask = protocol == L7Protocol.HTTP2
-            kafka_mask = protocol == L7Protocol.KAFKA
-            if has_h2:
-                h2_out = self._process_h2(events[h2_mask], out[h2_mask])
-                if h2_out is not None and h2_out.shape[0]:
-                    self.ds.persist_requests(h2_out)
-                    self.stats.edges_out += h2_out.shape[0]
-            if has_kafka:
-                self._process_kafka(events[kafka_mask], out[kafka_mask])
-            result = out[~h2_mask & ~kafka_mask]
-        else:
-            result = out
-        if result.shape[0]:
-            self.ds.persist_requests(result)
-            self.stats.edges_out += result.shape[0]
-            self.stats.l7_joined += result.shape[0]
-        return result
+        return events, out, protocol, proto_present
 
     # -- outbound naming ----------------------------------------------------
 

@@ -417,6 +417,18 @@ class ShardedIngest:
             )
             for i in range(self.n)
         ]
+        # engine backend (ISSUE 16): when the config (or the A/B
+        # override) asks for the native L7 engine, dlopen + layout-check
+        # it at pool construction — the first traffic batch must not pay
+        # the load, and a missing .so warns HERE, not mid-traffic
+        if self.workers[0]._use_native_engine():
+            loaded = all(
+                w._native_l7_engine() is not None for w in self.workers
+            )
+            log.info(
+                f"sharded ingest L7 engine backend: native "
+                f"(loaded={loaded}, workers={self.n})"
+            )
         self._queues = [
             BatchQueue(queue_events, f"shard{i}") for i in range(self.n)
         ]
@@ -749,8 +761,15 @@ class ShardedIngest:
             except WorkerCrash:
                 # the thread dies with this item in flight: attribute its
                 # rows before going (conservation survives the crash),
-                # then let the supervisor shell take over
-                if kind in ("l7", "tcp"):
+                # then let the supervisor shell take over. ONLY L7 rows
+                # carry weight in the conservation books (the process
+                # backend's kill-settle rule, process_pool.py): a TCP
+                # establish never becomes a REQUEST row, so ledgering a
+                # crashed tcp item counts rows no numerator pushed —
+                # the per-tenant gate reads that as a negative gap. The
+                # row-visible consequence of the lost socket state is
+                # ledgered downstream as filtered/no_socket.
+                if kind == "l7":
                     self.ledger.add("dropped", len(item), reason="worker_crash")
                 raise
             except Exception as exc:  # keep the shard alive; mirror service workers
@@ -760,7 +779,8 @@ class ShardedIngest:
                 # crashes. Attribution errs toward overcounting when the
                 # engine emitted part of the batch before raising; a
                 # negative gap is the loud failure mode, not a silent one.
-                if kind in ("l7", "tcp"):
+                # L7-only, same contract as the crash path above.
+                if kind == "l7":
                     self.ledger.add("dropped", len(item), reason="batch_error")
                 log.warning(f"shard{i} {kind} batch failed: {exc}")
             finally:

@@ -22,6 +22,28 @@ ONE_MINUTE_NS = 60_000_000_000
 ASSUMED_INTERVAL_NS = 5 * ONE_MINUTE_NS  # DeleteUnused assumedInterval
 
 
+class _Rev:
+    """Store-wide mutation counter. The native L7 engine flattens the whole
+    store into contiguous arrays once and reuses that snapshot until this
+    revision moves — only mutations that can change a join result bump it
+    (inserts, clears, compaction, pid removal; ``_last_match`` writes don't)."""
+
+    __slots__ = ("n", "_lock")
+
+    def __init__(self) -> None:
+        self.n = 0  # guarded-by: self._lock (writes); racy reads see a
+        # value at most one bump behind — the snapshot records it BEFORE
+        # flattening, so any later mutation forces a rebuild
+        self._lock = threading.Lock()
+
+    def bump(self) -> None:
+        # the lock makes every mutation ADVANCE the counter (a lost
+        # update could leave the revision unchanged across a mutation
+        # and let a torn snapshot be reused forever)
+        with self._lock:
+            self.n += 1
+
+
 @dataclass
 class SockInfo:
     pid: int
@@ -35,11 +57,12 @@ class SockInfo:
 class SocketLine:
     """Sorted (timestamp, sockinfo|None) history for one (pid, fd)."""
 
-    __slots__ = ("pid", "fd", "_ts", "_open", "_saddr", "_sport", "_daddr", "_dport", "_last_match", "_n", "_lock")
+    __slots__ = ("pid", "fd", "_ts", "_open", "_saddr", "_sport", "_daddr", "_dport", "_last_match", "_n", "_lock", "_rev")
 
-    def __init__(self, pid: int, fd: int, cap: int = 4):
+    def __init__(self, pid: int, fd: int, cap: int = 4, rev: _Rev | None = None):
         self.pid = pid
         self.fd = fd
+        self._rev = rev if rev is not None else _Rev()
         self._n = 0
         self._ts = np.zeros(cap, dtype=np.uint64)
         self._open = np.zeros(cap, dtype=bool)  # False = close marker
@@ -64,6 +87,7 @@ class SocketLine:
     def clear(self) -> None:
         with self._lock:
             self._n = 0
+            self._rev.bump()
 
     def add_value(self, timestamp: int, info: SockInfo | None) -> None:
         """Sorted insert with tail dedup (AddValue, sock_num_line.go:62-80):
@@ -99,6 +123,7 @@ class SocketLine:
                 self._dport[idx] = info.dport
             self._last_match[idx] = 0
             self._n = n + 1
+            self._rev.bump()
 
     def get_value(self, timestamp: int, now_ns: int = 0) -> SockInfo | None:
         out = self.get_values(np.asarray([timestamp], dtype=np.uint64), now_ns)
@@ -247,10 +272,40 @@ class SocketLine:
             arr = getattr(self, name)
             arr[: k.shape[0]] = arr[k]
         self._n = k.shape[0]
+        self._rev.bump()
 
     def snapshot(self) -> list[tuple[int, bool]]:
         with self._lock:
             return [(int(self._ts[i]), bool(self._open[i])) for i in range(self._n)]
+
+    def export_arrays(
+        self,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Consistent copies of (ts, open, saddr, sport, daddr, dport) for the
+        native engine's flattened snapshot."""
+        with self._lock:
+            n = self._n
+            return (
+                self._ts[:n].copy(),
+                self._open[:n].copy(),
+                self._saddr[:n].copy(),
+                self._sport[:n].copy(),
+                self._daddr[:n].copy(),
+                self._dport[:n].copy(),
+            )
+
+    def touch(self, local_idx: np.ndarray, now_ns: int) -> None:
+        """Mark snapshot-resolved entries as matched (native join writeback).
+
+        ``local_idx`` indexes the entries as of the snapshot; a concurrent
+        insert can shift them, so out-of-range hits are clipped away — this
+        only feeds the DeleteUnused staleness heuristic, not join results."""
+        if not now_ns:
+            return
+        with self._lock:
+            idx = local_idx[local_idx < self._n]
+            if idx.shape[0]:
+                self._last_match[idx] = np.uint64(now_ns)
 
 
 class SocketLineStore:
@@ -261,9 +316,13 @@ class SocketLineStore:
     def __init__(self) -> None:
         self._lines: dict[tuple[int, int], SocketLine] = {}  # lockless-ok: double-checked fast path — reads are single GIL-atomic dict lookups; every structural mutation holds self._lock
         self._lock = threading.Lock()
+        self.rev = _Rev()  # shared with every line; native snapshot cache key
 
     def __len__(self) -> int:
         return len(self._lines)
+
+    def items(self) -> list[tuple[tuple[int, int], SocketLine]]:
+        return list(self._lines.items())
 
     def get(self, pid: int, fd: int) -> SocketLine | None:
         return self._lines.get((pid, fd))
@@ -275,7 +334,7 @@ class SocketLineStore:
             with self._lock:
                 line = self._lines.get(key)
                 if line is None:
-                    line = SocketLine(pid, fd)
+                    line = SocketLine(pid, fd, rev=self.rev)
                     self._lines[key] = line
         return line
 
@@ -286,6 +345,8 @@ class SocketLineStore:
             doomed = [k for k in self._lines if k[0] == pid]
             for k in doomed:
                 del self._lines[k]
+            if doomed:
+                self.rev.bump()
             return len(doomed)
 
     def gc(self) -> None:

@@ -468,6 +468,17 @@ class RuntimeConfig:
     # and needs a picklable label_fn. "process" also applies at
     # ingest_workers == 1 (ingest leaves the serving process's GIL).
     ingest_backend: str = "thread"
+    # L7 engine body backend (ISSUE 16, ARCHITECTURE §3s): "python" runs
+    # _process_l7_inner's join/attribution/row-fill as the numpy stage;
+    # "native" routes it through alz_process_l7 in libalaz_ingest.so —
+    # one C++ pass per batch, GIL held only for the block handoff.
+    # Bit-identical output (parity-tested); falls back to "python" with
+    # a warning when the .so is unavailable. env-reading DEFAULT (not
+    # just from_env) so spawned shard processes and chaos pipelines that
+    # build a plain RuntimeConfig() still honor ENGINE_BACKEND=native.
+    engine_backend: str = field(
+        default_factory=lambda: env_str("ENGINE_BACKEND", "python")
+    )
     # shm ring geometry (process backend only; alazspec pins the layout
     # in wire_layouts.json `shm_ring`): bytes per fixed slot and slots
     # per ring. A scattered chunk must fit in ring_slots - 1 slots;
@@ -539,6 +550,7 @@ class RuntimeConfig:
             idle_flush_grace_s=env_float("IDLE_FLUSH_GRACE_S", 30.0),
             ingest_workers=env_int("INGEST_WORKERS", 1),
             ingest_backend=env_str("INGEST_BACKEND", "thread"),
+            engine_backend=env_str("ENGINE_BACKEND", "python"),
             shm_slot_bytes=env_int("SHM_SLOT_BYTES", 65_536),
             shm_ring_slots=env_int("SHM_RING_SLOTS", 512),
             tenants=env_int("TENANTS", 1),

@@ -68,7 +68,17 @@ NATIVE_EXPORTS: dict = {
     "alz_current_edge_count": ("i64", ("ptr",)),
     "alz_close_window_feats": (
         "i32",
-        ("ptr", "u32", "u32", "ptr", "f32") + ("ptr",) * 6,
+        ("ptr", "u32", "u32", "ptr", "f32", "u32", "u64") + ("ptr",) * 7,
+    ),
+    "alz_process_l7": (
+        "i64",
+        ("ptr", "i64", "u64",  # events, n, now_ns
+         "ptr", "ptr", "ptr", "i64",  # sl_pid, sl_fd, sl_off, n_lines
+         "ptr", "ptr", "ptr", "ptr", "ptr", "ptr",  # ts/open/saddr/sport/daddr/dport
+         "ptr",  # sl_touched (out)
+         "ptr", "ptr", "i64",  # pod ips/uids/n
+         "ptr", "ptr", "i64",  # svc ips/uids/n
+         "ptr", "ptr", "ptr", "ptr"),  # out rows, kept_idx, unmatched_idx, counts
     ),
     "alz_group_edges": (
         "i64",
@@ -82,8 +92,17 @@ NATIVE_EXPORTS: dict = {
     "alz_edge_feat_dim": ("u32", ()),
     "alz_node_feat_dim": ("u32", ()),
     "alz_abi_record_layout": ("cstr", ()),
+    "alz_abi_l7_event_layout": ("cstr", ()),
+    "alz_abi_request_layout": ("cstr", ()),
     "alz_source_hash": ("cstr", ()),
 }
+
+# Drop/retry cause order of alz_process_l7's `counts` output vector —
+# counts[0] is requeue-or-no_socket (unmatched join), counts[1] is the
+# not_pod attribution drop. Pinned in the alazspec l7_engine wire table;
+# the aggregator maps them onto DropLedger "filtered" reasons, so a
+# reorder here without a spec regen fails tier-1.
+L7_ENGINE_DROP_CAUSES = ("no_socket", "not_pod")
 
 # The per-column meaning of alz_close_window's 10 output pointers and
 # alz_export_nodes' 2 — every aggregate column after window_start_ms must
@@ -125,6 +144,23 @@ def record_layout_string() -> str:
     from alaz_tpu.events.schema import dtype_layout
 
     return dtype_layout(NATIVE_RECORD_DTYPE, "AlzRecord")
+
+
+def l7_event_layout_string() -> str:
+    """L7_EVENT_DTYPE's layout string — the input half of the
+    alz_process_l7 wire contract (AlzL7Event mirror in ingest.cc)."""
+    from alaz_tpu.events.schema import L7_EVENT_DTYPE, dtype_layout
+
+    return dtype_layout(L7_EVENT_DTYPE, "AlzL7Event")
+
+
+def request_layout_string() -> str:
+    """REQUEST_DTYPE's layout string — the output half of the
+    alz_process_l7 wire contract (AlzRequest mirror in ingest.cc)."""
+    from alaz_tpu.datastore.dto import REQUEST_DTYPE
+    from alaz_tpu.events.schema import dtype_layout
+
+    return dtype_layout(REQUEST_DTYPE, "AlzRequest")
 
 
 def loaded_source_hash() -> Optional[str]:
@@ -199,6 +235,20 @@ def _register(lib: ctypes.CDLL) -> None:
             f"  dtype: {record_layout_string()}\n"
             "rebuild with make -C alaz_tpu/native -B"
         )
+    # L7 engine wire mirrors (ISSUE 16): alz_process_l7 reads L7_EVENT_DTYPE
+    # bytes and writes REQUEST_DTYPE bytes directly — same loud-failure
+    # rationale as AlzRecord, for both directions of the handoff.
+    for fn_name, want in (
+        ("alz_abi_l7_event_layout", l7_event_layout_string()),
+        ("alz_abi_request_layout", request_layout_string()),
+    ):
+        compiled = getattr(lib, fn_name)().decode()
+        if compiled != want:
+            raise RuntimeError(
+                f"libalaz_ingest.so {fn_name} drifted from the pinned "
+                f"dtype:\n  .so:   {compiled}\n  dtype: {want}\n"
+                "rebuild with make -C alaz_tpu/native -B"
+            )
 
 
 def available() -> bool:
@@ -306,6 +356,14 @@ class NativeWindowedStore:
     def acc_dropped(self) -> int:
         return self.ingest.acc_dropped
 
+    @property
+    def sampled_edges(self) -> int:
+        return self.ingest.sampled_edges
+
+    @property
+    def sampled_rows(self) -> int:
+        return self.ingest.sampled_rows
+
     def persist_requests(self, batch: np.ndarray) -> None:
         with self._lock:
             self.last_persist_monotonic = time.monotonic()
@@ -371,6 +429,9 @@ class NativeIngest:
         max_edges: int = 1 << 20,
         max_nodes: int = 1 << 20,
         renumber: bool = False,
+        degree_cap: int = 0,
+        sample_seed: int = 0,
+        ledger=None,
     ):
         lib = _load()
         if lib is None:
@@ -383,6 +444,15 @@ class NativeIngest:
         # the locality pass runs host-side on the exported arrays — the
         # C++ core's internal slot assignment is untouched
         self.renumber = renumber
+        # per-dst fan-in cap folded into the close pass (ISSUE 16): the
+        # C++ side draws the SAME sample_priorities(seed, window, uids,
+        # proto) bottom-k as graph/builder.py degree_cap_select, so the
+        # native close and the numpy builder select identical survivors
+        self.degree_cap = int(degree_cap)
+        self.sample_seed = int(sample_seed)
+        self.ledger = ledger
+        self.sampled_edges = 0
+        self.sampled_rows = 0
         self._h = ctypes.c_void_p(
             lib.alz_create(self.window_ms, ring_capacity, max_edges, max_nodes)
         )
@@ -507,12 +577,15 @@ class NativeIngest:
         ef = np.zeros((e_pad, EDGE_FEATURE_DIM), np.float32)
         nf = np.zeros((n_pad, NODE_FEATURE_DIM), np.float32)
         ws = ctypes.c_int64(0)
+        sampled = np.zeros(2, np.int64)  # [cut_edges, cut_rows]
         ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
         n = int(
             self._lib.alz_close_window_feats(
                 self._h, e_pad, n_pad, ctypes.byref(ws),
                 ctypes.c_float(self.window_s),
+                self.degree_cap, self.sample_seed,
                 ptr(es), ptr(ed), ptr(et), ptr(cnt), ptr(ef), ptr(nf),
+                ptr(sampled),
             )
         )
         if n == -2:
@@ -521,6 +594,11 @@ class NativeIngest:
             raise RuntimeError("native node buffer too small; raise max_nodes")
         if n < 0:
             raise RuntimeError("native edge buffer overflow; raise max_edges")
+        if sampled[0]:
+            self.sampled_edges += int(sampled[0])
+            self.sampled_rows += int(sampled[1])
+            if self.ledger is not None:
+                self.ledger.add("sampled", int(sampled[1]), reason="degree_cap")
 
         uids = np.zeros(n_pad, np.int32)
         types = np.zeros(n_pad, np.uint8)

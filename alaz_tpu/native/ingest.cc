@@ -99,6 +99,66 @@ struct NodeSlot {
   uint8_t used;
 };
 
+// ---------------------------------------------------------------------------
+// L7 engine wire mirrors (ISSUE 16). These are byte-for-byte images of the
+// PACKED numpy dtypes the Python plane pins (events/schema.py
+// L7_EVENT_DTYPE, datastore/dto.py REQUEST_DTYPE) — the same arrays the
+// shm_ring ABI already carries between shard processes, so a shard worker
+// can hand a ring-slot view straight to alz_process_l7 with zero per-row
+// Python work. graph/native.py refuses the .so at load when the layout
+// strings below disagree with dtype_layout() (the AlzRecord precedent).
+// ---------------------------------------------------------------------------
+
+#pragma pack(push, 1)
+
+struct AlzL7Event {
+  uint32_t pid;
+  uint64_t fd;
+  uint64_t write_time_ns;
+  uint64_t duration_ns;
+  uint8_t protocol;
+  uint8_t method;
+  uint8_t tls;
+  uint8_t failed;
+  uint32_t status;
+  uint32_t payload_size;
+  uint8_t payload_read_complete;
+  uint32_t tid;
+  uint32_t seq;
+  int16_t kafka_api_version;
+  uint32_t mysql_prep_stmt_id;
+  uint32_t saddr;
+  uint16_t sport;
+  uint32_t daddr;
+  uint16_t dport;
+  uint64_t event_read_time_ns;
+  uint8_t payload[256];
+};
+static_assert(sizeof(AlzL7Event) == 331, "L7_EVENT_DTYPE mirror drifted");
+
+struct AlzRequest {
+  int64_t start_time_ms;
+  uint64_t latency_ns;
+  uint32_t from_ip;
+  uint8_t from_type;
+  int32_t from_uid;
+  uint16_t from_port;
+  uint32_t to_ip;
+  uint8_t to_type;
+  int32_t to_uid;
+  uint16_t to_port;
+  uint8_t protocol;
+  uint8_t tls;
+  uint8_t completed;
+  uint32_t status_code;
+  int32_t fail_reason;
+  uint8_t method;
+  int32_t path;
+};
+static_assert(sizeof(AlzRequest) == 54, "REQUEST_DTYPE mirror drifted");
+
+#pragma pack(pop)
+
 }  // extern "C"
 
 namespace {
@@ -270,6 +330,14 @@ struct Ingest {
   };
   static_assert(sizeof(NodeAcc) == 64, "one cache line per node");
   std::vector<NodeAcc> nacc;                           // per-node stats
+
+  // degree-cap scratch (close-path sampling, ISSUE 16): per-edge
+  // priorities, a dst-grouped placement order and the survivor flags —
+  // persistent like dst_off/nacc so capped closes allocate nothing steady
+  // state.
+  std::vector<uint64_t> eprio;
+  std::vector<uint32_t> eorder;
+  std::vector<uint8_t> ekeep;
 
   Ingest(int64_t wms, uint32_t ring_cap, uint32_t edge_cap, uint32_t node_cap)
       : ring(ring_cap), ring_mask(ring_cap - 1), window_ms(wms),
@@ -511,12 +579,24 @@ uint32_t alz_node_feat_dim(void) { return kNodeFeatDim; }
 // 256k-edge window → ~10 ms). Buffers: src/dst/etype/count sized e_cap;
 // ef e_cap*16 floats; nf n_cap*32 floats. ef/nf rows must arrive
 // zeroed — only nonzero slots are written (cols 7..15 one-hot, nf cols
-// 0..11). Returns the edge count; -1 e_cap too small, -2 no open
-// window, -3 n_cap smaller than the node table.
+// 0..11).
+//
+// degree_cap > 0 folds alz_sample_degree_cap into the close (ISSUE 16,
+// carried ROADMAP item): every over-cap dst keeps the `cap` edges with
+// the smallest sample_priorities(seed, window, dst-uid, src-uid, proto)
+// — the SAME pure-function draw as graph/builder.py, so serial numpy
+// builds and this path select identically. Node features keep the FULL
+// pre-cap aggregate (the builder contract: a hot-key dst keeps its real
+// in-degree signal); only edge emission is cut. sampled_out[0]/[1]
+// report cut edges/rows for the ledger's sampled/degree_cap row.
+// Returns the emitted (post-cap) edge count; -1 e_cap too small, -2 no
+// open window, -3 n_cap smaller than the node table.
 int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
                                int64_t* window_start_ms, float window_s,
+                               uint32_t degree_cap, uint64_t sample_seed,
                                int32_t* src, int32_t* dst, int32_t* etype,
-                               uint64_t* count, float* ef, float* nf) {
+                               uint64_t* count, float* ef, float* nf,
+                               int64_t* sampled_out) {
   Ingest* ig = static_cast<Ingest*>(p);
   WindowAcc* acc = ig->oldest_open();
   if (acc == nullptr) return -2;
@@ -526,14 +606,19 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
   if (n > e_cap) return -1;
   if (n_nodes > n_cap) return -3;
   *window_start_ms = acc->window_id() * ig->window_ms;
+  sampled_out[0] = 0;
+  sampled_out[1] = 0;
 
   ig->dst_off.assign(n_nodes + 1, 0);
   ig->nacc.assign(n_nodes, Ingest::NodeAcc{});
   Ingest::NodeAcc* nacc = ig->nacc.data();
 
-  // pass 1: dst histogram + per-node accumulators (2 cache lines/edge)
+  // pass 1: dst histogram + per-node accumulators (2 cache lines/edge).
+  // Runs over ALL edges — node features see the pre-cap aggregate.
+  uint32_t max_in_deg = 0;
   for (const EdgeSlot& e : edges) {
-    ig->dst_off[e.dst_slot + 1] += 1;
+    const uint32_t deg = ++ig->dst_off[e.dst_slot + 1];
+    if (deg > max_in_deg) max_in_deg = deg;
     const double c = static_cast<double>(e.count);
     Ingest::NodeAcc& s = nacc[e.src_slot];
     Ingest::NodeAcc& d = nacc[e.dst_slot];
@@ -548,9 +633,65 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
   }
   for (uint32_t i = 0; i < n_nodes; ++i) ig->dst_off[i + 1] += ig->dst_off[i];
 
+  // cap pass: bottom-k per over-cap dst by (priority, arena index). The
+  // priority replicates graph/builder.py sample_priorities bit-for-bit:
+  // base = mix64((seed << 32) ^ window_start_ms); per edge
+  // mix64((u64(i64(dst_uid)) << 32) ^ u64(i64(src_uid)) ^ (proto << 56)
+  // ^ base) — sign-extended uids, exactly the numpy int64→uint64 casts.
+  uint32_t n_emit = n;
+  const bool capped = degree_cap > 0 && max_in_deg > degree_cap;
+  if (capped) {
+    const uint64_t base =
+        mix64((sample_seed << 32) ^ static_cast<uint64_t>(*window_start_ms));
+    ig->eprio.resize(n);
+    ig->eorder.resize(n);
+    ig->ekeep.assign(n, 1);
+    // dst-grouped placement (same counting sort as pass 2, on a copy of
+    // the offsets) so each dst's edges are a contiguous slice of eorder
+    std::vector<uint32_t> place(ig->dst_off.begin(), ig->dst_off.end() - 1);
+    for (uint32_t i = 0; i < n; ++i) {
+      const EdgeSlot& e = edges[i];
+      uint64_t x =
+          (static_cast<uint64_t>(static_cast<int64_t>(e.to_uid)) << 32) ^
+          static_cast<uint64_t>(static_cast<int64_t>(e.from_uid)) ^
+          (static_cast<uint64_t>(e.protocol) << 56);
+      ig->eprio[i] = mix64(x ^ base);
+      ig->eorder[place[e.dst_slot]++] = i;
+    }
+    const uint64_t* prio = ig->eprio.data();
+    for (uint32_t g = 0; g < n_nodes; ++g) {
+      // after the prefix sum, dst slot g's edges span
+      // [dst_off[g], dst_off[g+1]) of the placement order
+      const uint32_t g0 = ig->dst_off[g];
+      const uint32_t g1 = ig->dst_off[g + 1];
+      const uint32_t size = g1 - g0;
+      if (size <= degree_cap) continue;
+      uint32_t* beg = ig->eorder.data() + g0;
+      uint32_t* end = ig->eorder.data() + g1;
+      std::nth_element(beg, beg + degree_cap, end,
+                       [prio](uint32_t a, uint32_t b) {
+                         return prio[a] != prio[b] ? prio[a] < prio[b] : a < b;
+                       });
+      for (uint32_t* it = beg + degree_cap; it != end; ++it) {
+        ig->ekeep[*it] = 0;
+        sampled_out[0] += 1;
+        sampled_out[1] += static_cast<int64_t>(edges[*it].count);
+      }
+    }
+    n_emit = n - static_cast<uint32_t>(sampled_out[0]);
+    // rebuild the dst histogram over the SURVIVORS for pass 2 placement
+    ig->dst_off.assign(n_nodes + 1, 0);
+    for (uint32_t i = 0; i < n; ++i) {
+      if (ig->ekeep[i]) ig->dst_off[edges[i].dst_slot + 1] += 1;
+    }
+    for (uint32_t i = 0; i < n_nodes; ++i) ig->dst_off[i + 1] += ig->dst_off[i];
+  }
+
   // pass 2: place each edge at its sorted position, features inline
   const double ws = window_s > 1e-6f ? static_cast<double>(window_s) : 1e-6;
-  for (const EdgeSlot& e : edges) {
+  for (uint32_t i = 0; i < n; ++i) {
+    const EdgeSlot& e = edges[i];
+    if (capped && !ig->ekeep[i]) continue;
     const uint32_t pos = ig->dst_off[e.dst_slot]++;
     src[pos] = e.src_slot;
     dst[pos] = e.dst_slot;
@@ -591,7 +732,7 @@ int32_t alz_close_window_feats(void* p, uint32_t e_cap, uint32_t n_cap,
 
   if (acc->window_id() > ig->closed_upto) ig->closed_upto = acc->window_id();
   ig->release(acc);
-  return static_cast<int32_t>(n);
+  return static_cast<int32_t>(n_emit);
 }
 
 // ---------------------------------------------------------------------------
@@ -748,6 +889,273 @@ int64_t alz_sample_degree_cap(const int32_t* dst, const uint64_t* prio,
   return kept;
 }
 
+// ---------------------------------------------------------------------------
+// Native batch L7 engine (ISSUE 16): the `_process_l7_inner` join +
+// attribution + REQUEST-row emission body in one pass over the batch.
+// STATELESS like alz_group_edges — every piece of mutable state stays
+// Python-owned and arrives as arrays:
+//
+//  - the socket-line table comes in FLATTENED (per-line entry slices of
+//    one concatenated arena, lines lexsorted by (pid, fd), offsets
+//    sl_off[n_lines+1]) — a snapshot the binding caches and rebuilds only
+//    when the store's revision counter moves;
+//  - pod/service attribution tables are the _IpTable._compile() arrays
+//    (sorted u32 ips / i32 uids — recompiles swap arrays, never mutate,
+//    so handing them over without a lock is safe);
+//  - emitted REQUEST rows land in `out` in ORIGINAL row order (the order
+//    the numpy boolean-mask path preserves), with kept_idx/unmatched_idx
+//    reporting ascending original indexes so the Python side can requeue
+//    retry rows and keep DropLedger `filtered` accounting EXACT:
+//    counts[0] = unmatched (no_socket/requeue), counts[1] = not_pod.
+//
+// The caller holds the GIL only to hand these blocks off — ctypes
+// releases it for the call, so thread-mode shards overlap here too.
+// Stateful corners stay Python (the backend's documented refusal
+// surface): retry scheduling, outbound reverse-DNS interning, payload
+// path enrichment, h2/kafka reassembly, proc/k8s folds, rate limiting.
+// ---------------------------------------------------------------------------
+
+// _IpTable.lookup for one ip: searchsorted(side=left), clip to size-1,
+// exact-match test; uid 0 on miss (the np.where(found, uids, 0) contract)
+static int32_t alz_ip_lookup_(const uint32_t* ips, const int32_t* uids,
+                              int64_t n, uint32_t ip, bool* found) {
+  if (n == 0) {
+    *found = false;
+    return 0;
+  }
+  int64_t idx = std::lower_bound(ips, ips + n, ip) - ips;
+  if (idx >= n) idx = n - 1;
+  *found = ips[idx] == ip;
+  return *found ? uids[idx] : 0;
+}
+
+// Open-addressed exact-match mirror of alz_ip_lookup_ for the batch hot
+// loop: the compiled tables are consulted 2-3x PER ROW, and a dependent-
+// load binary search chain costs ~10 mispredict-prone probes per lookup
+// where one L1-resident probe suffices. Built per call (the tables are
+// snapshots that never mutate in place) when the batch is large enough
+// to amortize the inserts — a pure access-path swap, the (found, uid)
+// result for every ip is identical to the binary search by construction.
+struct AlzIpHash {
+  std::vector<uint32_t> key;
+  std::vector<int32_t> uid;
+  std::vector<uint8_t> used;
+  uint32_t mask = 0;
+
+  void build(const uint32_t* ips, const int32_t* uids, int64_t n) {
+    uint32_t cap = 16;
+    while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
+    mask = cap - 1;
+    key.assign(cap, 0);
+    uid.assign(cap, 0);
+    used.assign(cap, 0);
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t slot = (ips[i] * 0x9E3779B9u) & mask;
+      while (used[slot]) slot = (slot + 1) & mask;  // keys are unique
+      key[slot] = ips[i];
+      uid[slot] = uids[i];
+      used[slot] = 1;
+    }
+  }
+
+  int32_t lookup(uint32_t ip, bool* found) const {
+    uint32_t slot = (ip * 0x9E3779B9u) & mask;
+    while (used[slot]) {
+      if (key[slot] == ip) {
+        *found = true;
+        return uid[slot];
+      }
+      slot = (slot + 1) & mask;
+    }
+    *found = false;
+    return 0;
+  }
+};
+
+// SocketLine.get_values (sockline.py) case-for-case for ONE timestamp
+// over flattened entries [a, b); uint64 subtractions wrap exactly like
+// the numpy side's. Returns the selected LOCAL entry index, or -1.
+static int64_t alz_sockline_pick_(const uint64_t* ts, const uint8_t* open_,
+                                  const uint32_t* daddr, const uint16_t* dport,
+                                  int64_t a, int64_t b, uint64_t t) {
+  const int64_t nL = b - a;
+  if (nL == 0) return -1;
+  const uint64_t* base = ts + a;
+  const int64_t idx = std::lower_bound(base, ts + b, t) - base;  // side="left"
+  if (idx == nL) {  // after the last entry
+    if (open_[b - 1]) return nL - 1;
+    if (nL >= 2 && open_[b - 2] && (t - ts[b - 2]) < 60000000000ULL)
+      return nL - 2;  // ONE_MINUTE_NS close-race tolerance
+    return -1;
+  }
+  if (idx == 0) return open_[a] ? 0 : -1;  // before the first entry
+  const int64_t prev = idx - 1;
+  if (open_[a + prev]) return prev;
+  // landed on a close: neighbor-agreement heuristic
+  const int64_t cp = prev - 1;
+  const int64_t ca = prev + 1;  // == idx, < nL in this branch
+  if (cp < 0 || !open_[a + cp] || !open_[a + ca]) return -1;
+  if (daddr[a + cp] != daddr[a + ca] || dport[a + cp] != dport[a + ca])
+    return -1;
+  return (t - ts[a + cp]) < (ts[a + ca] - t) ? cp : ca;
+}
+
+int64_t alz_process_l7(const AlzL7Event* ev, int64_t n, uint64_t now_ns,
+                       const uint32_t* sl_pid, const uint64_t* sl_fd,
+                       const int64_t* sl_off, int64_t n_lines,
+                       const uint64_t* sl_ts, const uint8_t* sl_open,
+                       const uint32_t* sl_saddr, const uint16_t* sl_sport,
+                       const uint32_t* sl_daddr, const uint16_t* sl_dport,
+                       uint8_t* sl_touched, const uint32_t* pod_ips,
+                       const int32_t* pod_uids, int64_t n_pod,
+                       const uint32_t* svc_ips, const int32_t* svc_uids,
+                       int64_t n_svc, AlzRequest* out, int64_t* kept_idx,
+                       int64_t* unmatched_idx, int64_t* counts) {
+  (void)now_ns;  // _last_match writeback happens Python-side via sl_touched
+  counts[0] = 0;
+  counts[1] = 0;
+  if (n <= 0) return 0;
+
+  // -- phase 1: V1 socket-line join for rows without embedded addresses.
+  // `matched` exists only when the batch HAS V1 rows — the all-V2 hot
+  // path (every row carries addresses) skips the flag vector entirely
+  // and phase 2 runs branch-free on it.
+  std::vector<uint8_t> matched;
+  std::vector<uint32_t> jsa, jda;
+  std::vector<uint16_t> jsp, jdp;
+  std::vector<std::pair<uint64_t, int64_t>> keyed;
+  for (int64_t i = 0; i < n; ++i) {
+    if (ev[i].daddr == 0) {
+      // the SAME hashed conn key the numpy path groups on — collisions
+      // fold (pid, fd) pairs together there, so they must fold here too
+      const uint64_t key = (static_cast<uint64_t>(ev[i].pid) << 32) ^
+                           (ev[i].fd * 0x9E3779B97F4A7C15ULL);
+      keyed.emplace_back(key, i);
+    }
+  }
+  const bool any_v1 = !keyed.empty();
+  if (any_v1) {
+    matched.assign(static_cast<size_t>(n), 1);
+    for (const auto& k : keyed) matched[static_cast<size_t>(k.second)] = 0;
+    jsa.resize(static_cast<size_t>(n));
+    jsp.resize(static_cast<size_t>(n));
+    jda.resize(static_cast<size_t>(n));
+    jdp.resize(static_cast<size_t>(n));
+    // stable: rows inside a key group stay in original order, so the
+    // group head is the first occurrence — numpy's sel[0]
+    std::stable_sort(
+        keyed.begin(), keyed.end(),
+        [](const std::pair<uint64_t, int64_t>& x,
+           const std::pair<uint64_t, int64_t>& y) { return x.first < y.first; });
+    size_t g0 = 0;
+    while (g0 < keyed.size()) {
+      size_t g1 = g0 + 1;
+      while (g1 < keyed.size() && keyed[g1].first == keyed[g0].first) ++g1;
+      const AlzL7Event& head = ev[keyed[g0].second];
+      // binary search the (pid, fd) pair in the lexsorted snapshot keys
+      int64_t lo = 0, hi = n_lines;
+      while (lo < hi) {
+        const int64_t mid = lo + (hi - lo) / 2;
+        if (sl_pid[mid] < head.pid ||
+            (sl_pid[mid] == head.pid && sl_fd[mid] < head.fd)) {
+          lo = mid + 1;
+        } else {
+          hi = mid;
+        }
+      }
+      if (lo < n_lines && sl_pid[lo] == head.pid && sl_fd[lo] == head.fd) {
+        const int64_t a = sl_off[lo];
+        const int64_t b = sl_off[lo + 1];
+        for (size_t k = g0; k < g1; ++k) {
+          const int64_t row = keyed[k].second;
+          const int64_t sel = alz_sockline_pick_(sl_ts, sl_open, sl_daddr,
+                                                 sl_dport, a, b,
+                                                 ev[row].write_time_ns);
+          if (sel < 0) continue;
+          jsa[static_cast<size_t>(row)] = sl_saddr[a + sel];
+          jsp[static_cast<size_t>(row)] = sl_sport[a + sel];
+          jda[static_cast<size_t>(row)] = sl_daddr[a + sel];
+          jdp[static_cast<size_t>(row)] = sl_dport[a + sel];
+          matched[static_cast<size_t>(row)] = 1;
+          sl_touched[a + sel] = 1;
+        }
+      }
+      g0 = g1;
+    }
+  }
+
+  // -- phase 2: sequential original-order pass — requeue partition,
+  // pod/service attribution, REQUEST row fill (the numpy boolean-mask
+  // order is ascending original index, reproduced exactly). Attribution
+  // goes through the L1-resident hash mirrors when the batch is large
+  // enough to amortize building them (2-3 lookups per row; identical
+  // (found, uid) results either way), and the service probe is skipped
+  // when the destination already matched a pod — the to_type chain
+  // never consults it in that case.
+  const bool use_hash = n >= 64 && n >= (n_pod + n_svc) / 4;
+  AlzIpHash pod_h, svc_h;
+  if (use_hash) {
+    pod_h.build(pod_ips, pod_uids, n_pod);
+    svc_h.build(svc_ips, svc_uids, n_svc);
+  }
+  int64_t n_emit = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + 4 < n) {
+      // the 331-byte rows defeat the adjacent-line prefetcher; pull the
+      // row 4 ahead while this one's lookups resolve
+      __builtin_prefetch(ev + i + 4);
+    }
+    if (any_v1 && !matched[static_cast<size_t>(i)]) {
+      unmatched_idx[counts[0]++] = i;
+      continue;
+    }
+    const AlzL7Event& e = ev[i];
+    const bool via_join = e.daddr == 0;
+    const uint32_t sa = via_join ? jsa[static_cast<size_t>(i)] : e.saddr;
+    const uint16_t sp = via_join ? jsp[static_cast<size_t>(i)] : e.sport;
+    const uint32_t da = via_join ? jda[static_cast<size_t>(i)] : e.daddr;
+    const uint16_t dp = via_join ? jdp[static_cast<size_t>(i)] : e.dport;
+    bool from_pod = false;
+    const int32_t from_uid =
+        use_hash ? pod_h.lookup(sa, &from_pod)
+                 : alz_ip_lookup_(pod_ips, pod_uids, n_pod, sa, &from_pod);
+    if (!from_pod) {  // From must be a pod (setFromToV2 contract)
+      counts[1] += 1;
+      continue;
+    }
+    bool to_pod = false, to_svc = false;
+    const int32_t to_pod_uid =
+        use_hash ? pod_h.lookup(da, &to_pod)
+                 : alz_ip_lookup_(pod_ips, pod_uids, n_pod, da, &to_pod);
+    const int32_t to_svc_uid =
+        to_pod ? 0
+               : (use_hash
+                      ? svc_h.lookup(da, &to_svc)
+                      : alz_ip_lookup_(svc_ips, svc_uids, n_svc, da, &to_svc));
+    AlzRequest& r = out[n_emit];
+    r.start_time_ms = static_cast<int64_t>(e.write_time_ns / 1000000ULL);
+    r.latency_ns = e.duration_ns;
+    r.from_ip = sa;
+    r.from_type = 1;  // EP_POD
+    r.from_uid = from_uid;
+    r.from_port = sp;
+    r.to_ip = da;
+    r.to_type = to_pod ? 1 : (to_svc ? 2 : 3);  // EP_POD/EP_SERVICE/EP_OUTBOUND
+    r.to_uid = to_pod ? to_pod_uid : (to_svc ? to_svc_uid : 0);
+    r.to_port = dp;
+    r.protocol = e.protocol;
+    r.tls = e.tls;
+    r.completed = 1;
+    r.status_code = e.status;
+    r.fail_reason = 0;
+    r.method = e.method;
+    r.path = 0;
+    kept_idx[n_emit] = i;
+    ++n_emit;
+  }
+  return n_emit;
+}
+
 uint32_t alz_export_nodes(void* p, uint32_t buf_cap, int32_t* uids, uint8_t* types) {
   Ingest* ig = static_cast<Ingest*>(p);
   uint32_t n = static_cast<uint32_t>(ig->node_uids.size());
@@ -785,6 +1193,87 @@ const char* alz_abi_record_layout(void) {
         offsetof(AlzRecord, to_type), sizeof(AlzRecord::to_type),
         offsetof(AlzRecord, protocol), sizeof(AlzRecord::protocol),
         offsetof(AlzRecord, flags), sizeof(AlzRecord::flags));
+    return std::string(buf);
+  }();
+  return layout.c_str();
+}
+
+// L7 engine wire mirrors, same offsetof/sizeof self-description: the
+// binding refuses to route process_l7 through a .so whose compiled
+// layouts disagree with L7_EVENT_DTYPE / REQUEST_DTYPE.
+const char* alz_abi_l7_event_layout(void) {
+  static const std::string layout = [] {
+    char buf[1024];
+    std::snprintf(
+        buf, sizeof(buf),
+        "AlzL7Event:%zu;"
+        "pid:%zu:%zu;fd:%zu:%zu;write_time_ns:%zu:%zu;duration_ns:%zu:%zu;"
+        "protocol:%zu:%zu;method:%zu:%zu;tls:%zu:%zu;failed:%zu:%zu;"
+        "status:%zu:%zu;payload_size:%zu:%zu;payload_read_complete:%zu:%zu;"
+        "tid:%zu:%zu;seq:%zu:%zu;kafka_api_version:%zu:%zu;"
+        "mysql_prep_stmt_id:%zu:%zu;saddr:%zu:%zu;sport:%zu:%zu;"
+        "daddr:%zu:%zu;dport:%zu:%zu;event_read_time_ns:%zu:%zu;"
+        "payload:%zu:%zu",
+        sizeof(AlzL7Event),
+        offsetof(AlzL7Event, pid), sizeof(AlzL7Event::pid),
+        offsetof(AlzL7Event, fd), sizeof(AlzL7Event::fd),
+        offsetof(AlzL7Event, write_time_ns), sizeof(AlzL7Event::write_time_ns),
+        offsetof(AlzL7Event, duration_ns), sizeof(AlzL7Event::duration_ns),
+        offsetof(AlzL7Event, protocol), sizeof(AlzL7Event::protocol),
+        offsetof(AlzL7Event, method), sizeof(AlzL7Event::method),
+        offsetof(AlzL7Event, tls), sizeof(AlzL7Event::tls),
+        offsetof(AlzL7Event, failed), sizeof(AlzL7Event::failed),
+        offsetof(AlzL7Event, status), sizeof(AlzL7Event::status),
+        offsetof(AlzL7Event, payload_size), sizeof(AlzL7Event::payload_size),
+        offsetof(AlzL7Event, payload_read_complete),
+        sizeof(AlzL7Event::payload_read_complete),
+        offsetof(AlzL7Event, tid), sizeof(AlzL7Event::tid),
+        offsetof(AlzL7Event, seq), sizeof(AlzL7Event::seq),
+        offsetof(AlzL7Event, kafka_api_version),
+        sizeof(AlzL7Event::kafka_api_version),
+        offsetof(AlzL7Event, mysql_prep_stmt_id),
+        sizeof(AlzL7Event::mysql_prep_stmt_id),
+        offsetof(AlzL7Event, saddr), sizeof(AlzL7Event::saddr),
+        offsetof(AlzL7Event, sport), sizeof(AlzL7Event::sport),
+        offsetof(AlzL7Event, daddr), sizeof(AlzL7Event::daddr),
+        offsetof(AlzL7Event, dport), sizeof(AlzL7Event::dport),
+        offsetof(AlzL7Event, event_read_time_ns),
+        sizeof(AlzL7Event::event_read_time_ns),
+        offsetof(AlzL7Event, payload), sizeof(AlzL7Event::payload));
+    return std::string(buf);
+  }();
+  return layout.c_str();
+}
+
+const char* alz_abi_request_layout(void) {
+  static const std::string layout = [] {
+    char buf[1024];
+    std::snprintf(
+        buf, sizeof(buf),
+        "AlzRequest:%zu;"
+        "start_time_ms:%zu:%zu;latency_ns:%zu:%zu;from_ip:%zu:%zu;"
+        "from_type:%zu:%zu;from_uid:%zu:%zu;from_port:%zu:%zu;"
+        "to_ip:%zu:%zu;to_type:%zu:%zu;to_uid:%zu:%zu;to_port:%zu:%zu;"
+        "protocol:%zu:%zu;tls:%zu:%zu;completed:%zu:%zu;"
+        "status_code:%zu:%zu;fail_reason:%zu:%zu;method:%zu:%zu;path:%zu:%zu",
+        sizeof(AlzRequest),
+        offsetof(AlzRequest, start_time_ms), sizeof(AlzRequest::start_time_ms),
+        offsetof(AlzRequest, latency_ns), sizeof(AlzRequest::latency_ns),
+        offsetof(AlzRequest, from_ip), sizeof(AlzRequest::from_ip),
+        offsetof(AlzRequest, from_type), sizeof(AlzRequest::from_type),
+        offsetof(AlzRequest, from_uid), sizeof(AlzRequest::from_uid),
+        offsetof(AlzRequest, from_port), sizeof(AlzRequest::from_port),
+        offsetof(AlzRequest, to_ip), sizeof(AlzRequest::to_ip),
+        offsetof(AlzRequest, to_type), sizeof(AlzRequest::to_type),
+        offsetof(AlzRequest, to_uid), sizeof(AlzRequest::to_uid),
+        offsetof(AlzRequest, to_port), sizeof(AlzRequest::to_port),
+        offsetof(AlzRequest, protocol), sizeof(AlzRequest::protocol),
+        offsetof(AlzRequest, tls), sizeof(AlzRequest::tls),
+        offsetof(AlzRequest, completed), sizeof(AlzRequest::completed),
+        offsetof(AlzRequest, status_code), sizeof(AlzRequest::status_code),
+        offsetof(AlzRequest, fail_reason), sizeof(AlzRequest::fail_reason),
+        offsetof(AlzRequest, method), sizeof(AlzRequest::method),
+        offsetof(AlzRequest, path), sizeof(AlzRequest::path));
     return std::string(buf);
   }();
   return layout.c_str();

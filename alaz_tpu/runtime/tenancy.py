@@ -141,20 +141,18 @@ class TenantPartition:
                         "ingest_workers > 1 ignored with use_native_ingest: "
                         "the C++ window accumulator is its own ingest plane"
                     )
-                if degree_cap:
-                    # the C++ accumulator assembles features in its own
-                    # close pass (alz_close_window_feats) — the cap rides
-                    # the GraphBuilder paths only; a silent no-op here
-                    # would let a hot key through a "capped" deployment
-                    log.warning(
-                        "degree_cap is not applied by the native window "
-                        "accumulator; use the sharded or numpy ingest "
-                        "plane for hot-key protection"
-                    )
+                # degree_cap rides the C++ close pass itself now
+                # (alz_close_window_feats selects bottom-k priorities per
+                # hot dst, bit-identical to degree_cap_select) — cut rows
+                # land in the shared ledger under sampled/degree_cap, same
+                # as the GraphBuilder paths
                 self.graph_store = native_mod.NativeWindowedStore(
                     window_s=config.window_s,
                     on_batch=on_batch,
                     renumber=renumber,
+                    degree_cap=degree_cap,
+                    sample_seed=sample_seed,
+                    ledger=self.ledger,
                 )
             else:
                 log.warning(

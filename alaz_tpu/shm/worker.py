@@ -201,6 +201,17 @@ def shard_worker_main(spec: WorkerSpec) -> None:
     # so the parent's close rule waits for real progress, not the dead
     # predecessor's horizon
     req.set_stat_i64(S_WATERMARK, W_FLOOR)
+    # engine backend (ISSUE 16): ENGINE_BACKEND=native reaches this
+    # spawned process through RuntimeConfig.engine_backend's env-reading
+    # default (or the parent's explicit config). dlopen + layout-check
+    # the .so BEFORE the readiness handshake so the first traffic batch
+    # never pays the load inside a caller's measured window.
+    if agg._use_native_engine():
+        eng = agg._native_l7_engine()
+        log.info(
+            f"shm shard{spec.shard_index} L7 engine backend: native "
+            f"(loaded={eng is not None})"
+        )
     # readiness handshake: generation+1 (never 0) says THIS generation's
     # loop is about to poll — wait_ready() pins pool spawn cost outside
     # a caller's measured window (the bench's steady-state contract)

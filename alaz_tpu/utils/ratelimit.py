@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import threading
 
+import numpy as np
+
 
 class TokenBucket:
     def __init__(self, rate_per_s: float, burst: float, now_s: float = 0.0):
@@ -32,3 +34,42 @@ class TokenBucket:
             take = min(n, int(self._tokens))
             self._tokens -= take
             return take
+
+
+def admit_batch(buckets: list[TokenBucket], counts, now_s: float) -> np.ndarray:
+    """Vectorized ``TokenBucket.admit`` across many buckets: admits
+    ``counts[i]`` units on ``buckets[i]`` at ``now_s`` in one array pass.
+
+    Per-bucket results and post-call bucket state are bit-identical to
+    calling ``buckets[i].admit(counts[i], now_s)`` one by one — the same
+    max/min/int-truncation chain over IEEE doubles, in the same order
+    (``int()`` truncates toward zero; tokens are non-negative so
+    ``astype(int64)`` matches).
+
+    The per-bucket locks are taken only to snapshot and write back state:
+    callers serialize whole admissions themselves (the aggregator holds
+    ``_l7_lock`` across the batch), the bucket locks just fence concurrent
+    readers like the gc staleness sweep.
+    """
+    k = len(buckets)
+    tokens = np.empty(k, dtype=np.float64)
+    last = np.empty(k, dtype=np.float64)
+    rate = np.empty(k, dtype=np.float64)
+    burst = np.empty(k, dtype=np.float64)
+    for i, b in enumerate(buckets):
+        with b._lock:
+            tokens[i] = b._tokens
+            last[i] = b._last
+        rate[i] = b.rate
+        burst[i] = b.burst
+    elapsed = np.maximum(0.0, now_s - last)
+    tokens = np.minimum(burst, tokens + elapsed * rate)
+    take = np.minimum(
+        np.asarray(counts, dtype=np.int64), tokens.astype(np.int64)
+    )
+    tokens -= take
+    for i, b in enumerate(buckets):
+        with b._lock:
+            b._tokens = float(tokens[i])
+            b._last = now_s
+    return take

@@ -148,6 +148,40 @@ class TestWorkerSupervision:
         finally:
             pipe.stop()
 
+    def test_kill_on_tcp_item_never_ledgers_row_drops(self):
+        """A crash landing on a TCP establish item must NOT ride the row
+        ledger: TCP events are control plane (socket state), not L7
+        request rows — they appear in no conservation numerator, so
+        ledgering them reads as a NEGATIVE gap in the per-tenant gate
+        (pushed-L7 == emitted + ledger). The process backend's kill
+        books already weight only L7 rows (process_pool.py); this pins
+        the thread backend to the same contract. The row-visible
+        consequence of lost socket state is ledgered downstream as
+        filtered/no_socket, not here."""
+        from alaz_tpu.events.schema import TcpEventType, make_tcp_events
+
+        n_rows = 8_000
+        tr = make_ingest_trace(n_rows, pods=20, svcs=4, windows=2, seed=25)
+        wchaos = WorkerChaos(seed=5, crash_prob=1.0, max_crashes=1, kinds=("tcp",))
+        pipe, closed, ledger, _ = _mk_pipe(tr, 2, fault_hook=wchaos)
+        tcp = make_tcp_events(14)
+        tcp["type"] = TcpEventType.ESTABLISHED
+        tcp["timestamp_ns"] = 1
+        try:
+            pipe.process_tcp(tcp, now_ns=10_000_000_000)
+            pipe.process_l7(tr[0], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=20)
+            assert pipe.drain(timeout_s=10)
+            assert wchaos.crashes == 1
+            assert pipe.worker_restarts >= 1
+            snap = ledger.snapshot()
+            assert snap["reasons"].get("dropped/worker_crash", 0) == 0, snap
+            # the all-V2 L7 rows never needed the dead tcp item's socket
+            # state: conservation over the L7 numerator stays exact
+            assert emitted_rows(closed) + ledger.total == n_rows, snap
+        finally:
+            pipe.stop()
+
     def test_kill_mid_close_wave_flush_completes_bounded(self):
         """The regression gate: a worker killed ON the close item (the
         wave's ack can never arrive from the dead thread) must not hang

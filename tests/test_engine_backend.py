@@ -1,0 +1,411 @@
+"""Native L7 engine backend (ISSUE 16): alz_process_l7 executes the
+_process_l7_inner join/attribution/fill body in one C++ pass.
+
+The headline property: the native engine is BIT-IDENTICAL to the python
+one — same REQUEST rows, same windows/edges/features through the sharded
+pipelines at {thread, process} × N ∈ {1, 2, 4}, same stats, and EXACT
+drop-ledger accounting (no_socket, not_pod, rate_limit) — so flipping
+ENGINE_BACKEND can never change what a deployment measures, only how
+fast it measures it. Plus: the degree-capped native close
+(alz_close_window_feats) against degree_cap_select, and the vectorized
+rate limiter against its scalar reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from alaz_tpu.aggregator import native_l7
+from alaz_tpu.aggregator.cluster import ClusterInfo
+from alaz_tpu.aggregator.engine import Aggregator, set_native_engine
+from alaz_tpu.aggregator.sharded import ShardedIngest
+from alaz_tpu.config import RuntimeConfig
+from alaz_tpu.datastore.inmem import InMemDataStore
+from alaz_tpu.events.intern import Interner
+from alaz_tpu.events.net import ip_to_u32
+from alaz_tpu.events.schema import TcpEventType, make_tcp_events
+from alaz_tpu.replay.synth import make_ingest_trace
+from alaz_tpu.utils.ledger import DropLedger
+from alaz_tpu.utils.ratelimit import TokenBucket, admit_batch
+from tests.test_sharded_ingest import _canonical, _node_stats
+
+needs_native = pytest.mark.skipif(
+    not native_l7.available(), reason="libalaz_ingest.so not buildable"
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine_override():
+    yield
+    set_native_engine(None)
+
+
+def _v1ify(ev, frac=0.5, seed=0, orphan_frac=0.0):
+    """Blank the embedded addresses on ``frac`` of the rows and return
+    the TCP events that establish the (pid, fd) socket lines re-deriving
+    them (the V1 findRelatedSocket join path). ``orphan_frac`` of the
+    blanked rows get a pid with NO socket line — the retry-then-
+    no_socket path."""
+    rng = np.random.default_rng(seed)
+    ev = ev.copy()
+    n = ev.shape[0]
+    v1 = rng.random(n) < frac
+    idx = np.flatnonzero(v1)
+    orphans = idx[rng.random(idx.shape[0]) < orphan_frac]
+    ev["pid"][orphans] = 999_999  # no line ever established for this pid
+    keys = (ev["pid"][idx].astype(np.uint64) << np.uint64(32)) | ev["fd"][
+        idx
+    ].astype(np.uint64)
+    _, first = np.unique(keys, return_index=True)
+    first = first[ev["pid"][idx[first]] != 999_999]
+    tcp = make_tcp_events(first.shape[0])
+    tcp["pid"] = ev["pid"][idx[first]]
+    tcp["fd"] = ev["fd"][idx[first]]
+    tcp["timestamp_ns"] = 1  # before every write_time_ns in the trace
+    tcp["type"] = TcpEventType.ESTABLISHED
+    tcp["saddr"] = ev["saddr"][idx[first]]
+    tcp["sport"] = ev["sport"][idx[first]]
+    tcp["daddr"] = ev["daddr"][idx[first]]
+    tcp["dport"] = ev["dport"][idx[first]]
+    ev["saddr"][idx] = 0
+    ev["sport"][idx] = 0
+    ev["daddr"][idx] = 0
+    ev["dport"][idx] = 0
+    return ev, tcp
+
+
+def _run_serial_rows(ev, tcp, msgs, native, chunks, rate_limit=None):
+    """One serial Aggregator run; returns (all REQUEST rows incl. retry
+    flushes, stats dict, ledger snapshot)."""
+    set_native_engine(native)
+    try:
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(ds, interner=interner, cluster=cluster)
+        if rate_limit is not None:
+            agg.rate_limit = rate_limit
+        if tcp is not None and tcp.shape[0]:
+            agg.process_tcp(tcp, now_ns=10_000_000_000)
+        outs = []
+        lo = 0
+        for hi in list(chunks) + [ev.shape[0]]:
+            if hi > lo:
+                outs.append(agg.process_l7(ev[lo:hi], now_ns=10_000_000_000))
+                lo = hi
+        # drive the retry backoffs (20ms, 40ms) past the attempt limit
+        for dt in (25_000_000, 75_000_000, 200_000_000):
+            r = agg.flush_retries(10_000_000_000 + dt)
+            if r is not None:
+                outs.append(r)
+        rows = np.concatenate(outs) if outs else np.zeros(0, ds.all_requests().dtype)
+        return rows, agg.stats.as_dict(), agg.ledger.snapshot()
+    finally:
+        set_native_engine(None)
+
+
+@needs_native
+class TestSerialBackendParity:
+    @pytest.mark.parametrize("trace", ["v1_heavy", "all_v2"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_request_rows_bit_identical(self, trace, seed):
+        """python and native engines emit byte-identical REQUEST rows —
+        including through the retry requeue and both drop causes — over
+        randomized chunk boundaries."""
+        rng = np.random.default_rng(100 + seed)
+        n_rows = 20_000
+        ev, msgs = make_ingest_trace(
+            n_rows, pods=60, svcs=10, windows=4, seed=seed
+        )
+        # a slice of NON-pod sources exercises the not_pod drop
+        notpod = rng.random(n_rows) < 0.05
+        ev["saddr"][notpod] = np.uint32(ip_to_u32("8.8.8.8")) + rng.integers(
+            0, 64, int(notpod.sum()), dtype=np.uint32
+        )
+        if trace == "v1_heavy":
+            ev, tcp = _v1ify(ev, frac=0.7, seed=seed, orphan_frac=0.05)
+        else:
+            tcp = None
+        chunks = np.sort(rng.integers(0, n_rows, 6)).tolist()
+        p_rows, p_stats, p_led = _run_serial_rows(ev, tcp, msgs, False, chunks)
+        n_rows_out, n_stats, n_led = _run_serial_rows(ev, tcp, msgs, True, chunks)
+        assert np.array_equal(p_rows, n_rows_out), "REQUEST rows differ"
+        assert p_stats == n_stats
+        assert p_led == n_led
+        if trace == "v1_heavy":
+            assert p_stats["l7_requeued"] > 0, "retry path never fired — vacuous"
+            assert p_led["reasons"].get("filtered/no_socket", 0) > 0
+        assert p_led["reasons"].get("filtered/not_pod", 0) > 0
+
+    def test_native_requested_but_unavailable_falls_back(self, monkeypatch):
+        """A missing .so degrades to the python engine with identical
+        output (and one warning), never an error."""
+        monkeypatch.setattr(native_l7, "make_engine", lambda: None)
+        n = 2_000
+        ev, msgs = make_ingest_trace(n, pods=20, svcs=4, windows=2, seed=5)
+        p_rows, p_stats, _ = _run_serial_rows(ev, None, msgs, False, [])
+        f_rows, f_stats, _ = _run_serial_rows(ev, None, msgs, True, [])
+        assert np.array_equal(p_rows, f_rows)
+        assert p_stats == f_stats
+
+
+@needs_native
+class TestShardedBackendParity:
+    """serial (python engine) ≡ sharded (native engine): transitively
+    pins native ≡ python through the full pipeline — windows, edges,
+    bit-exact features, node rollups."""
+
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_thread_backend(self, n_workers):
+        n_rows = 20_000
+        ev, msgs = make_ingest_trace(n_rows, pods=50, svcs=8, windows=4, seed=3)
+        ev, tcp = _v1ify(ev, frac=0.4, seed=3)
+        si = Interner()
+        sclosed = []
+        from alaz_tpu.graph.builder import WindowedGraphStore
+
+        store = WindowedGraphStore(si, window_s=1.0, on_batch=sclosed.append)
+        scluster = ClusterInfo(si)
+        for m in msgs:
+            scluster.handle_msg(m)
+        sagg = Aggregator(store, interner=si, cluster=scluster)
+        sagg.process_tcp(tcp, now_ns=10_000_000_000)
+        for i in range(0, n_rows, 1 << 13):
+            sagg.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+        store.flush()
+
+        pi = Interner()
+        pclosed = []
+        pcluster = ClusterInfo(pi)
+        for m in msgs:
+            pcluster.handle_msg(m)
+        pipe = ShardedIngest(
+            n_workers, interner=pi, cluster=pcluster, window_s=1.0,
+            on_batch=pclosed.append,
+            config=RuntimeConfig(engine_backend="native"),
+        )
+        try:
+            pipe.process_tcp(tcp, now_ns=10_000_000_000)
+            for i in range(0, n_rows, 1 << 13):
+                pipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+            # non-vacuity: the native engine actually loaded in every worker
+            assert all(w._native_l7 is not None for w in pipe.workers)
+        finally:
+            pipe.stop()
+        assert _canonical(si, sclosed) == _canonical(pi, pclosed)
+        assert _node_stats(si, sclosed) == _node_stats(pi, pclosed)
+        assert pipe.stats.as_dict() == sagg.stats.as_dict()
+
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_process_backend(self, n_workers):
+        """ENGINE_BACKEND=native reaches spawned shm workers through the
+        pickled config; windows match the serial python engine exactly."""
+        from alaz_tpu.shm.process_pool import ProcessShardedIngest
+        from tests.test_sharded_ingest import _run_serial
+
+        n_rows = 16_000
+        ev, msgs = make_ingest_trace(n_rows, pods=40, svcs=8, windows=3, seed=9)
+        si, sb, _ = _run_serial(ev, msgs, n_rows)
+        interner = Interner()
+        closed = []
+        pipe = ProcessShardedIngest(
+            n_workers, interner=interner, window_s=1.0,
+            on_batch=closed.append,
+            config=RuntimeConfig(engine_backend="native"),
+        )
+        try:
+            for m in msgs:
+                pipe.process_k8s(m)
+            for i in range(0, n_rows, 1 << 13):
+                pipe.process_l7(ev[i : i + (1 << 13)], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert _canonical(si, sb) == _canonical(interner, closed)
+        assert _node_stats(si, sb) == _node_stats(interner, closed)
+        assert pipe.ledger.total == 0
+        assert pipe.request_count == n_rows
+
+
+@needs_native
+class TestLedgerExactness:
+    def test_filtered_causes_exact_counts(self):
+        """Engineered drop counts: rate=0 bucket admits exactly `burst`
+        rows for the single pid, orphan (pid, fd) rows fall out as
+        no_socket after the attempt limit, alien sources as not_pod —
+        the ledger must carry those EXACT numbers on the native engine,
+        and conservation must close."""
+        n_rows = 4_000
+        burst = 1_500
+        ev, msgs = make_ingest_trace(n_rows, pods=10, svcs=4, windows=2, seed=4)
+        ev["pid"] = 777  # one pid → one deterministic bucket
+        n_notpod = 120
+        ev["saddr"][:n_notpod] = ip_to_u32("9.9.9.9")
+        n_orphan = 200
+        orphan_slice = slice(n_notpod, n_notpod + n_orphan)
+        ev["pid"][orphan_slice] = 999_999
+        ev["saddr"][orphan_slice] = 0
+        ev["sport"][orphan_slice] = 0
+        ev["daddr"][orphan_slice] = 0
+        ev["dport"][orphan_slice] = 0
+        results = {}
+        for native in (False, True):
+            rows, stats, led = _run_serial_rows(
+                ev, None, msgs, native, [], rate_limit=(0.0, float(burst))
+            )
+            results[native] = (rows, stats, led)
+            # two pids → two buckets: pid 777 carries n_rows - n_orphan
+            # rows and admits `burst`; the orphan pid's 200 all fit
+            assert (
+                led["reasons"]["filtered/rate_limit"]
+                == n_rows - n_orphan - burst
+            )
+            admitted_notpod = int(
+                stats["l7_dropped_not_pod"]
+            )  # only admitted rows reach attribution
+            assert led["reasons"]["filtered/not_pod"] == admitted_notpod
+            assert (
+                led["reasons"].get("filtered/no_socket", 0)
+                == stats["l7_dropped_no_socket"]
+            )
+            # conservation: every admitted row is emitted or ledgered
+            assert (
+                rows.shape[0]
+                + led["filtered"]
+                == n_rows
+            ), (stats, led)
+        assert np.array_equal(results[False][0], results[True][0])
+        assert results[False][1] == results[True][1]
+        assert results[False][2] == results[True][2]
+
+
+class TestRateLimitVectorized:
+    def test_bit_identical_to_scalar_reference(self):
+        """The vectorized _apply_rate_limit: same kept rows, stats,
+        ledger AND bucket state (tokens, last) as the per-pid loop over
+        randomized multi-batch sequences."""
+        rng = np.random.default_rng(3)
+        a = Aggregator(InMemDataStore(), interner=Interner())
+        b = Aggregator(InMemDataStore(), interner=Interner())
+        a.rate_limit = b.rate_limit = (100.0, 50.0)
+        from alaz_tpu.events.schema import make_l7_events
+
+        for step in range(8):
+            n = int(rng.integers(1, 500))
+            ev = make_l7_events(n)
+            ev["pid"] = rng.choice([5, 9, 11, 200, 201], size=n)
+            now = 1_000_000_000 * (step + 1) + int(rng.integers(0, 10**8))
+            ka = a._apply_rate_limit(ev.copy(), now)
+            kb = b._scalar_apply_rate_limit(ev.copy(), now)
+            assert np.array_equal(ka, kb), f"step {step}: kept rows differ"
+        assert a.stats.as_dict() == b.stats.as_dict()
+        assert a.stats.l7_rate_limited > 0, "limiter never bit — vacuous"
+        assert a.ledger.snapshot() == b.ledger.snapshot()
+        assert set(a._pid_buckets) == set(b._pid_buckets)
+        for pid, ba in a._pid_buckets.items():
+            bb = b._pid_buckets[pid]
+            assert (ba._tokens, ba._last) == (bb._tokens, bb._last), pid
+
+    def test_admit_batch_matches_scalar_admit(self):
+        rng = np.random.default_rng(7)
+        scalar = [TokenBucket(r, bst, now_s=0.0) for r, bst in
+                  [(10.0, 5.0), (100.0, 1000.0), (0.0, 3.0), (0.5, 2.0)]]
+        vec = [TokenBucket(b.rate, b.burst, now_s=0.0) for b in scalar]
+        now = 0.0
+        for _ in range(50):
+            now += float(rng.random())
+            counts = rng.integers(0, 20, len(scalar))
+            want = [b.admit(int(c), now) for b, c in zip(scalar, counts)]
+            got = admit_batch(vec, counts, now)
+            assert got.tolist() == want
+            for s, v in zip(scalar, vec):
+                assert (s._tokens, s._last) == (v._tokens, v._last)
+
+
+@needs_native
+class TestNativeCloseDegreeCap:
+    @pytest.mark.parametrize("cap", [1, 2])
+    def test_bit_identical_to_degree_cap_select(self, cap):
+        """alz_close_window_feats' in-pass cap selects the SAME edges as
+        sample_priorities + degree_cap_select (bit-identical features,
+        identical sampled-row ledgering) at the nth_element edge caps."""
+        from alaz_tpu.graph import native
+        from alaz_tpu.graph.builder import WindowedGraphStore
+        from tests.test_native import _edge_map, _rows
+
+        nled, pled = DropLedger(), DropLedger()
+        ns = native.NativeWindowedStore(
+            window_s=1.0, degree_cap=cap, sample_seed=11, ledger=nled
+        )
+        ps = WindowedGraphStore(
+            Interner(), window_s=1.0, degree_cap=cap, sample_seed=11,
+            ledger=pled,
+        )
+        parts = [
+            _rows(400, window_ms=1000, seed=1),
+            _rows(300, window_ms=2500, seed=2),
+        ]
+        for p in parts:
+            ns.persist_requests(p.copy())
+            ps.persist_requests(p.copy())
+        ns.flush()
+        ps.flush()
+        assert ns.sampled_edges > 0, "cap never bit — vacuous"
+        assert [b.window_start_ms for b in ns.batches] == [
+            b.window_start_ms for b in ps.batches
+        ]
+        for nb, pb in zip(ns.batches, ps.batches):
+            m1, m2 = _edge_map(nb), _edge_map(pb)
+            assert set(m1) == set(m2), "kept edge sets differ"
+            for k in m1:
+                np.testing.assert_allclose(m1[k], m2[k], atol=1e-6)
+        assert (ns.sampled_edges, ns.sampled_rows) == (
+            ps.builder.sampled_edges,
+            ps.builder.sampled_rows,
+        )
+        assert nled.snapshot() == pled.snapshot()
+        ns.close()
+
+
+@needs_native
+class TestChaosNativeEngine:
+    def test_sigkill_conservation_with_native_engine(self):
+        """Exact row conservation through SIGKILLed shard processes with
+        ENGINE_BACKEND=native — the replay-or-attribute contract is
+        engine-independent."""
+        from alaz_tpu.chaos.harness import emitted_rows
+        from alaz_tpu.chaos.injectors import WorkerChaos
+        from alaz_tpu.shm.process_pool import ProcessShardedIngest
+
+        n_rows = 24_000
+        ev, msgs = make_ingest_trace(n_rows, pods=60, svcs=10, windows=4, seed=0)
+        wchaos = WorkerChaos(
+            seed=0, crash_prob=0.02, max_crashes=2, ensure_crash=True
+        )
+        interner = Interner()
+        closed = []
+        pipe = ProcessShardedIngest(
+            2, interner=interner, window_s=1.0, on_batch=closed.append,
+            fault_hook=wchaos, shed_block_s=0.5,
+            config=RuntimeConfig(engine_backend="native"),
+        )
+        try:
+            for m in msgs:
+                pipe.process_k8s(m)
+            for i in range(0, n_rows, 2048):
+                pipe.process_l7(ev[i : i + 2048], now_ns=10_000_000_000)
+            assert pipe.flush(timeout_s=60.0)
+            assert pipe.flush(timeout_s=60.0)
+        finally:
+            pipe.stop()
+        assert wchaos.crashes > 0, "kill never fired — vacuous"
+        assert pipe.worker_restarts > 0, "kill observed but no respawn"
+        gap = pipe.ledger.conservation_gap(n_rows, emitted_rows(closed))
+        assert gap == 0, (
+            f"conservation broken through SIGKILL on native engine: "
+            f"gap={gap} ledger={pipe.ledger.snapshot()}"
+        )

@@ -440,6 +440,33 @@ def wire_layout_table() -> dict:
             "default": int(RuntimeConfig().degree_cap),
             "ledger_causes": list(DropLedger.CAUSES),
         },
+        # native L7 engine contract (ISSUE 16): alz_process_l7 executes
+        # the join/attribution/REQUEST-fill body against mirrored
+        # AlzL7Event/AlzRequest row layouts — the binding refuses to
+        # load on drift, and this section pins the whole wire table:
+        # input/output layout strings, the binding signature, the
+        # drop-cause COUNT VECTOR ORDER the python side ledgers from,
+        # the config surface, and the refusal surface that stays python.
+        "l7_engine": {
+            "export": "alz_process_l7",
+            "signature": gn.export_signatures()["alz_process_l7"],
+            "input_layout": gn.l7_event_layout_string(),
+            "output_layout": gn.request_layout_string(),
+            "index_columns": ["kept_idx:i64-ascending", "unmatched_idx:i64-ascending"],
+            "drop_cause_order": list(gn.L7_ENGINE_DROP_CAUSES),
+            "config_field": "engine_backend",
+            "env": ["ALAZ_TPU_ENGINE_BACKEND", "ENGINE_BACKEND"],
+            "default": "python",
+            "refusal_surface": [
+                "retry_requeue_scheduling",
+                "ledger_accounting",
+                "outbound_reverse_dns_interning",
+                "path_enrichment",
+                "h2_kafka_reassembly",
+                "rate_limit",
+                "proc_k8s_folds",
+            ],
+        },
         # process-mode shm ring ABI (ISSUE 15): both sides of the SPAWN
         # boundary import alaz_tpu/shm, but the layout lives in shared
         # memory — a slot-header or stats-offset edit that only one
@@ -538,6 +565,10 @@ def check_wire_layouts(
                 REPO / "alaz_tpu" / "graph" / "native.py",
             ),
             ("sampling", REPO / "alaz_tpu" / "graph" / "builder.py"),
+            (
+                "l7_engine",
+                REPO / "alaz_tpu" / "aggregator" / "native_l7.py",
+            ),
             ("shm_ring", REPO / "alaz_tpu" / "shm" / "ring.py"),
         ):
             live_sec = live.get(section, {})
