@@ -261,7 +261,7 @@ class Aggregator:
         self.pg_stmts: ConnStmtCache = ConnStmtCache()
         self.mysql_stmts: ConnStmtCache = ConnStmtCache()
         # retry queue of (l7 rows, attempts, not_before_ns)
-        self._retries: deque[tuple[np.ndarray, int, int]] = deque()
+        self._retries: deque[tuple[np.ndarray, int, int]] = deque()  # guarded-by: self._l7_lock
         # L7 processing is single-logical-threaded, but the housekeeping
         # ticker also fires flush_retries (ADVICE: retries must not wait
         # for the next L7 batch); reentrant because process_l7 flushes too
@@ -326,7 +326,7 @@ class Aggregator:
             pid = int(rows["pid"][0])
             fd = int(rows["fd"][0])
             line = self.socket_lines.get_or_create(pid, fd)
-            self.live_pids.add(pid)
+            self.live_pids.add(pid)  # alazlint: disable=ALZ051 -- idempotent element op: liveness set tolerates ingest/reap interleaving; add/discard are single container ops, never check-then-act
             for r in rows:
                 if r["type"] == TcpEventType.ESTABLISHED:
                     line.add_value(
@@ -437,7 +437,7 @@ class Aggregator:
         for r in events:
             pid = int(r["pid"])
             if r["type"] == ProcEventType.EXIT:
-                self.live_pids.discard(pid)
+                self.live_pids.discard(pid)  # alazlint: disable=ALZ051 -- idempotent element op: liveness set tolerates ingest/reap interleaving; add/discard are single container ops, never check-then-act
                 self.socket_lines.remove_pid(pid)
                 self.h2.remove_pid(pid)
                 with self._l7_lock:  # stmt caches belong to the L7 worker
@@ -450,7 +450,7 @@ class Aggregator:
                     # _apply_rate_limit inserted concurrently)
                     self._pid_buckets.pop(pid, None)
             elif r["type"] == ProcEventType.EXEC:
-                self.live_pids.add(pid)
+                self.live_pids.add(pid)  # alazlint: disable=ALZ051 -- idempotent element op: liveness set tolerates ingest/reap interleaving; add/discard are single container ops, never check-then-act
 
     # ------------------------------------------------------------------
     # K8s events
@@ -542,7 +542,8 @@ class Aggregator:
 
     @property
     def pending_retries(self) -> int:
-        return len(self._retries)
+        with self._l7_lock:  # stat probe races the L7 worker's requeues
+            return len(self._retries)
 
     def flush_retries(self, now_ns: int) -> np.ndarray | None:
         """Re-run due retry entries (the signal-and-requeue path). Safe to
@@ -673,7 +674,7 @@ class Aggregator:
             if attempts + 1 < RETRY_ATTEMPT_LIMIT:
                 rows = events[unmatched_idx]  # fancy index -> fresh copy
                 backoff = RETRY_INTERVAL_NS * (1 << attempts)  # 20ms, 40ms
-                self._retries.append((rows, attempts + 1, now_ns + backoff))
+                self._retries.append((rows, attempts + 1, now_ns + backoff))  # alazlint: disable=ALZ010 -- _l7_lock IS held: every _process_l7_inner caller (process_l7, flush_retries) wraps the call in the lock
                 self.stats.l7_requeued += rows.shape[0]
             else:
                 lost = int(unmatched_idx.shape[0])
@@ -742,7 +743,7 @@ class Aggregator:
             if attempts + 1 < RETRY_ATTEMPT_LIMIT:
                 rows = events[unmatched].copy()
                 backoff = RETRY_INTERVAL_NS * (1 << attempts)  # 20ms, 40ms
-                self._retries.append((rows, attempts + 1, now_ns + backoff))
+                self._retries.append((rows, attempts + 1, now_ns + backoff))  # alazlint: disable=ALZ010 -- _l7_lock IS held: every _process_l7_inner caller (process_l7, flush_retries) wraps the call in the lock
                 self.stats.l7_requeued += rows.shape[0]
             else:
                 lost = int(unmatched.sum())

@@ -470,7 +470,7 @@ class ShardedIngest:
         # heartbeat races the wave-waiter's, and whoever loses that race
         # must still re-drive (the original close died with the thread)
         self._worker_gen = [0] * self.n  # guarded-by: self._restart_lock
-        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge  # lockless-ok: written only under the merge lock's bare bounded acquire (invisible to with-based lockset models); the racy float read IS the last_wave_age_s freshness gauge
+        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge  # lockless-ok: written only under the merge lock's bare bounded acquire (invisible to with-based lockset models); the racy float read IS the last_wave_age_s freshness gauge. Re-audited under the v1.1 mutating-call walk: every site is a plain float store/read, never a container mutation, so the sanction holds
 
         self._stop = threading.Event()
         if autostart:
@@ -911,7 +911,7 @@ class ShardedIngest:
                 if self.on_batch is not None:
                     self.on_batch(batch)
                 else:
-                    self.batches.append(batch)
+                    self.batches.append(batch)  # alazlint: disable=ALZ051 -- _merge_lock IS held via the bounded acquire above (the lockset walk only models `with` blocks); main reads batches after stop()/join
                 # completes the span here when no scorer follows
                 # (complete_at_emit); the service's tracer keeps it open
                 self.tracer.emit(w * self.window_ms)

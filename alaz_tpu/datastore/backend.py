@@ -223,7 +223,7 @@ class BatchingBackend(BaseDataStore):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._warned_endpoints: set = set()
+        self._warned_endpoints: set = set()  # guarded-by: self._lock
         # flapping-backend protection (ISSUE 6): consecutive failed sends
         # open the circuit; sends shed fast until a cooldown probe heals
         self.breaker = CircuitBreaker(
@@ -424,8 +424,11 @@ class BatchingBackend(BaseDataStore):
                 # backend without this endpoint doesn't silently eat data.
                 # The backend ANSWERED — availability-wise that's a
                 # success, so the breaker doesn't count it.
-                if endpoint not in self._warned_endpoints:
-                    self._warned_endpoints.add(endpoint)
+                with self._lock:  # warn-once latch is check-then-act
+                    first_drop = endpoint not in self._warned_endpoints
+                    if first_drop:
+                        self._warned_endpoints.add(endpoint)
+                if first_drop:
                     log.warning(
                         f"dropping batch for {endpoint}: non-retryable HTTP {status}"
                     )

@@ -1023,7 +1023,7 @@ class WindowedGraphStore(BaseDataStore):
             if self.on_batch is not None:
                 self.on_batch(batch)
             else:
-                self.batches.append(batch)
+                self.batches.append(batch)  # alazlint: disable=ALZ050 -- every close path appends under _lock (ingest/flush callers); scenario replay's batches read is a single-threaded epilogue after flush() returns
             self.tracer.emit(ws_ms)
 
     def flush(self) -> None:

@@ -191,10 +191,17 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not build():
+    # ALZ_NATIVE_LIB points the whole binding at an alternate build of the
+    # same exports — the seam the alaznat fuzz harness (tools/alaznat) uses
+    # to run the ASan/UBSan shared objects through the exact ctypes paths
+    # production takes (the sanitizer runtime arrives via LD_PRELOAD in
+    # that subprocess). The alternate build passes the same _register
+    # layout checks as the default; no other behavior changes.
+    alt = os.environ.get("ALZ_NATIVE_LIB")
+    if not alt and not build():
         return None
     try:
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = ctypes.CDLL(alt if alt else str(_LIB_PATH))
         _register(lib)
     except (OSError, AttributeError):
         # unloadable or stale .so missing newer symbols (e.g. prebuilt lib

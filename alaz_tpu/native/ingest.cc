@@ -37,6 +37,19 @@
 #define ALZ_SOURCE_HASH "unstamped"
 #endif
 
+// Byte-scannable twin of alz_source_hash() for builds that cannot be
+// dlopen'd from the checking process: the ASan/UBSan shared objects
+// (loading them requires the sanitizer runtime preloaded), like
+// tsan_test/agent_example before them, carry the marker in .rodata so
+// check_binary_stamps can flag a stale sanitizer build without loading
+// it. Executable builds that link this file (tsan_test) define
+// ALZ_BIN_STAMP and emit their OWN marker covering every linked source;
+// suppress this one there so the byte scan finds exactly one stamp.
+#ifndef ALZ_BIN_STAMP
+__attribute__((used)) static const char kAlzSourceStamp[] =
+    "ALZ_SOURCE_STAMP:" ALZ_SOURCE_HASH;
+#endif
+
 extern "C" {
 
 // Mirror of events/schema.py L7Protocol (the reference's

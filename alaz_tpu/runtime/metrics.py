@@ -89,7 +89,7 @@ class Metrics:
         # exposition while their count is zero — the same discipline the
         # gauge-error path applies to NaN samples: a series that has
         # nothing to say is absent, never an empty/nan render
-        self._sparse: set = set()
+        self._sparse: set = set()  # guarded-by: self._lock
         self._lock = threading.Lock()
         self.started_at = time.time()
         # registered through the public surface so the golden registry

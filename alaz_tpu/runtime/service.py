@@ -255,7 +255,12 @@ class Service:
         # mis-tagged frames per second must cost a counter bump, not an
         # unbounded log flood. Bounded: wire ids fit a byte; API callers
         # past the cap stay silent (the counter carries the signal).
-        self._warned_tenants: set = set()  # lockless-ok: best-effort warn-once latch; a duplicate warning under a racy add is cosmetic
+        # the check-then-act (membership probe + cap + add) is locked:
+        # alazrace's v1.1 lockset walk counts the `.add(...)` as a
+        # structural write, and the old lockless-ok sanction cannot
+        # bless an unlocked container mutation (ALZ053)
+        self._warn_lock = threading.Lock()
+        self._warned_tenants: set = set()  # guarded-by: self._warn_lock
         # spans complete at emit when no scorer runs behind the store;
         # with a model they stay open through stage/score/export
         self.tracer = SpanTracer(
@@ -593,8 +598,14 @@ class Service:
         # submits — row-less k8s refusals included); lost ROWS ride the
         # refused ledger, so the two series never mix units
         self.metrics.counter("ingest.unknown_tenant").inc()
-        if tenant not in self._warned_tenants and len(self._warned_tenants) < 300:
-            self._warned_tenants.add(tenant)
+        with self._warn_lock:  # warn-once latch is check-then-act
+            first_refusal = (
+                tenant not in self._warned_tenants
+                and len(self._warned_tenants) < 300
+            )
+            if first_refusal:
+                self._warned_tenants.add(tenant)
+        if first_refusal:
             log.warning(
                 f"refused frame for unknown tenant {tenant} "
                 f"(service runs {self.tenants}); further refusals for this "

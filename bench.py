@@ -874,6 +874,22 @@ def bench_ingest(args) -> dict:
     except Exception:  # repo layout unavailable (installed wheel): skip
         race_findings, race_runtime_s = -1, -1.0
 
+    # and the native-layer contract (ISSUE 18): the alaznat static pass
+    # over alaz_tpu/native/*.cc (offset/magic provenance, GIL
+    # discipline, golden offset-map drift) must report 0, or the
+    # measured pipeline runs native code whose byte math nothing pins.
+    # The sanitizer fuzz half runs in `make sanitize-native`, not here —
+    # same cost split as flow/race (static rides along, dynamic gates).
+    try:
+        from tools.alaznat.driver import (
+            DEFAULT_PATHS as NAT_PATHS,
+            nat_paths,
+        )
+
+        nat_findings = len(nat_paths(list(NAT_PATHS), tree_mode=True))
+    except Exception:  # repo layout unavailable (installed wheel): skip
+        nat_findings = -1
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -889,6 +905,7 @@ def bench_ingest(args) -> dict:
         "flow_findings": flow_findings,
         "race_findings": race_findings,
         "race_runtime_s": race_runtime_s,
+        "nat_findings": nat_findings,
         "stage_latency": stage_latency,
         "trace_overhead_pct": round(trace_overhead_pct, 2),
         # score-plane cost + clean-trace drift silence (ISSUE 13): the

@@ -1,0 +1,335 @@
+"""alaznat (ISSUE 18): the sixth tier-1 head — native offset/GIL lint,
+golden offset-map fixpoint, the C++ disable-comment contract, the
+sanitizer-build stamp extensions, and the fuzz corpus replayed
+sanitizer-free as regression fixtures (the same adversarial batches
+`make sanitize-native` drives under ASan/UBSan gate every plain
+`make test` here, against the regular build)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tools.alazlint.core import Finding
+from tools.alaznat import fuzz, natgolden, natrules
+from tools.alaznat.driver import DEFAULT_PATHS, nat_paths
+from tools.alaznat.natmodel import (
+    filter_native_disables,
+    parse_native_source,
+    strip_comments,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = json.loads((REPO / "tests" / "nat_fixtures" / "corpus.json").read_text())
+
+
+def _native_available() -> bool:
+    from alaz_tpu.graph import native
+
+    return native.available()
+
+
+needs_native = pytest.mark.skipif(
+    not _native_available(), reason="libalaz_ingest.so not buildable"
+)
+
+
+def _parse(tmp_path: Path, source: str, name: str = "x.cc"):
+    p = tmp_path / name
+    p.write_text(source)
+    return parse_native_source(p)
+
+
+class TestParser:
+    def test_packed_struct_with_arrays(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "#pragma pack(push, 1)\n"
+            "struct Ev {\n"
+            "  uint32_t pid;\n"
+            "  uint64_t fd;\n"
+            "  uint8_t payload[16];\n"
+            "};\n"
+            "#pragma pack(pop)\n",
+        )
+        assert ns.structs["Ev"].layout_string() == (
+            "Ev:28;pid:0:4;fd:4:8;payload:12:16"
+        )
+
+    def test_natural_alignment_outside_pack(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "struct S {\n  uint8_t a;\n  uint64_t b;\n  uint32_t c;\n};\n",
+        )
+        # SysV: b aligns to 8, tail pads the total to 8
+        assert ns.structs["S"].layout_string() == "S:24;a:0:1;b:8:8;c:16:4"
+
+    def test_opaque_struct_is_not_guessed(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "struct H {\n  std::vector<int> v;\n  uint32_t n;\n};\n",
+        )
+        assert "H" in ns.opaque_structs and "H" not in ns.structs
+
+    def test_enum_constexpr_static_assert(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "enum P { A = 0, B, C = 7, D };\n"
+            "constexpr uint32_t kCap = 1 << 9;\n"
+            "struct S { uint32_t a; };\n"
+            "static_assert(sizeof(S) == 4, \"\");\n",
+        )
+        assert ns.enums["P"] == {"A": 0, "B": 1, "C": 7, "D": 8}
+        assert ns.constexprs["kCap"] == 512
+        assert ("S", 4) in ns.size_asserts
+
+    def test_literal_scan_skips_comments_strings_preprocessor(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "#define MAGIC 7777\n"
+            "// offset 8888 in a comment\n"
+            'const char *s = "9999";\n'
+            "int x = 6666;\n",
+        )
+        assert [l.value for l in ns.literals] == [6666]
+        assert "8888" not in strip_comments(ns.source)
+
+
+class TestStaticRules:
+    def test_underivable_magic_flagged(self, tmp_path):
+        ns = _parse(tmp_path, "int off = 7777;\n")
+        found = natrules.check_alz060_literals(ns, natgolden.PINNED_CONSTANTS)
+        assert [f.code for f in found] == ["ALZ060"]
+
+    def test_constexpr_and_small_and_pow2_exempt(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "constexpr uint32_t kStride = 331;\n"
+            "int a = 331;\n"   # derivable: own constexpr
+            "int b = 63;\n"    # small furniture
+            "int c = 4096;\n"  # power of two
+            "int d = 4095;\n",  # all-ones mask
+        )
+        assert natrules.check_alz060_literals(
+            ns, natgolden.PINNED_CONSTANTS
+        ) == []
+
+    def test_wire_table_numbers_are_derivable(self, tmp_path):
+        # 331 = sizeof(AlzL7Event), pinned in wire_layouts.json — a
+        # library file may do byte math with it without a local pin
+        ns = _parse(tmp_path, "int sz = 331;\n")
+        assert natrules.check_alz060_literals(
+            ns, natgolden.PINNED_CONSTANTS
+        ) == []
+
+    def test_struct_drift_against_wire_table(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "struct AlzRecord {\n"
+            "  int64_t start_time_ms;\n"
+            "  uint32_t from_uid;\n"
+            "};\n",
+        )
+        found = natrules.check_alz060_struct_drift(ns)
+        assert any(
+            f.code == "ALZ060" and "drifted" in f.message for f in found
+        )
+
+    def test_static_assert_mismatch_flagged(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "struct S { uint32_t a; };\n"
+            "static_assert(sizeof(S) == 8, \"\");\n",
+        )
+        found = natrules.check_alz060_struct_drift(ns)
+        assert any("static_assert" in f.message for f in found)
+
+    def test_alz061_py_api_and_include(self, tmp_path):
+        ns = _parse(
+            tmp_path,
+            "#include <Python.h>\n"
+            "void f() { PyGILState_Ensure(); }\n",
+        )
+        found = natrules.check_alz061(ns)
+        assert [f.code for f in found] == ["ALZ061", "ALZ061"]
+        assert found[0].line == 1 and found[1].line == 2
+
+    def test_disable_comment_with_why_suppresses(self, tmp_path):
+        src = (
+            "int off = 7777;  "
+            "// alazlint: disable=ALZ060 -- fixture constant\n"
+        )
+        ns = _parse(tmp_path, src)
+        raw = natrules.check_alz060_literals(ns, natgolden.PINNED_CONSTANTS)
+        assert raw and filter_native_disables(raw, {ns.path: ns}) == []
+
+    def test_bare_disable_surfaces_alz000(self, tmp_path):
+        ns = _parse(tmp_path, "int off = 7777;  // alazlint: disable=ALZ060\n")
+        raw = natrules.check_alz060_literals(ns, natgolden.PINNED_CONSTANTS)
+        out = filter_native_disables(raw, {ns.path: ns})
+        assert [f.code for f in out] == ["ALZ000"]
+
+
+class TestTreeAndGolden:
+    def test_native_tree_is_nat_clean(self):
+        findings = nat_paths(list(DEFAULT_PATHS), tree_mode=True)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_offset_map_golden_fixpoint(self):
+        live = natgolden.render(
+            natgolden.compute_offset_map(natgolden.parse_sources())
+        )
+        assert live == natgolden.OFFSETS_GOLDEN.read_text(), (
+            "nat_offsets.json is not a regen fixpoint — run "
+            "`python -m tools.alaznat --write-offsets`"
+        )
+
+    def test_pinned_constants_verify_live(self):
+        assert natgolden.verify_pinned_constants() == []
+
+    def test_golden_pins_all_exports_gil_dropped(self):
+        from alaz_tpu.graph import native as gn
+
+        golden = json.loads(natgolden.OFFSETS_GOLDEN.read_text())
+        assert set(golden["gil_contract"]["exports"]) == set(
+            gn.NATIVE_EXPORTS
+        )
+        assert set(golden["sanitizer_builds"]) == {
+            "libalaz_ingest.asan.so",
+            "libalaz_ingest.ubsan.so",
+        }
+
+    def test_missing_golden_is_a_finding(self, tmp_path):
+        found = natgolden.check_alz062(golden_path=tmp_path / "nope.json")
+        assert [f.code for f in found] == ["ALZ062"]
+
+
+class TestSanitizerStamps:
+    """alazspec extensions (satellite 1): the sanitizer .so flavors join
+    the byte-scanned stamp matrix; strays and unstamped builds are
+    findings."""
+
+    def _dir(self, tmp_path, stamp: str | None):
+        from tools.alazspec.abirules import binary_source_hash
+
+        (tmp_path / "ingest.cc").write_text("int x;\n")
+        want = binary_source_hash([tmp_path / "ingest.cc"])
+        blob = b"\x7fELFjunk"
+        if stamp == "good":
+            blob += b"ALZ_SOURCE_STAMP:" + want.encode()
+        elif stamp == "stale":
+            blob += b"ALZ_SOURCE_STAMP:" + b"0" * 16
+        (tmp_path / "libalaz_ingest.asan.so").write_bytes(blob)
+        return tmp_path
+
+    def _check(self, d):
+        from tools.alazspec.abirules import check_binary_stamps
+
+        return check_binary_stamps(
+            native_dir=d,
+            binaries={"libalaz_ingest.asan.so": ("ingest.cc",)},
+        )
+
+    def test_stamped_sanitizer_build_is_clean(self, tmp_path):
+        assert self._check(self._dir(tmp_path, "good")) == []
+
+    def test_unstamped_sanitizer_build_is_a_finding(self, tmp_path):
+        found = self._check(self._dir(tmp_path, None))
+        assert [f.code for f in found] == ["ALZ020"]
+        assert "no source stamp" in found[0].message
+
+    def test_stale_sanitizer_build_names_rebuild_target(self, tmp_path):
+        found = self._check(self._dir(tmp_path, "stale"))
+        assert [f.code for f in found] == ["ALZ020"]
+        assert "make asan" in found[0].message
+
+    def test_stray_so_variant_is_a_finding(self, tmp_path):
+        d = self._dir(tmp_path, "good")
+        (d / "libalaz_ingest.weird.so").write_bytes(b"\x7fELF")
+        found = self._check(d)
+        assert [f.code for f in found] == ["ALZ020"]
+        assert "stray" in found[0].message
+
+    def test_real_tree_stamps_are_current(self):
+        from tools.alazspec.abirules import check_binary_stamps
+
+        findings = check_binary_stamps()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+class TestCatalog:
+    def test_alz06x_registered_append_only(self):
+        from tools.alazlint.rules import RULES
+
+        for code in ("ALZ060", "ALZ061", "ALZ062", "ALZ063"):
+            assert code in RULES, f"{code} missing from the catalog"
+
+
+class TestCorpusShape:
+    def test_names_unique_and_exports_covered(self):
+        names = [c["name"] for c in CORPUS["cases"]]
+        assert len(names) == len(set(names))
+        assert {c["export"] for c in CORPUS["cases"]} == set(fuzz._RUNNERS)
+
+    def test_every_case_generates(self):
+        """Generators are pure and total over the corpus even without a
+        native build — the fixture set fails fast on a malformed spec."""
+        gens = {
+            "group_edges": fuzz.gen_group,
+            "degree_cap": fuzz.gen_degree,
+            "close_window": fuzz.gen_close,
+            "process_l7": fuzz.gen_l7,
+        }
+        for case in CORPUS["cases"]:
+            out = gens[case["export"]](case.get("gen", {}))
+            assert out is not None
+
+    def test_group_columns_stay_float64_exact(self):
+        """The parity oracle demands EXACT sums, which holds only while
+        every case's total stays under 2^53 — pin the invariant the
+        ge_many_cols corpus bug taught us."""
+        for case in CORPUS["cases"]:
+            if case["export"] != "group_edges":
+                continue
+            spec = case.get("gen", {})
+            total = int(spec.get("n", 0)) * int(spec.get("val_scale", 1000))
+            assert total < 2**53, case["name"]
+
+
+@needs_native
+class TestCorpusReplay:
+    """Every fuzz corpus case, sanitizer-free, against the regular
+    build: the adversarial seeds are permanent regression fixtures."""
+
+    @pytest.mark.parametrize(
+        "case", CORPUS["cases"], ids=[c["name"] for c in CORPUS["cases"]]
+    )
+    def test_case_parity(self, case):
+        problems = fuzz.run_case(case)
+        assert problems == [], f"{case['name']}: {problems}"
+
+
+class TestDriverCli:
+    def test_json_mode_and_exit_codes(self, capsys, tmp_path):
+        from tools.alaznat.driver import main
+
+        rc = main([str(REPO / "alaz_tpu" / "native"), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["count"] == 0
+        bad = tmp_path / "bad.cc"
+        bad.write_text("void f() { PyErr_Clear(); }\n")
+        rc = main([str(bad), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["count"] == 1
+        assert out["findings"][0]["code"] == "ALZ061"
+
+    def test_findings_render_like_the_other_heads(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("int off = 7777;\n")
+        found = nat_paths([str(bad)])
+        assert len(found) == 1
+        assert isinstance(found[0], Finding)
+        assert found[0].line == 1 and found[0].code == "ALZ060"

@@ -1,6 +1,7 @@
-"""alazrace: the thread-escape + lockset race gate (ISSUE 12).
+"""alazrace: the thread-escape + lockset race gate (ISSUE 12; mutating
+method-call writes — the v1.1 precision-bound closure — ISSUE 18).
 
-Five halves:
+Six halves:
 
 1. Fixture corpus — ALZ050-053 proven by flagged fixtures
    (``# alz-expect`` markers, asserted by code AND line) and clean
@@ -28,6 +29,12 @@ Five halves:
    under concurrent pump), the breaker-shed → ledger `shed` attribution
    (ISSUE 12 satellite), `_IpTable.contains` racing the k8s fold's
    rehash, and the engine's `_pid_buckets` cross-thread dict mutation.
+
+6. Mutating-call writes — ``self.d.update(...)`` / ``.append(...)`` on
+   a container field count as compound writes (flagged unlocked,
+   clean when guarded, rejected under ``# lockless-ok``); the
+   value-kind and project-method guards keep Event/Queue primitives
+   and same-named project methods out of the write set.
 """
 
 from __future__ import annotations
@@ -162,6 +169,127 @@ class TestFixtureCorpus:
         got = {(f.line, f.code) for f in race_source("t.py", src)}
         # only the main-side write remains flagged
         assert got == {(29, "ALZ050")}
+
+
+class TestMutatingCallWrites:
+    """The v1.1 precision-bound closure (ISSUE 18 satellite, the
+    ROADMAP carried item): mutating METHOD calls (``self.d.update(...)``,
+    ``self.q.append(...)``) count as compound writes in the lockset
+    walk — resize/rehash is multi-op under the hood, same as
+    ``d[k] = v``. Two precision guards keep it honest: the receiver
+    must be a declared CONTAINER field (threading.Event/Queue share
+    mutator names like ``clear`` but synchronize internally), and a
+    call resolving to a project method stays a call edge."""
+
+    def test_unlocked_update_on_container_field_is_alz051(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.d = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker_loop).start()\n"
+            "    def _worker_loop(self):\n"
+            "        self.d.update({'k': 1})\n"
+            "def main():\n"
+            "    c = C()\n"
+            "    c.start()\n"
+            "    x = c.d\n"
+            "    return x\n"
+        )
+        got = {(f.line, f.code) for f in race_source("t.py", src)}
+        assert got == {(8, "ALZ051")}
+
+    def test_event_clear_is_not_a_container_write(self):
+        # threading.Event shares mutator names (`clear`) but is a
+        # thread-safe primitive — the container value-kind guard must
+        # keep it out of the write set
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker_loop).start()\n"
+            "    def _worker_loop(self):\n"
+            "        self._stop.clear()\n"
+            "def main():\n"
+            "    c = C()\n"
+            "    c.start()\n"
+            "    c._stop.set()\n"
+        )
+        findings = race_source("t.py", src)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_project_method_update_stays_a_call_edge(self):
+        # a project class whose method happens to be NAMED like a
+        # mutator: the call resolves through the call graph, it is not
+        # a container write on the `reg` field
+        src = (
+            "import threading\n"
+            "class Registry:\n"
+            "    def update(self):\n"
+            "        pass\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.reg = Registry()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker_loop).start()\n"
+            "    def _worker_loop(self):\n"
+            "        self.reg.update()\n"
+            "def main():\n"
+            "    c = C()\n"
+            "    c.start()\n"
+            "    x = c.reg\n"
+            "    return x\n"
+        )
+        findings = race_source("t.py", src)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_locked_method_mutation_with_guard_is_clean(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.d = {}  # guarded-by: self._lock\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker_loop).start()\n"
+            "    def _worker_loop(self):\n"
+            "        with self._lock:\n"
+            "            self.d.update({'k': 1})\n"
+            "def main():\n"
+            "    c = C()\n"
+            "    c.start()\n"
+            "    with c._lock:\n"
+            "        x = c.d\n"
+        )
+        findings = race_source("t.py", src)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_lockless_ok_cannot_bless_method_mutation(self):
+        # the closure that makes the bound matter: before v1.1 an
+        # unlocked `.append` was invisible, so a `# lockless-ok` on the
+        # container passed the ALZ053 audit vacuously. Now the append
+        # IS a structural write and the sanction is rejected at the
+        # declaration.
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.items = []  # lockless-ok: single writer by design\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker_loop).start()\n"
+            "    def _worker_loop(self):\n"
+            "        self.items.append(1)\n"
+            "def main():\n"
+            "    c = C()\n"
+            "    c.start()\n"
+            "    x = c.items\n"
+            "    return x\n"
+        )
+        got = {(f.line, f.code) for f in race_source("t.py", src)}
+        assert got == {(4, "ALZ053")}
 
 
 _MOD_A = (

@@ -266,6 +266,7 @@ class TestSelfEnforcement:
                 str(REPO / "tools" / "alazspec"),
                 str(REPO / "tools" / "alazflow"),
                 str(REPO / "tools" / "alazrace"),
+                str(REPO / "tools" / "alaznat"),
             ]
         )
         assert findings == [], "\n".join(f.render() for f in findings)

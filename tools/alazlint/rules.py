@@ -207,6 +207,38 @@ _ALL = [
         "(resources/specs/threads.json; --write-threads regenerates)",
         lambda ctx: (),  # emitted by tools.alazrace.goldenmap
     ),
+    # -- alaznat family (tools/alaznat): native-layer safety — the sixth
+    # head. The static half lints alaz_tpu/native/*.cc (offset/magic
+    # provenance, GIL discipline, golden offset map); the dynamic half
+    # replays the fuzz corpus under ASan/UBSan builds (`make
+    # sanitize-native`). C++ sources carry the same disable comment as
+    # Python (`// alazlint: disable=CODE -- why`); registered here so
+    # codes stay append-only and the catalog stays whole.
+    Rule(
+        "ALZ060",
+        "native magic number not derivable from a pinned layout, a "
+        "struct drifted from its wire-table layout, or a pinned "
+        "constant drifted from its Python provenance",
+        lambda ctx: (),  # emitted by tools.alaznat.natrules/natgolden
+    ),
+    Rule(
+        "ALZ061",
+        "CPython API reachable in GIL-dropped native code (ctypes "
+        "releases the GIL around every export)",
+        lambda ctx: (),  # emitted by tools.alaznat.natrules
+    ),
+    Rule(
+        "ALZ062",
+        "native offset map drifted from the golden "
+        "(resources/specs/nat_offsets.json; --write-offsets regenerates)",
+        lambda ctx: (),  # emitted by tools.alaznat.natgolden
+    ),
+    Rule(
+        "ALZ063",
+        "sanitizer fuzz finding: ASan/UBSan report or native-vs-python "
+        "parity divergence on a corpus case (make sanitize-native)",
+        lambda ctx: (),  # emitted by tools.alaznat.fuzz
+    ),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

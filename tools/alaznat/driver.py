@@ -1,0 +1,126 @@
+"""alaznat driver: parse native sources → offset/GIL rules → golden
+cross-checks → C++ disable filter → report. Mirrors the alazrace driver
+contract (same Finding type, same exit codes, `--write-offsets` like
+`--write-threads`) so `make nat` and tier-1 read one uniform finding
+stream — plus the dynamic half: `--sanitize` builds the ASan/UBSan
+shared objects and drives the fuzz corpus through them, `--fuzz-run` is
+the in-process worker those sanitized subprocesses execute.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from tools.alazlint.core import Finding
+from tools.alaznat import natgolden, natrules
+from tools.alaznat.natmodel import (
+    NatSource,
+    filter_native_disables,
+    parse_native_source,
+)
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# what `make nat` / bench's nat_findings sweep: the native layer. The
+# analyzer itself is Python and is covered by the five AST heads
+# (tools/alaznat sits in `make lint`'s path list like its siblings).
+DEFAULT_PATHS = (str(natgolden.NATIVE_DIR),)
+
+
+def _collect(paths: Sequence[str]) -> Dict[Path, NatSource]:
+    sources: Dict[Path, NatSource] = {}
+    for p in paths:
+        pth = Path(p)
+        if pth.is_dir():
+            for cc in sorted(pth.glob("*.cc")):
+                sources[cc] = parse_native_source(cc)
+        elif pth.suffix == ".cc" and pth.exists():
+            sources[pth] = parse_native_source(pth)
+    return sources
+
+
+def _run_rules(
+    sources: Dict[Path, NatSource], tree_mode: bool
+) -> List[Finding]:
+    """The static passes. ``tree_mode`` arms the golden checks (ALZ062
+    drift + pinned-constant provenance) which are statements about the
+    whole native tree — single-file/fixture runs get the local rules
+    only, so scanning a fixture doesn't re-litigate the tree golden."""
+    raw: List[Finding] = []
+    for p, ns in sorted(sources.items()):
+        role = natgolden.FILE_ROLES.get(p.name, "library")
+        if role == "library":
+            raw.extend(
+                natrules.check_alz060_literals(
+                    ns, natgolden.PINNED_CONSTANTS
+                )
+            )
+        raw.extend(natrules.check_alz060_struct_drift(ns))
+        raw.extend(natrules.check_alz061(ns))
+    if tree_mode:
+        raw.extend(natgolden.verify_pinned_constants())
+        raw.extend(natgolden.check_alz062(sources))
+    return filter_native_disables(raw, sources)
+
+
+def nat_paths(
+    paths: Sequence[str], tree_mode: bool = False
+) -> List[Finding]:
+    findings = _run_rules(_collect(paths), tree_mode)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def _print_findings(findings: List[Finding], as_json: bool, label: str) -> None:
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"{label}: {len(findings)} finding(s)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--write-offsets" in argv:
+        path = natgolden.write_offsets_golden()
+        print(f"wrote {path}")
+        return 0
+    if "--fuzz-run" in argv:
+        # worker mode: the whole corpus, in-process, against whatever
+        # library ALZ_NATIVE_LIB points at (the sanitized .so when run
+        # under --sanitize; the regular build when invoked by hand)
+        from tools.alaznat import fuzz
+
+        report = fuzz.run_fuzz()
+        print(json.dumps(report, indent=2))
+        return 1 if report["problems"] else 0
+    if "--sanitize" in argv:
+        from tools.alaznat import fuzz
+
+        findings, skipped = fuzz.sanitize()
+        if skipped is not None:
+            print(f"alaznat: sanitize skipped — {skipped}", file=sys.stderr)
+            return 0
+        _print_findings(findings, as_json, "alaznat --sanitize")
+        return 1 if findings else 0
+    # the golden checks are statements about the WHOLE native tree —
+    # they run on the default invocation (`make nat`); explicit paths
+    # get the local rules only (the alazrace precedent)
+    paths = argv or list(DEFAULT_PATHS)
+    findings = nat_paths(paths, tree_mode=not argv)
+    _print_findings(findings, as_json, "alaznat")
+    return 1 if findings else 0

@@ -1,0 +1,593 @@
+"""The dynamic half of alaznat: a structured fuzz corpus driven through
+all four native exports with the Python engine as bit-parity oracle,
+under real ASan/UBSan builds of the ingest core.
+
+Execution model: sanitized shared objects cannot be dlopen'd into a
+stock interpreter (the sanitizer runtime must be the first DSO), so
+``sanitize()`` builds ``libalaz_ingest.{asan,ubsan}.so`` and spawns one
+subprocess per sanitizer with ``LD_PRELOAD=<runtime>`` and
+``ALZ_NATIVE_LIB=<instrumented .so>`` — the seam graph/native._load()
+honors — running ``python -m tools.alaznat --fuzz-run``, which replays
+the whole corpus in-process. A sanitizer report aborts the subprocess
+(abort_on_error / -fno-sanitize-recover), a parity divergence surfaces
+as a problem line in the worker's JSON; either becomes an ALZ063
+finding. The corpus itself lives in ``tests/nat_fixtures/corpus.json``
+and replays sanitizer-free as tier-1 regression fixtures
+(tests/test_alaznat.py), so every adversarial shape that ever drove the
+sanitizers also gates every plain `make test` forever.
+
+Corpus case shape::
+
+    {"name": "...", "export": "group_edges" | "degree_cap" |
+     "close_window" | "process_l7", "gen": {...}, "expect": "parity"}
+
+``expect: "refused"`` marks inputs the native side must *decline* (return
+the fall-back sentinel) rather than answer — e.g. ``cap == 0`` degree
+sampling, where the C++ export returns -1 and the binding hands the
+caller back to numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tools.alazlint.core import Finding
+
+REPO = Path(__file__).resolve().parent.parent.parent
+NATIVE_DIR = REPO / "alaz_tpu" / "native"
+CORPUS_PATH = REPO / "tests" / "nat_fixtures" / "corpus.json"
+
+_SUBPROCESS_TIMEOUT_S = 900
+
+# sanitizer -> (instrumented lib, preloaded runtime, make target, env)
+SANITIZERS = {
+    "asan": (
+        "libalaz_ingest.asan.so",
+        "libasan.so",
+        "asan",
+        {"ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1"},
+    ),
+    "ubsan": (
+        "libalaz_ingest.ubsan.so",
+        "libubsan.so",
+        "ubsan",
+        {"UBSAN_OPTIONS": "halt_on_error=1,print_stacktrace=1"},
+    ),
+}
+
+
+def load_corpus(path: Path = CORPUS_PATH) -> List[dict]:
+    return json.loads(path.read_text())["cases"]
+
+
+# -- generators (pure functions of the case's gen spec) ----------------------
+
+
+def _rng(spec: dict):
+    return np.random.default_rng(int(spec.get("seed", 0)))
+
+
+def gen_group(spec: dict):
+    """(keys, sum_cols, max_cols) for alz_group_edges. Columns are
+    integer-valued float64 (< 2^53) so sums are order-independent and the
+    parity check can demand EXACT equality."""
+    rng = _rng(spec)
+    n = int(spec.get("n", 0))
+    mode = spec.get("keys", "random")
+    if mode == "single":
+        keys = np.full(n, 42, dtype=np.int64)
+    elif mode == "extreme":
+        keys = rng.integers(
+            -(2**63), 2**63 - 1, n, dtype=np.int64, endpoint=True
+        )
+        if n >= 2:
+            keys[0] = -(2**63)
+            keys[-1] = 2**63 - 1
+    else:
+        keys = rng.integers(
+            0, int(spec.get("key_space", 64)), n
+        ).astype(np.int64)
+    scale = int(spec.get("val_scale", 1000))
+    sum_cols = [
+        rng.integers(0, scale, n).astype(np.float64)
+        for _ in range(int(spec.get("n_sum", 2)))
+    ]
+    max_cols = [
+        rng.integers(0, scale, n).astype(np.float64)
+        for _ in range(int(spec.get("n_max", 1)))
+    ]
+    return keys, sum_cols, max_cols
+
+
+def gen_degree(spec: dict):
+    """(dst_sorted, prio, cap) for alz_sample_degree_cap. dst arrives
+    dst-sorted — the export's documented precondition (it runs over the
+    already-grouped edge list alz_group_edges emits)."""
+    rng = _rng(spec)
+    n = int(spec.get("n", 0))
+    mode = spec.get("dst", "random")
+    if mode == "hot":
+        dst = np.zeros(n, dtype=np.int32)
+    else:
+        dst = np.sort(
+            rng.integers(0, int(spec.get("n_dst", 8)), n)
+        ).astype(np.int32)
+    pmode = spec.get("prio", "random")
+    if pmode == "ties":
+        prio = np.full(n, 7, dtype=np.uint64)
+    elif pmode == "umax":
+        prio = np.full(n, 2**64 - 1, dtype=np.uint64)
+    else:
+        prio = rng.integers(0, 2**64 - 1, n, dtype=np.uint64, endpoint=True)
+    return dst, prio, int(spec.get("cap", 1))
+
+
+def gen_close(spec: dict):
+    """List of REQUEST_DTYPE parts for the windowed-store pair. Each
+    part spec: {n, window_ms, ...mutations} — window_ms ordering across
+    parts exercises window rolls and late stragglers."""
+    from alaz_tpu.datastore.dto import EP_POD, EP_SERVICE, make_requests
+
+    parts = []
+    for i, p in enumerate(spec.get("parts", [])):
+        rng = np.random.default_rng(int(spec.get("seed", 0)) * 1000 + i)
+        n = int(p.get("n", 0))
+        rows = make_requests(n)
+        rows["from_uid"] = rng.integers(1, int(p.get("n_src", 15)) + 1, n)
+        rows["to_uid"] = rng.integers(100, 100 + int(p.get("n_dst", 7)), n)
+        rows["from_type"], rows["to_type"] = EP_POD, EP_SERVICE
+        rows["protocol"] = rng.integers(1, 4, n)
+        rows["latency_ns"] = rng.integers(10, 1000, n)
+        rows["status_code"] = np.where(rng.random(n) < 0.1, 500, 200)
+        rows["completed"] = True
+        rows["start_time_ms"] = int(p.get("window_ms", 1000))
+        if p.get("dup_edges"):
+            rows["from_uid"] = 3
+            rows["to_uid"] = 104
+            rows["protocol"] = 1
+        if p.get("hostile"):
+            # extremes within contract: u32-max status, <2^53 latency
+            # (float64-exact accumulation), u8-max protocol. uids stay
+            # ≤ ~2^20: they are Interner-owned sequential ids, and the
+            # python oracle's slot map is DENSE over max(uid) — a 2^31
+            # uid would make the oracle allocate gigabytes, not expose
+            # a native bug (the native side hashes, and never sees
+            # non-interner uids in production)
+            rows["status_code"] = 2**32 - 1
+            rows["latency_ns"] = 2**52
+            rows["protocol"] = 255
+            rows["from_uid"] = 2**20 - 2
+            rows["to_uid"] = 2**20 - 1
+            rows["tls"] = True
+            rows["completed"] = False
+        parts.append(rows)
+    return parts
+
+
+def _v1ify(ev, frac: float, seed: int, orphan_frac: float = 0.0):
+    """Blank embedded addresses on ``frac`` of rows; return the TCP
+    events establishing the (pid, fd) socket lines that re-derive them
+    (mirrors tests/test_engine_backend._v1ify — the V1 join path)."""
+    from alaz_tpu.events.schema import TcpEventType, make_tcp_events
+
+    rng = np.random.default_rng(seed)
+    ev = ev.copy()
+    n = ev.shape[0]
+    v1 = rng.random(n) < frac
+    idx = np.flatnonzero(v1)
+    orphans = idx[rng.random(idx.shape[0]) < orphan_frac]
+    ev["pid"][orphans] = 999_999
+    keys = (ev["pid"][idx].astype(np.uint64) << np.uint64(32)) | ev["fd"][
+        idx
+    ].astype(np.uint64)
+    _, first = np.unique(keys, return_index=True)
+    first = first[ev["pid"][idx[first]] != 999_999]
+    tcp = make_tcp_events(first.shape[0])
+    tcp["pid"] = ev["pid"][idx[first]]
+    tcp["fd"] = ev["fd"][idx[first]]
+    tcp["timestamp_ns"] = 1
+    tcp["type"] = TcpEventType.ESTABLISHED
+    tcp["saddr"] = ev["saddr"][idx[first]]
+    tcp["sport"] = ev["sport"][idx[first]]
+    tcp["daddr"] = ev["daddr"][idx[first]]
+    tcp["dport"] = ev["dport"][idx[first]]
+    ev["saddr"][idx] = 0
+    ev["sport"][idx] = 0
+    ev["daddr"][idx] = 0
+    ev["dport"][idx] = 0
+    return ev, tcp
+
+
+def gen_l7(spec: dict):
+    """(ev, tcp, msgs, chunks) for the Aggregator A/B: a synth trace
+    with adversarial mutations layered on."""
+    from alaz_tpu.replay.synth import make_ingest_trace
+
+    seed = int(spec.get("seed", 0))
+    n = int(spec.get("n", 0))
+    ev, msgs = make_ingest_trace(
+        max(n, 32),
+        pods=int(spec.get("pods", 20)),
+        svcs=int(spec.get("svcs", 4)),
+        windows=int(spec.get("windows", 2)),
+        seed=seed,
+    )
+    ev = ev[:n]
+    if spec.get("dup_conn"):
+        ev["pid"] = 4242
+        ev["fd"] = 7
+    tcp = None
+    if float(spec.get("v1_frac", 0.0)) > 0:
+        ev, tcp = _v1ify(
+            ev,
+            frac=float(spec["v1_frac"]),
+            seed=seed,
+            orphan_frac=float(spec.get("orphan_frac", 0.0)),
+        )
+    if spec.get("truncated"):
+        # hostile payload accounting: the count field claims more bytes
+        # than the 256-byte payload buffer holds — the native pass must
+        # never trust payload_size as a read length
+        half = ev.shape[0] // 2
+        ev["payload_size"][:half] = 2**32 - 1
+        ev["payload_read_complete"][:half] = False
+        ev["payload_size"][half:] = 300
+        ev["payload_read_complete"][half:] = True
+    if spec.get("hostile"):
+        rng = np.random.default_rng(seed + 1)
+        m = ev.shape[0]
+        ev["status"] = rng.choice(
+            np.array([0, 99, 2**31, 2**32 - 1], dtype=np.uint64), m
+        )
+        ev["duration_ns"] = rng.choice(
+            np.array([0, 1, 2**52], dtype=np.uint64), m
+        )
+        ev["method"] = 255
+        ev["protocol"] = rng.choice(
+            np.array([0, 9, 200, 255], dtype=np.uint8), m
+        )
+        ev["kafka_api_version"] = -1
+        ev["mysql_prep_stmt_id"] = 2**32 - 1
+        ev["tid"] = 2**32 - 1
+        ev["seq"] = 2**32 - 1
+    return ev, tcp, msgs, [int(c) for c in spec.get("chunks", [])]
+
+
+# -- runners (native vs Python-oracle, exact comparisons) --------------------
+
+
+def _force_numpy_grouping():
+    from alaz_tpu.graph import builder
+
+    builder.set_native_grouping(False)
+
+
+def _reset_grouping():
+    from alaz_tpu.graph import builder
+
+    builder.set_native_grouping(None)
+
+
+def run_group(case: dict) -> List[str]:
+    from alaz_tpu.graph import builder, native
+
+    keys, sc, mc = gen_group(case.get("gen", {}))
+    got = native.group_edges(keys, sc, mc)
+    if got is None:
+        return ["native group_edges unavailable (library not loaded)"]
+    _force_numpy_grouping()
+    try:
+        want = builder.group_reduce(keys, sc, mc)
+    finally:
+        _reset_grouping()
+    problems: List[str] = []
+    gk, gc, gr, gs, gm = got
+    wk, wc, wr, ws, wm = want
+    if not np.array_equal(gk, wk):
+        problems.append("group keys diverge from numpy oracle")
+    if not np.array_equal(gc, wc):
+        problems.append("group counts diverge from numpy oracle")
+    # rep is any-member-valid by contract: check membership, not identity
+    if gk.shape == wk.shape and gk.shape[0] and not np.array_equal(
+        keys[gr], gk
+    ):
+        problems.append("group rep indices point outside their groups")
+    for i, (a, b) in enumerate(zip(gs, ws)):
+        if not np.array_equal(a, b):
+            problems.append(f"group sum col {i} diverges from numpy oracle")
+    for i, (a, b) in enumerate(zip(gm, wm)):
+        if not np.array_equal(a, b):
+            problems.append(f"group max col {i} diverges from numpy oracle")
+    return problems
+
+
+def run_degree(case: dict) -> List[str]:
+    from alaz_tpu.graph import builder, native
+
+    dst, prio, cap = gen_degree(case.get("gen", {}))
+    got = native.sample_degree_cap(dst, prio, cap)
+    if case.get("expect") == "refused":
+        return (
+            []
+            if got is None
+            else ["native sample_degree_cap answered an input it must refuse"]
+        )
+    if got is None:
+        return ["native sample_degree_cap unavailable (library not loaded)"]
+    _force_numpy_grouping()
+    try:
+        want = builder.degree_cap_select(dst, prio, cap)
+    finally:
+        _reset_grouping()
+    if not np.array_equal(got, want):
+        return [
+            f"degree-cap kept set diverges: native {got.shape[0]} rows "
+            f"vs numpy {want.shape[0]}"
+        ]
+    return []
+
+
+def _edge_map(b) -> Dict[tuple, np.ndarray]:
+    uids = b.node_uids
+    return {
+        (
+            int(uids[b.edge_src[i]]),
+            int(uids[b.edge_dst[i]]),
+            int(b.edge_type[i]),
+        ): b.edge_feats[i]
+        for i in range(b.n_edges)
+    }
+
+
+def run_close(case: dict) -> List[str]:
+    from alaz_tpu.events.intern import Interner
+    from alaz_tpu.graph import native
+    from alaz_tpu.graph.builder import WindowedGraphStore
+
+    spec = case.get("gen", {})
+    parts = gen_close(spec)
+    kwargs = {}
+    if "degree_cap" in spec:
+        kwargs = {
+            "degree_cap": int(spec["degree_cap"]),
+            "sample_seed": int(spec.get("sample_seed", 11)),
+        }
+    try:
+        ns = native.NativeWindowedStore(window_s=1.0, **kwargs)
+    except RuntimeError:
+        return ["native windowed store unavailable (library not loaded)"]
+    try:
+        for p in parts:
+            ns.persist_requests(p.copy())
+        ns.flush()
+    finally:
+        ns.close()
+    ps = WindowedGraphStore(Interner(), window_s=1.0, **kwargs)
+    _force_numpy_grouping()
+    try:
+        for p in parts:
+            ps.persist_requests(p.copy())
+        ps.flush()
+    finally:
+        _reset_grouping()
+    problems: List[str] = []
+    nw = [b.window_start_ms for b in ns.batches]
+    pw = [b.window_start_ms for b in ps.batches]
+    if nw != pw:
+        return [f"window sequence diverges: native {nw} vs numpy {pw}"]
+    for nb, pb in zip(ns.batches, ps.batches):
+        m1, m2 = _edge_map(nb), _edge_map(pb)
+        if set(m1) != set(m2):
+            problems.append(
+                f"window {nb.window_start_ms}: edge key sets diverge "
+                f"({len(m1)} native vs {len(m2)} numpy)"
+            )
+            continue
+        for k in m1:
+            if not np.allclose(m1[k], m2[k], atol=1e-6):
+                problems.append(
+                    f"window {nb.window_start_ms}: edge {k} features diverge"
+                )
+                break
+    return problems
+
+
+def _serial_rows(ev, tcp, msgs, native_engine: bool, chunks, rate_limit=None):
+    """One serial Aggregator run (mirrors tests/test_engine_backend.
+    _run_serial_rows): returns (REQUEST rows incl. retry flushes, stats
+    dict, ledger snapshot)."""
+    from alaz_tpu.aggregator.cluster import ClusterInfo
+    from alaz_tpu.aggregator.engine import Aggregator, set_native_engine
+    from alaz_tpu.datastore.inmem import InMemDataStore
+    from alaz_tpu.events.intern import Interner
+
+    set_native_engine(native_engine)
+    try:
+        interner = Interner()
+        ds = InMemDataStore(retain=True)
+        cluster = ClusterInfo(interner)
+        for m in msgs:
+            cluster.handle_msg(m)
+        agg = Aggregator(ds, interner=interner, cluster=cluster)
+        if rate_limit is not None:
+            agg.rate_limit = rate_limit
+        if tcp is not None and tcp.shape[0]:
+            agg.process_tcp(tcp, now_ns=10_000_000_000)
+        outs = []
+        lo = 0
+        for hi in list(chunks) + [ev.shape[0]]:
+            if hi > lo:
+                outs.append(agg.process_l7(ev[lo:hi], now_ns=10_000_000_000))
+                lo = hi
+        for dt in (25_000_000, 75_000_000, 200_000_000):
+            r = agg.flush_retries(10_000_000_000 + dt)
+            if r is not None:
+                outs.append(r)
+        rows = (
+            np.concatenate(outs)
+            if outs
+            else np.zeros(0, ds.all_requests().dtype)
+        )
+        return rows, agg.stats.as_dict(), agg.ledger.snapshot()
+    finally:
+        set_native_engine(None)
+
+
+def run_l7(case: dict) -> List[str]:
+    from alaz_tpu.aggregator import native_l7
+
+    if not native_l7.available():
+        return ["native L7 engine unavailable (library not loaded)"]
+    ev, tcp, msgs, chunks = gen_l7(case.get("gen", {}))
+    p_rows, p_stats, p_led = _serial_rows(ev, tcp, msgs, False, chunks)
+    n_rows, n_stats, n_led = _serial_rows(ev, tcp, msgs, True, chunks)
+    problems: List[str] = []
+    if not np.array_equal(p_rows, n_rows):
+        problems.append(
+            f"REQUEST rows diverge: python {p_rows.shape[0]} "
+            f"vs native {n_rows.shape[0]} rows (or payload bytes differ)"
+        )
+    if p_stats != n_stats:
+        keys = [k for k in p_stats if p_stats[k] != n_stats.get(k)]
+        problems.append(f"stats diverge on {keys}")
+    if p_led != n_led:
+        problems.append("drop-ledger snapshots diverge")
+    return problems
+
+
+_RUNNERS = {
+    "group_edges": run_group,
+    "degree_cap": run_degree,
+    "close_window": run_close,
+    "process_l7": run_l7,
+}
+
+
+def run_case(case: dict) -> List[str]:
+    return _RUNNERS[case["export"]](case)
+
+
+def run_fuzz(corpus_path: Path = CORPUS_PATH) -> dict:
+    """The whole corpus, in-process, against whatever library the
+    binding resolves (ALZ_NATIVE_LIB under --sanitize). Returns
+    {"cases": n, "problems": [{"case", "export", "problem"}, ...]}."""
+    cases = load_corpus(corpus_path)
+    problems: List[dict] = []
+    for case in cases:
+        for p in run_case(case):
+            problems.append(
+                {"case": case["name"], "export": case["export"], "problem": p}
+            )
+    return {"cases": len(cases), "problems": problems}
+
+
+# -- sanitizer orchestration -------------------------------------------------
+
+
+def _runtime_path(runtime: str) -> Optional[str]:
+    gcc = shutil.which("gcc") or shutil.which("cc")
+    if gcc is None:
+        return None
+    try:
+        out = subprocess.run(
+            [gcc, f"-print-file-name={runtime}"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    # gcc echoes the bare name back when it has no such runtime
+    return out if out and os.path.sep in out and Path(out).exists() else None
+
+
+def toolchain_gap() -> Optional[str]:
+    """Why sanitize() cannot run here, or None when it can. No-install
+    discipline: a missing compiler/runtime is a graceful skip, never an
+    attempted install."""
+    if shutil.which("g++") is None and shutil.which("c++") is None:
+        return "no C++ compiler on PATH"
+    for _, (_, runtime, _, _) in SANITIZERS.items():
+        if _runtime_path(runtime) is None:
+            return f"gcc has no {runtime} runtime"
+    return None
+
+
+def _finding(msg: str) -> Finding:
+    return Finding("ALZ063", msg, str(NATIVE_DIR / "ingest.cc"), 1, 0)
+
+
+def sanitize() -> Tuple[List[Finding], Optional[str]]:
+    """Build the ASan/UBSan libraries and replay the corpus under each.
+    Returns (findings, skip_reason): skip_reason is non-None only when
+    the toolchain cannot run sanitizers at all (then findings is [])."""
+    gap = toolchain_gap()
+    if gap is not None:
+        return [], gap
+    build = subprocess.run(
+        ["make", "-C", str(NATIVE_DIR), "asan", "ubsan"],
+        capture_output=True,
+        text=True,
+        timeout=_SUBPROCESS_TIMEOUT_S,
+    )
+    if build.returncode != 0:
+        return [
+            _finding(
+                "sanitizer build failed (make -C alaz_tpu/native asan "
+                f"ubsan):\n{build.stdout[-1500:]}{build.stderr[-1500:]}"
+            )
+        ], None
+    findings: List[Finding] = []
+    for san, (libname, runtime, _, opts) in SANITIZERS.items():
+        rt = _runtime_path(runtime)
+        env = os.environ.copy()
+        env.update(opts)
+        env["LD_PRELOAD"] = rt or ""
+        env["ALZ_NATIVE_LIB"] = str(NATIVE_DIR / libname)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            run = subprocess.run(
+                [sys.executable, "-m", "tools.alaznat", "--fuzz-run"],
+                capture_output=True,
+                text=True,
+                cwd=str(REPO),
+                env=env,
+                timeout=_SUBPROCESS_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            findings.append(_finding(f"{san} fuzz run timed out"))
+            continue
+        report = None
+        try:
+            report = json.loads(run.stdout)
+        except json.JSONDecodeError:
+            pass
+        bad = (
+            "ERROR: AddressSanitizer" in run.stderr
+            or "runtime error:" in run.stderr
+            or "ERROR: UndefinedBehaviorSanitizer" in run.stderr
+        )
+        if bad or (run.returncode != 0 and report is None):
+            findings.append(
+                _finding(
+                    f"{san} fuzz run failed (rc={run.returncode}):\n"
+                    f"{run.stderr[-2000:]}"
+                )
+            )
+            continue
+        for p in (report or {}).get("problems", [])[:20]:
+            findings.append(
+                _finding(
+                    f"{san} corpus case {p['case']} ({p['export']}): "
+                    f"{p['problem']}"
+                )
+            )
+    return findings, None

@@ -1,0 +1,274 @@
+"""The golden native offset map: ``resources/specs/nat_offsets.json``
+and ALZ062 (drift).
+
+The map pins what the static half DERIVED — per-file struct layouts
+(pack(1)- and array-aware), enum/constexpr tables, static_assert size
+pins, the GIL-region contract of every export, the pinned-constant
+table, and the sanitizer build matrix — the same way alazspec's
+specfiles pin shapes and alazrace's ``threads.json`` pins thread
+topology: regenerated deterministically (``make specs`` / ``python -m
+tools.alaznat --write-offsets``), committed, byte-fixpoint under regen.
+A new offset, a struct growing a field, or an export joining the
+GIL-dropped surface shows up as a one-line JSON diff in the PR that
+caused it. ALZ062 flags any live map that disagrees with the committed
+one.
+
+The pinned-constant table is the lint's escape from magic-number
+whack-a-mole: every non-layout constant the native code legitimately
+shares with the Python side (hash mixers, the conn-key mixer, time-unit
+conversions, HTTP status classes) is pinned WITH its Python provenance,
+and the provenance is re-verified live at check time — pinning a
+constant that no longer matches its Python twin is itself a finding.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tools.alazlint.core import Finding
+from tools.alaznat.natmodel import NatSource, parse_native_source
+
+REPO = Path(__file__).resolve().parent.parent.parent
+NATIVE_DIR = REPO / "alaz_tpu" / "native"
+OFFSETS_GOLDEN = REPO / "resources" / "specs" / "nat_offsets.json"
+
+# per-file lint role: the offset/magic rule (ALZ060) holds library
+# sources to the derivable-set contract; harness sources (test drivers,
+# example agents — their literals are traffic shapes, not wire
+# knowledge) get the GIL rule and the struct cross-check only. A file
+# NOT listed here defaults to "library": new native code is strict until
+# a reviewed golden regen classifies it.
+FILE_ROLES = {
+    "ingest.cc": "library",
+    "tsan_test.cc": "harness",
+    "agent_example.cc": "harness",
+}
+
+# value -> provenance. Every entry is re-verified against its Python
+# twin by verify_pinned_constants() — see _VERIFIERS below.
+PINNED_CONSTANTS: Dict[int, str] = {
+    0xFF51AFD7ED558CCD: (
+        "splitmix64 finalizer c1 — graph/builder._MIX_C1 "
+        "(wire_layouts sampling.priority_mix)"
+    ),
+    0xC4CEB9FE1A85EC53: (
+        "splitmix64 finalizer c2 — graph/builder._MIX_C2 "
+        "(wire_layouts sampling.priority_mix)"
+    ),
+    0x9E3779B97F4A7C15: (
+        "conn-key (pid,fd) mixer — aggregator/engine.py socket-line "
+        "grouping key (64-bit golden ratio)"
+    ),
+    0x9E3779B9: (
+        "32-bit golden-ratio hash combiner — AlzIpHash (native-only; "
+        "boost::hash_combine constant)"
+    ),
+    60_000_000_000: (
+        "ONE_MINUTE_NS — aggregator/sockline.py socket-line pick window"
+    ),
+    1_000_000: "ns -> ms divisor (write_time_ns -> REQUEST start_time_ms)",
+    500: "HTTP 5xx class floor — graph/builder.py err5 edge feature",
+    400: "HTTP 4xx class floor — graph/builder.py err4 edge feature",
+}
+
+
+def _grep_hex(path: Path, value: int) -> bool:
+    text = path.read_text().lower()
+    return f"0x{value:x}" in text
+
+
+def _verify_mix(which: str, value: int) -> Optional[str]:
+    from alaz_tpu.graph import builder
+
+    live = getattr(builder, which)
+    if live != value:
+        return f"graph/builder.{which} is 0x{live:X}, pinned 0x{value:X}"
+    return None
+
+
+def _verify_conn_key(value: int) -> Optional[str]:
+    if not _grep_hex(REPO / "alaz_tpu" / "aggregator" / "engine.py", value):
+        return (
+            f"0x{value:X} not found in aggregator/engine.py — the conn-key "
+            "mixer moved or changed"
+        )
+    return None
+
+
+def _verify_minute(value: int) -> Optional[str]:
+    from alaz_tpu.aggregator.sockline import ONE_MINUTE_NS
+
+    if ONE_MINUTE_NS != value:
+        return f"sockline.ONE_MINUTE_NS is {ONE_MINUTE_NS}, pinned {value}"
+    return None
+
+
+def _verify_status_class(value: int) -> Optional[str]:
+    text = (REPO / "alaz_tpu" / "graph" / "builder.py").read_text()
+    if not re.search(rf">=\s*{value}\b", text):
+        return (
+            f"status class {value} not found in graph/builder.py — the "
+            "err4/err5 feature classes moved"
+        )
+    return None
+
+
+_VERIFIERS = {
+    0xFF51AFD7ED558CCD: lambda v: _verify_mix("_MIX_C1", v),
+    0xC4CEB9FE1A85EC53: lambda v: _verify_mix("_MIX_C2", v),
+    0x9E3779B97F4A7C15: _verify_conn_key,
+    60_000_000_000: _verify_minute,
+    500: _verify_status_class,
+    400: _verify_status_class,
+}
+
+
+def verify_pinned_constants() -> List[Finding]:
+    """A pinned constant whose Python provenance no longer agrees is an
+    ALZ060 finding — the table must never drift into fiction."""
+    out: List[Finding] = []
+    for value, verify in _VERIFIERS.items():
+        problem = verify(value)
+        if problem is not None:
+            out.append(
+                Finding(
+                    "ALZ060",
+                    f"pinned constant drifted from its provenance: {problem} "
+                    f"(pinned as: {PINNED_CONSTANTS[value]}) — update the "
+                    "pinned-constant table AND the native code together",
+                    str(OFFSETS_GOLDEN),
+                    1,
+                    0,
+                )
+            )
+    return out
+
+
+def _const_key(value: int) -> str:
+    return f"0x{value:X}" if value > 0xFFFF else str(value)
+
+
+def _file_entry(ns: NatSource) -> dict:
+    name = ns.path.name
+    return {
+        "role": FILE_ROLES.get(name, "library"),
+        "structs": {
+            n: s.layout_string() for n, s in sorted(ns.structs.items())
+        },
+        "opaque_structs": sorted(ns.opaque_structs),
+        "enums": {
+            n: dict(sorted(vals.items(), key=lambda kv: kv[1]))
+            for n, vals in sorted(ns.enums.items())
+        },
+        "constexprs": dict(sorted(ns.constexprs.items())),
+        "size_asserts": {n: sz for n, sz in sorted(ns.size_asserts)},
+    }
+
+
+def compute_offset_map(sources: Dict[Path, NatSource]) -> dict:
+    from alaz_tpu.graph import native as gn
+
+    return {
+        "files": {
+            ns.path.name: _file_entry(ns)
+            for ns in sorted(sources.values(), key=lambda s: s.path.name)
+        },
+        # the GIL-region contract: ctypes releases the GIL around every
+        # call, so each export IS a GIL-dropped region end to end —
+        # what ALZ061 enforces, pinned here so the contract is reviewed
+        # topology, not tribal knowledge
+        "gil_contract": {
+            "boundary": "ctypes (releases the GIL for the call duration)",
+            "exports": {
+                name: "dropped" for name in sorted(gn.NATIVE_EXPORTS)
+            },
+        },
+        "pinned_constants": {
+            _const_key(v): why
+            for v, why in sorted(PINNED_CONSTANTS.items())
+        },
+        # sanitizer build matrix (the dynamic half): binary -> sources,
+        # mirrored by alazspec's check_binary_stamps staleness scan
+        "sanitizer_builds": {
+            "libalaz_ingest.asan.so": ["ingest.cc"],
+            "libalaz_ingest.ubsan.so": ["ingest.cc"],
+        },
+    }
+
+
+def render(offset_map: dict) -> str:
+    return json.dumps(offset_map, indent=2, sort_keys=True) + "\n"
+
+
+def parse_sources(
+    native_dir: Path = NATIVE_DIR,
+) -> Dict[Path, NatSource]:
+    return {
+        p: parse_native_source(p) for p in sorted(native_dir.glob("*.cc"))
+    }
+
+
+def write_offsets_golden(
+    sources: Optional[Dict[Path, NatSource]] = None,
+    path: Path = OFFSETS_GOLDEN,
+) -> Path:
+    sources = sources if sources is not None else parse_sources()
+    path.write_text(render(compute_offset_map(sources)))
+    return path
+
+
+def _diff_paths(golden, live, prefix="") -> List[str]:
+    if isinstance(golden, dict) and isinstance(live, dict):
+        out: List[str] = []
+        for k in sorted(set(golden) | set(live)):
+            p = f"{prefix}.{k}" if prefix else k
+            if k not in golden:
+                out.append(f"{p} (new)")
+            elif k not in live:
+                out.append(f"{p} (gone)")
+            else:
+                out.extend(_diff_paths(golden[k], live[k], p))
+        return out
+    if golden != live:
+        return [f"{prefix}: golden {golden!r} vs live {live!r}"]
+    return []
+
+
+def check_alz062(
+    sources: Optional[Dict[Path, NatSource]] = None,
+    golden_path: Path = OFFSETS_GOLDEN,
+) -> List[Finding]:
+    sources = sources if sources is not None else parse_sources()
+    live = compute_offset_map(sources)
+    try:
+        golden = json.loads(golden_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return [
+            Finding(
+                "ALZ062",
+                f"golden native offset map {golden_path.name} missing or "
+                "unreadable — regenerate with `python -m tools.alaznat "
+                "--write-offsets` (or `make specs`) and commit",
+                str(golden_path),
+                1,
+                0,
+            )
+        ]
+    out: List[Finding] = []
+    for drift in _diff_paths(golden, live)[:20]:
+        out.append(
+            Finding(
+                "ALZ062",
+                f"native offset map drifted from {golden_path.name}: "
+                f"{drift} — an offset, struct, export, or pin changed; "
+                "regenerate with --write-offsets and REVIEW the diff "
+                "(byte layout changing is a design event, not a drive-by)",
+                str(golden_path),
+                1,
+                0,
+            )
+        )
+    return out

@@ -1,0 +1,293 @@
+"""C-source facts for the alaznat rules: struct layouts (pack(1)- and
+array-aware — the two shapes tools/alazspec/cstructs.py deliberately
+does not model), enum/constexpr constants, static_assert size pins,
+integer-literal sites, and the C++ disable-comment scan.
+
+The parser is a restricted-subset extractor exactly like alazspec's
+``CSource``: it parses the declaration shapes ingest.cc actually uses
+and records anything else as opaque (a functor struct, a struct holding
+atomics/vectors/methods). That keeps it honest — the five wire structs
+(AlzRecord, EdgeSlot, NodeSlot, AlzL7Event, AlzRequest) parse fully and
+cross-check against the golden wire table; everything the parser cannot
+lay out is excluded from the derivable set rather than guessed at.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# fixed-width scalar sizes the native sources use (size, natural align)
+_TYPE_SIZES = {
+    "uint8_t": 1, "int8_t": 1, "char": 1, "bool": 1,
+    "uint16_t": 2, "int16_t": 2,
+    "uint32_t": 4, "int32_t": 4, "int": 4, "unsigned": 4, "float": 4,
+    "uint64_t": 8, "int64_t": 8, "size_t": 8, "double": 8,
+}
+
+_STRUCT_RE = re.compile(r"^struct\s+(\w+)\s*\{", re.M)
+_FIELD_RE = re.compile(r"^\s*([A-Za-z_]\w*)\s+(\w+)\s*(?:\[(\d+)\])?\s*;\s*$")
+_ENUM_RE = re.compile(r"^enum\s+(\w+)\s*\{(.*?)\};", re.M | re.S)
+_CONSTEXPR_RE = re.compile(
+    r"^\s*constexpr\s+[\w:]+\s+(\w+)\s*=\s*"
+    r"(0[xX][0-9a-fA-F]+|\d+)\s*(?:<<\s*(\d+))?", re.M
+)
+_STATIC_ASSERT_RE = re.compile(
+    r"static_assert\s*\(\s*sizeof\s*\(\s*(\w+)\s*\)\s*==\s*(\d+)"
+)
+_PRAGMA_PACK_RE = re.compile(r"#pragma\s+pack\s*\(\s*(push\s*,\s*1|pop)\s*\)")
+
+# integer literals with their suffixes; the stripped source has no
+# strings/comments left, so a bare regex cannot false-positive on text
+_INT_LIT_RE = re.compile(
+    r"\b(0[xX][0-9a-fA-F]+|\d+)(?:[uU]?[lL]{0,2}|[lL]{1,2}[uU]?)\b"
+)
+
+# C++ analog of the core's ``# alazlint: disable=...`` comment — scanned
+# from the RAW source (comments survive there), same-line suppression,
+# same justification discipline (ALZ000 on a bare disable)
+_DISABLE_RE = re.compile(
+    r"//\s*alazlint:\s*disable=([A-Z0-9,\s]+?)(?:\s+--\s+(\S.*))?\s*$"
+)
+
+
+def strip_comments(src: str) -> str:
+    """Remove //, /* */ comments and string/char literal CONTENTS while
+    preserving the line structure, so reported line numbers stay true."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j < 0 else j  # keep the newline itself
+        elif src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            seg = src[i:(j + 2 if j >= 0 else n)]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        elif src[i] in "\"'":
+            q = src[i]
+            j = i + 1
+            while j < n and src[j] != q:
+                j += 2 if src[j] == "\\" else 1
+            out.append(q + q)  # empty literal placeholder
+            i = j + 1
+        else:
+            out.append(src[i])
+            i += 1
+    return "".join(out)
+
+
+@dataclass
+class CField:
+    name: str
+    offset: int
+    size: int
+
+
+@dataclass
+class CStructLayout:
+    name: str
+    size: int
+    packed: bool
+    fields: List[CField] = field(default_factory=list)
+
+    def layout_string(self) -> str:
+        """Same rendering as events/schema.dtype_layout and alazspec's
+        ``CStruct.layout_string`` — the cross-check currency."""
+        parts = [f"{self.name}:{self.size}"]
+        parts += [f"{f.name}:{f.offset}:{f.size}" for f in self.fields]
+        return ";".join(parts)
+
+
+@dataclass
+class LiteralSite:
+    line: int
+    col: int
+    token: str  # as written, suffix included
+    value: int
+
+
+@dataclass
+class NatSource:
+    """Parsed facts of one native source file."""
+
+    path: Path
+    source: str  # raw, comments intact (disable scan)
+    stripped: str  # comment/string-stripped, lines preserved
+    structs: Dict[str, CStructLayout] = field(default_factory=dict)
+    opaque_structs: List[str] = field(default_factory=list)
+    enums: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    constexprs: Dict[str, int] = field(default_factory=dict)
+    size_asserts: List[Tuple[str, int]] = field(default_factory=list)
+    literals: List[LiteralSite] = field(default_factory=list)
+    # line -> {code or "" (all codes): justification or None}
+    disables: Dict[int, Dict[str, Optional[str]]] = field(default_factory=dict)
+
+
+def _layout(name: str, body: str, packed: bool) -> Optional[CStructLayout]:
+    """SysV layout of a plain-field struct body; None when any line is
+    not a ``type name;`` / ``type name[N];`` declaration (opaque)."""
+    fields: List[CField] = []
+    off = 0
+    max_align = 1
+    for raw in body.split("\n"):
+        line = raw.strip()
+        if not line:
+            continue
+        m = _FIELD_RE.match(line)
+        if m is None:
+            return None
+        tname, fname, count = m.group(1), m.group(2), m.group(3)
+        elem = _TYPE_SIZES.get(tname)
+        if elem is None:
+            return None
+        size = elem * int(count) if count else elem
+        align = 1 if packed else min(elem, 8)
+        max_align = max(max_align, align)
+        off = (off + align - 1) // align * align
+        fields.append(CField(fname, off, size))
+        off += size
+    total = (off + max_align - 1) // max_align * max_align
+    return CStructLayout(name, total, packed, fields)
+
+
+def _brace_span(src: str, open_idx: int) -> int:
+    """Index just past the ``}`` matching the ``{`` at open_idx."""
+    depth = 0
+    for i in range(open_idx, len(src)):
+        if src[i] == "{":
+            depth += 1
+        elif src[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(src)
+
+
+def _packed_regions(stripped: str) -> List[Tuple[int, int]]:
+    """[start, end) character spans under ``#pragma pack(push, 1)``."""
+    spans: List[Tuple[int, int]] = []
+    start: Optional[int] = None
+    for m in _PRAGMA_PACK_RE.finditer(stripped):
+        if m.group(1).startswith("push"):
+            if start is None:
+                start = m.end()
+        else:
+            if start is not None:
+                spans.append((start, m.start()))
+                start = None
+    if start is not None:
+        spans.append((start, len(stripped)))
+    return spans
+
+
+def _parse_enum_body(body: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    nxt = 0
+    for part in body.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, val = part.partition("=")
+            try:
+                nxt = int(val.strip(), 0)
+            except ValueError:
+                continue
+            out[name.strip()] = nxt
+        else:
+            out[part] = nxt
+        nxt += 1
+    return out
+
+
+def _scan_disables(source: str) -> Dict[int, Dict[str, Optional[str]]]:
+    out: Dict[int, Dict[str, Optional[str]]] = {}
+    for ln, line in enumerate(source.split("\n"), 1):
+        m = _DISABLE_RE.search(line)
+        if m is None:
+            continue
+        why = m.group(2)
+        codes = [c.strip() for c in m.group(1).split(",") if c.strip()]
+        entry = out.setdefault(ln, {})
+        for code in codes:
+            entry[code] = why
+    return out
+
+
+def parse_native_source(path: Path) -> NatSource:
+    source = path.read_text()
+    stripped = strip_comments(source)
+    ns = NatSource(path=path, source=source, stripped=stripped)
+
+    packed_spans = _packed_regions(stripped)
+
+    def is_packed(idx: int) -> bool:
+        return any(a <= idx < b for a, b in packed_spans)
+
+    for m in _STRUCT_RE.finditer(stripped):
+        open_idx = stripped.index("{", m.start())
+        end = _brace_span(stripped, open_idx)
+        body = stripped[open_idx + 1 : end - 1]
+        layout = _layout(m.group(1), body, is_packed(m.start()))
+        if layout is None:
+            ns.opaque_structs.append(m.group(1))
+        else:
+            ns.structs[layout.name] = layout
+
+    for m in _ENUM_RE.finditer(stripped):
+        ns.enums[m.group(1)] = _parse_enum_body(m.group(2))
+
+    for m in _CONSTEXPR_RE.finditer(stripped):
+        val = int(m.group(2), 0)
+        if m.group(3):
+            val <<= int(m.group(3))
+        ns.constexprs[m.group(1)] = val
+
+    for m in _STATIC_ASSERT_RE.finditer(stripped):
+        ns.size_asserts.append((m.group(1), int(m.group(2))))
+
+    for ln, line in enumerate(stripped.split("\n"), 1):
+        if line.lstrip().startswith("#"):
+            continue  # preprocessor lines (includes, pragma, define)
+        for lm in _INT_LIT_RE.finditer(line):
+            ns.literals.append(
+                LiteralSite(ln, lm.start(), lm.group(0), int(lm.group(1), 0))
+            )
+
+    ns.disables = _scan_disables(source)
+    return ns
+
+
+def filter_native_disables(findings, sources: Dict[Path, NatSource]):
+    """The C++ twin of core.filter_disables: a ``// alazlint:
+    disable=ALZxxx -- why`` on the flagged line suppresses that code;
+    a disable with no justification surfaces as ALZ000 (same discipline
+    as the Python side — the escape hatch must carry its why)."""
+    from tools.alazlint.core import Finding
+
+    out = []
+    seen_bare: set = set()
+    for f in findings:
+        ns = sources.get(Path(f.path))
+        entry = ns.disables.get(f.line, {}) if ns is not None else {}
+        if f.code in entry or "" in entry:
+            why = entry.get(f.code, entry.get(""))
+            if why is None and (f.path, f.line) not in seen_bare:
+                seen_bare.add((f.path, f.line))
+                out.append(
+                    Finding(
+                        "ALZ000",
+                        "alazlint disable comment without a justification "
+                        "— write `// alazlint: disable=CODE -- <why>`",
+                        f.path,
+                        f.line,
+                        0,
+                    )
+                )
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,262 @@
+"""ALZ060/ALZ061 — the static half of alaznat.
+
+ALZ060 (offset/magic discipline): every integer constant in the native
+batch passes must be *derivable* — from a layout alazspec pins in
+``resources/specs/wire_layouts.json`` (struct totals, field offsets,
+field sizes — cstructs, dtype mirrors, l7_engine input/output, shm_ring
+headers, frame constants), from the file's own enums/constexprs (which
+the golden offset map pins), or from the pinned-constant table
+(``nat_offsets.json`` — hash mixers, conn-key, time-unit constants, each
+with a Python-side provenance that is re-verified live). A bare
+``memcpy(dst + 75, ...)`` with no pinned layout deriving 75 is exactly
+the drift this head exists to catch. The same pass cross-checks the
+pack(1)-aware struct layouts parsed from source against the golden wire
+table — the triangle alazspec cannot close (its parser models neither
+``#pragma pack`` nor array fields).
+
+ALZ061 (GIL discipline): every export is called through ctypes, which
+releases the GIL for the duration of the call — the whole native layer
+is one GIL-dropped region. Any CPython API use (``Py*`` identifier,
+``Python.h`` include) reachable there is a crash waiting for a second
+thread; the rule bans the tokens outright, disable-escapable like every
+other rule.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.alazlint.core import Finding
+from tools.alaznat.natmodel import NatSource
+
+REPO = Path(__file__).resolve().parent.parent.parent
+WIRE_LAYOUTS = REPO / "resources" / "specs" / "wire_layouts.json"
+
+# values below this are index/shift/arity furniture, not byte offsets
+_SMALL = 64
+
+# structs whose parsed layout must byte-match a pinned wire-table layout
+# of the same struct name (the source↔golden leg of the triangle; the
+# golden↔dtype and dtype↔binary legs are alazspec ALZ020/ALZ021)
+_WIRE_STRUCT_NAMES = (
+    "AlzRecord", "EdgeSlot", "NodeSlot", "AlzL7Event", "AlzRequest",
+)
+
+
+def _is_pow2ish(v: int) -> bool:
+    """Powers of two and all-ones masks: structural capacities,
+    alignments, and bit masks — not byte-layout knowledge."""
+    if v <= 0:
+        return False
+    return (v & (v - 1)) == 0 or (v & (v + 1)) == 0
+
+
+def _layout_numbers(layout: str) -> Set[int]:
+    """Every number a pinned layout string derives: total size, field
+    offsets, field sizes, and offset+size end positions (the natural
+    operands of a bounds check or a tail memset)."""
+    out: Set[int] = set()
+    parts = layout.split(";")
+    head = parts[0].split(":")
+    if len(head) == 2 and head[1].isdigit():
+        out.add(int(head[1]))
+    for p in parts[1:]:
+        bits = p.split(":")
+        if len(bits) == 3 and bits[1].isdigit() and bits[2].isdigit():
+            off, sz = int(bits[1]), int(bits[2])
+            out.update((off, sz, off + sz))
+    return out
+
+
+def _walk_wire(node) -> Iterable:
+    if isinstance(node, dict):
+        for v in node.values():
+            yield from _walk_wire(v)
+    elif isinstance(node, list):
+        for v in node:
+            yield from _walk_wire(v)
+    else:
+        yield node
+
+
+def wire_numbers(wire_path: Path = WIRE_LAYOUTS) -> Set[int]:
+    """All integers derivable from the golden wire table: layout-string
+    numbers plus plain numeric pins (frame magic/header_size, shm magic,
+    priority-mix constants, version fields)."""
+    try:
+        wire = json.loads(wire_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return set()
+    out: Set[int] = set()
+    for leaf in _walk_wire(wire):
+        if isinstance(leaf, bool):
+            continue
+        if isinstance(leaf, int):
+            out.add(leaf)
+        elif isinstance(leaf, str):
+            if ";" in leaf and ":" in leaf:
+                out |= _layout_numbers(leaf)
+            elif leaf.lower().startswith("0x"):
+                try:
+                    out.add(int(leaf, 16))
+                except ValueError:
+                    pass
+    return out
+
+
+def wire_layout_strings(wire_path: Path = WIRE_LAYOUTS) -> Dict[str, str]:
+    """struct name -> pinned layout string, for every layout string in
+    the wire table (cstructs, dtype mirrors, l7_engine, shm_ring)."""
+    try:
+        wire = json.loads(wire_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out: Dict[str, str] = {}
+    for leaf in _walk_wire(wire):
+        if isinstance(leaf, str) and ";" in leaf and ":" in leaf:
+            name = leaf.split(":", 1)[0]
+            out.setdefault(name, leaf)
+    return out
+
+
+def derivable_numbers(
+    ns: NatSource,
+    pinned: Dict[int, str],
+    wire_path: Path = WIRE_LAYOUTS,
+) -> Set[int]:
+    out = wire_numbers(wire_path)
+    out |= set(pinned)
+    for layout in ns.structs.values():
+        out |= _layout_numbers(layout.layout_string())
+    for values in ns.enums.values():
+        out |= set(values.values())
+    out |= set(ns.constexprs.values())
+    return out
+
+
+def check_alz060_literals(
+    ns: NatSource, pinned: Dict[int, str], wire_path: Path = WIRE_LAYOUTS
+) -> List[Finding]:
+    derivable = derivable_numbers(ns, pinned, wire_path)
+    out: List[Finding] = []
+    for lit in ns.literals:
+        v = lit.value
+        if v < _SMALL or _is_pow2ish(v) or v in derivable:
+            continue
+        out.append(
+            Finding(
+                "ALZ060",
+                f"magic number {lit.token} is not derivable from any "
+                "pinned layout — byte offsets/strides/sizes in the native "
+                "batch passes must come from a wire-table layout "
+                "(resources/specs/wire_layouts.json), an in-file "
+                "enum/constexpr, or the pinned-constant table "
+                "(resources/specs/nat_offsets.json); pin it with a "
+                "provenance or derive it from the struct",
+                str(ns.path),
+                lit.line,
+                lit.col,
+            )
+        )
+    return out
+
+
+def check_alz060_struct_drift(
+    ns: NatSource, wire_path: Path = WIRE_LAYOUTS
+) -> List[Finding]:
+    """source structs vs golden wire layouts + static_assert pins."""
+    out: List[Finding] = []
+    pinned_layouts = wire_layout_strings(wire_path)
+    for name in _WIRE_STRUCT_NAMES:
+        layout = ns.structs.get(name)
+        if layout is None:
+            continue
+        want = pinned_layouts.get(name)
+        if want is None:
+            out.append(
+                Finding(
+                    "ALZ060",
+                    f"struct {name} has no pinned layout in the wire table "
+                    f"({wire_path.name}) — a wire struct must be pinned "
+                    "before native code does byte math over it "
+                    "(`make specs` regenerates)",
+                    str(ns.path),
+                    1,
+                    0,
+                )
+            )
+            continue
+        got = layout.layout_string()
+        if got != want:
+            out.append(
+                Finding(
+                    "ALZ060",
+                    f"struct {name} drifted from its pinned wire layout:\n"
+                    f"  source: {got}\n  golden: {want}\n"
+                    "— realign the struct or regenerate the goldens "
+                    "(`make specs`) and review the diff",
+                    str(ns.path),
+                    1,
+                    0,
+                )
+            )
+    for sname, size in ns.size_asserts:
+        layout = ns.structs.get(sname)
+        if layout is not None and layout.size != size:
+            out.append(
+                Finding(
+                    "ALZ060",
+                    f"static_assert pins sizeof({sname}) == {size} but the "
+                    f"declared fields lay out to {layout.size} bytes — the "
+                    "assert and the struct tell different stories",
+                    str(ns.path),
+                    1,
+                    0,
+                )
+            )
+    return out
+
+
+# -- ALZ061: GIL discipline --------------------------------------------------
+
+import re as _re
+
+_PY_API_RE = _re.compile(r"\bPy[A-Z_]\w*")
+_PYTHON_H_RE = _re.compile(r'#\s*include\s*[<"][^>"]*Python\.h[>"]')
+
+
+def check_alz061(ns: NatSource) -> List[Finding]:
+    out: List[Finding] = []
+    for ln, line in enumerate(ns.stripped.split("\n"), 1):
+        m = _PYTHON_H_RE.search(line)
+        if m is not None:
+            out.append(
+                Finding(
+                    "ALZ061",
+                    "Python.h included in GIL-dropped native code — every "
+                    "export here runs with the GIL released (ctypes drops "
+                    "it for the duration of the call); CPython API use is "
+                    "a crash under any concurrent Python thread",
+                    str(ns.path),
+                    ln,
+                    m.start(),
+                )
+            )
+            continue
+        m = _PY_API_RE.search(line)
+        if m is not None:
+            out.append(
+                Finding(
+                    "ALZ061",
+                    f"CPython API token `{m.group(0)}` in GIL-dropped "
+                    "native code — the ctypes boundary releases the GIL "
+                    "around every export, so no Py* call is safe anywhere "
+                    "in this layer; marshal through plain buffers instead",
+                    str(ns.path),
+                    ln,
+                    m.start(),
+                )
+            )
+    return out

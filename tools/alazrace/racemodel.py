@@ -39,8 +39,12 @@ is correct, but two pipelines owning PRIVATE instances of one class
 merge into one role set, which over-approximates; the sanctioned
 ``# lockless-ok: <why>`` annotation (field- or class-level, audited by
 ALZ053) is the designed pressure valve, exactly like ALZ010's justified
-disables. Mutating METHOD calls (``self.d.update(...)``) are not writes
-in v1 — subscript stores and aug-assigns are.
+disables. Mutating METHOD calls (``self.d.update(...)``,
+``self.q.append(...)``) count as writes in the lockset walk alongside
+subscript stores and aug-assigns (the v1 bound ROADMAP carried, closed
+by ISSUE 18): a call whose receiver is a field and whose name is a
+known mutator records a compound write site — resize/rehash is
+multi-op under the hood, same as ``d[k] = v``.
 """
 
 from __future__ import annotations
@@ -86,6 +90,22 @@ _LOCKLESS_RE = re.compile(r"#\s*lockless-ok(?::\s*(?P<why>\S.*))?")
 _ROLE_PRIVATE_RE = re.compile(r"#\s*role-private(?::\s*(?P<why>\S.*))?")
 
 _MUTATING_SUBSCRIPT_WRITE = "container-write"
+
+# method names that structurally mutate their receiver: a call
+# ``self.<field>.<name>(...)`` records a WRITE site on the field in the
+# lockset walk (the v1 "mutating method calls are not writes" bound,
+# closed). Compound by nature — every one is read-modify-write on the
+# container's internals, so they audit like aug-assigns, not plain
+# stores. Names shadowed by project classes don't land here: the walk
+# only treats a call as a container mutation when it does NOT resolve
+# to a project method.
+_MUTATING_METHODS = frozenset(
+    (
+        "add", "append", "appendleft", "clear", "discard", "extend",
+        "extendleft", "insert", "pop", "popitem", "popleft", "remove",
+        "setdefault", "sort", "update",
+    )
+)
 
 
 def _unwrap_optional(ann: ast.AST) -> ast.AST:
@@ -739,6 +759,33 @@ class RaceModel:
                 for cb in callback_targets(node):
                     if cb != qn:
                         calls.append((frozenset(held), cb))
+                # mutating METHOD calls on a field (``self.d.update(x)``,
+                # ``self._q.append(it)``): a structural container write,
+                # recorded as a compound site like an aug-assign. Two
+                # guards keep it precise: the call must NOT resolve to a
+                # project method (``self.store.update()`` on a project
+                # class is a call edge, not a dict mutation), and the
+                # field must be DECLARED a container (``set()``/``{}``/
+                # ``deque()`` init) — ``self._stop.clear()`` on a
+                # threading.Event is a thread-safe primitive call that
+                # shares these method names.
+                fn = node.func
+                if (
+                    target is None
+                    and isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATING_METHODS
+                    and isinstance(fn.value, ast.Attribute)
+                ):
+                    cls_qn = receiver_class(fn.value.value)
+                    decl = (
+                        self.fields.get((cls_qn, fn.value.attr))
+                        if cls_qn is not None
+                        else None
+                    )
+                    if decl is not None and decl.value_kind == "container":
+                        field_site(
+                            cls_qn, fn.value.attr, node, True, True, held
+                        )
             if isinstance(node, (ast.Assign, ast.AugAssign)):
                 targets = (
                     node.targets if isinstance(node, ast.Assign) else [node.target]

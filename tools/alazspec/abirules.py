@@ -258,10 +258,23 @@ def check_export_buffers(cc_path: Path = INGEST_CC) -> List[Finding]:
 _STAMP_RE = re.compile(rb"ALZ_SOURCE_STAMP:([0-9a-f]{16}|unstamped)")
 
 # binary name → the source files its Makefile hash covers, in recipe
-# order (`cat a b | sha256sum`)
+# order (`cat a b | sha256sum`). The sanitizer shared objects (alaznat's
+# dynamic half, `make asan` / `make ubsan`) live here too: they cannot
+# be dlopen'd from a stock interpreter (the sanitizer runtime must be
+# preloaded), so like tsan_test they carry the byte-scannable
+# kAlzSourceStamp marker and are checked without loading.
 BINARY_SOURCES = {
     "tsan_test": ("ingest.cc", "tsan_test.cc"),
     "agent_example": ("agent_example.cc",),
+    "libalaz_ingest.asan.so": ("ingest.cc",),
+    "libalaz_ingest.ubsan.so": ("ingest.cc",),
+}
+
+_REBUILD_HINTS = {
+    "tsan_test": "make tsan",
+    "agent_example": "make agent",
+    "libalaz_ingest.asan.so": "make asan",
+    "libalaz_ingest.ubsan.so": "make ubsan",
 }
 
 
@@ -306,7 +319,7 @@ def check_binary_stamps(
             "carries no source stamp (built before stamping, or out of "
             "band)" if got in (None, "unstamped") else f"is stamped {got}"
         )
-        rebuild = "make tsan" if name == "tsan_test" else "make agent"
+        rebuild = _REBUILD_HINTS.get(name, "make -B")
         out.append(
             Finding(
                 "ALZ020",
@@ -315,6 +328,24 @@ def check_binary_stamps(
                 f"`{rebuild}` (in alaz_tpu/native) so the binary matches "
                 "the source the checks read",
                 str(bin_path),
+                1,
+                0,
+            )
+        )
+    # stray variants: a libalaz_ingest.<anything>.so that is neither the
+    # canonical library nor a known (stamp-checked) build flavor is an
+    # out-of-band artifact nothing regenerates — exactly the orphan
+    # sanitizer builds this pass was extended to catch
+    for so in sorted(native_dir.glob("libalaz_ingest.*.so")):
+        if so.name in binaries:
+            continue
+        out.append(
+            Finding(
+                "ALZ020",
+                f"stray native build {so.name}: not a known build flavor "
+                "(see BINARY_SOURCES) — delete it or register it with a "
+                "Makefile recipe that stamps it",
+                str(so),
                 1,
                 0,
             )

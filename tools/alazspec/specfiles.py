@@ -359,10 +359,12 @@ def check_specs(specs_dir: Optional[Path] = None) -> List[Finding]:
             )
         )
     for stray in sorted(specs_dir.glob("*.json")):
-        if stray.name in ("metrics.json", "threads.json"):
-            continue  # alazflow's golden metric registry (ALZ044) and
-            # alazrace's golden concurrency map (ALZ054) live beside the
-            # spec set but are owned by --write-metrics / --write-threads
+        if stray.name in ("metrics.json", "threads.json", "nat_offsets.json"):
+            continue  # alazflow's golden metric registry (ALZ044),
+            # alazrace's golden concurrency map (ALZ054), and alaznat's
+            # golden native offset map (ALZ062) live beside the spec set
+            # but are owned by --write-metrics / --write-threads /
+            # --write-offsets
         if stray.name not in live:
             out.append(
                 Finding(
