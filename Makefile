@@ -7,7 +7,7 @@ TAG       ?= latest
 # arm64 runs the data-plane (JAX_VARIANT=cpu); TPU hosts are amd64
 PLATFORMS ?= linux/amd64,linux/arm64
 
-.PHONY: native test lint sanitize abi-check flow race nat sanitize-native chaos scenarios specs image image-multiarch bench
+.PHONY: native test lint sanitize abi-check flow race nat sanitize-native jit chaos scenarios specs image image-multiarch bench
 
 native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent example
 	$(MAKE) -C alaz_tpu/native all agent
@@ -16,7 +16,7 @@ native:  ## libalaz_ingest.so (source-hash stamped) + the out-of-process agent e
 # main run skips their test files so the (not-cheap) stress and
 # spec-regen work isn't paid twice per invocation (tier-1 CI runs plain
 # `pytest tests/` and still covers both)
-test: lint sanitize abi-check flow race nat sanitize-native chaos scenarios
+test: lint sanitize abi-check flow race nat sanitize-native jit chaos scenarios
 	python -m pytest tests/ -x -q --ignore=tests/test_sanitize.py --ignore=tests/test_alazspec.py
 
 flow:  ## alazflow: whole-program row-conservation + blocking-discipline dataflow (ALZ040-ALZ044), incl. cause-vocabulary/metric-registry triangulation
@@ -27,6 +27,9 @@ race:  ## alazrace: whole-program thread-escape + lockset race detection (ALZ050
 
 nat:  ## alaznat static half: native offset/magic provenance + GIL discipline + golden offset-map drift over alaz_tpu/native/*.cc (ALZ060-ALZ062)
 	env JAX_PLATFORMS=cpu python -m tools.alaznat --json
+
+jit:  ## alazjit: device-plane static analysis — jit-surface discovery + retrace/host-sync/dtype hazard rules (ALZ070-ALZ073) + golden surface/budget-coverage drift (ALZ074, resources/specs/jit_surface.json)
+	python -m tools.alazjit --json
 
 sanitize-native:  ## alaznat dynamic half: ASan/UBSan builds of the ingest core + the adversarial fuzz corpus through all four exports with the Python engine as parity oracle (ALZ063); skips gracefully without the gcc sanitizer runtimes
 	env JAX_PLATFORMS=cpu python -m tools.alaznat --sanitize --json
@@ -48,9 +51,10 @@ specs:  ## regenerate golden specfiles + wire layout table + metric registry + c
 	python -m tools.alazflow --write-metrics
 	python -m tools.alazrace --write-threads
 	env JAX_PLATFORMS=cpu python -m tools.alaznat --write-offsets
+	python -m tools.alazjit --write-surface
 
 lint:  ## alazlint AST gate incl. whole-program ALZ006/ALZ014 and spec hygiene ALZ024 (also self-enforced in tier-1 via tests/test_lint.py) + ruff when installed
-	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec tools/alazflow tools/alazrace tools/alaznat --json
+	python -m tools.alazlint alaz_tpu/ tools/alazlint tools/alazspec tools/alazflow tools/alazrace tools/alaznat tools/alazjit --json
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check alaz_tpu tools; \
 	else \

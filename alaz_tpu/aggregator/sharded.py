@@ -375,7 +375,9 @@ class ShardedIngest:
         self.window_s = window_s
         self.window_ms = int(window_s * 1000)
         self.on_batch = on_batch
-        self.batches: List[GraphBatch] = []
+        # in-class appends happen inside the close-wave merge region;
+        # main reads .batches only after stop()/join (happens-before)
+        self.batches: List[GraphBatch] = []  # guarded-by: self._merge_lock
         # the cap applies HERE, at the merge-stage assembly, never in the
         # per-shard partials: each worker sees only its shard's slice of
         # a dst's fan-in, so capping early would make the sample depend
@@ -470,7 +472,7 @@ class ShardedIngest:
         # heartbeat races the wave-waiter's, and whoever loses that race
         # must still re-drive (the original close died with the thread)
         self._worker_gen = [0] * self.n  # guarded-by: self._restart_lock
-        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge  # lockless-ok: written only under the merge lock's bare bounded acquire (invisible to with-based lockset models); the racy float read IS the last_wave_age_s freshness gauge. Re-audited under the v1.1 mutating-call walk: every site is a plain float store/read, never a container mutation, so the sanction holds
+        self._last_wave_monotonic = time.monotonic()  # merge liveness gauge  # lockless-ok: written inside the merge lock's bounded-acquire region (which the lockset walk models since ISSUE 19); the sanction covers the racy float READ — it IS the last_wave_age_s freshness gauge. Every site is a plain float store/read, never a container mutation, so GIL-atomicity holds
 
         self._stop = threading.Event()
         if autostart:
@@ -911,12 +913,12 @@ class ShardedIngest:
                 if self.on_batch is not None:
                     self.on_batch(batch)
                 else:
-                    self.batches.append(batch)  # alazlint: disable=ALZ051 -- _merge_lock IS held via the bounded acquire above (the lockset walk only models `with` blocks); main reads batches after stop()/join
+                    self.batches.append(batch)
                 # completes the span here when no scorer follows
                 # (complete_at_emit); the service's tracer keeps it open
                 self.tracer.emit(w * self.window_ms)
-            self.merge_s += time.perf_counter() - t0  # alazlint: disable=ALZ010 -- _merge_lock IS held here via the bounded acquire above (the lint only models `with` blocks)
-            self.windows_merged += len(windows)  # alazlint: disable=ALZ010 -- held via the bounded acquire above, see merge_s
+            self.merge_s += time.perf_counter() - t0
+            self.windows_merged += len(windows)
             self._last_wave_monotonic = time.monotonic()
         finally:
             self._merge_lock.release()

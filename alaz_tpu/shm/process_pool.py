@@ -256,7 +256,9 @@ class ProcessShardedIngest:
         self.window_ms = int(window_s * 1000)
         self.on_batch = on_batch
         self.label_fn = label_fn
-        self.batches: List[GraphBatch] = []
+        # in-class appends happen inside the close-wave merge region;
+        # main reads .batches only after stop()/join (happens-before)
+        self.batches: List[GraphBatch] = []  # guarded-by: self._merge_lock
         # slot geometry: config knobs unless the caller overrides
         if slot_bytes is None:
             slot_bytes = getattr(
@@ -303,7 +305,7 @@ class ProcessShardedIngest:
         self._merge_lock = threading.Lock()
         self.merge_s = 0.0  # guarded-by: self._merge_lock
         self.windows_merged = 0  # guarded-by: self._merge_lock
-        self._last_wave_monotonic = time.monotonic()  # lockless-ok: written under the merge lock's bounded acquire; the racy float read IS the last_wave_age_s freshness gauge. Re-audited under the v1.1 mutating-call walk: every site is a plain float store/read, never a container mutation, so the sanction holds
+        self._last_wave_monotonic = time.monotonic()  # lockless-ok: written inside the merge lock's bounded-acquire region (which the lockset walk models since ISSUE 19); the sanction covers the racy float READ — it IS the last_wave_age_s freshness gauge. Every site is a plain float store/read, never a container mutation, so GIL-atomicity holds
 
         self._stop = threading.Event()
         self._merge_thread: Optional[threading.Thread] = None  # guarded-by: self._state_lock
@@ -904,10 +906,10 @@ class ProcessShardedIngest:
                 if self.on_batch is not None:
                     self.on_batch(batch)
                 else:
-                    self.batches.append(batch)  # alazlint: disable=ALZ051 -- _merge_lock IS held via the bounded acquire above (the lockset walk only models `with` blocks); main reads batches after stop()/join
+                    self.batches.append(batch)
                 self.tracer.emit(ws_ms)
-            self.merge_s += time.perf_counter() - t0  # alazlint: disable=ALZ010 -- _merge_lock IS held via the bounded acquire above (the lint only models `with` blocks)
-            self.windows_merged += len(windows)  # alazlint: disable=ALZ010 -- held via the bounded acquire above, see merge_s
+            self.merge_s += time.perf_counter() - t0
+            self.windows_merged += len(windows)
             self._last_wave_monotonic = time.monotonic()
         finally:
             self._merge_lock.release()

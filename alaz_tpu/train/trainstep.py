@@ -28,6 +28,17 @@ class TrainState:
     step: int = 0
 
 
+@functools.lru_cache(maxsize=None)
+def _adamw(lr: float, weight_decay: float = 1e-4) -> optax.GradientTransformation:
+    """One optimizer object per (lr, weight_decay). optax transforms are
+    pure (stateless init/update pairs), so sharing is safe — and the
+    cached object is what lets the lru_cache on the step makers hit
+    across calls: a fresh ``optax.adamw(...)`` per call is a fresh cache
+    key, which re-traces the step from scratch (ALZ070)."""
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+@functools.lru_cache(maxsize=None)
 def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation, pos_weight: float = 10.0) -> Callable:
     _, apply = get_model(cfg.model)
 
@@ -57,7 +68,7 @@ def train_on_batches(
 ) -> tuple[TrainState, List[float]]:
     init, _ = get_model(cfg.model)
     params = init(jax.random.PRNGKey(seed), cfg)
-    optimizer = optax.adamw(lr, weight_decay=1e-4)
+    optimizer = _adamw(lr)
     opt_state = optimizer.init(params)
     step_fn = make_train_step(cfg, optimizer, pos_weight)
 
@@ -89,6 +100,45 @@ def _pad_graph_field(name: str, v, n_t: int, e_t: int):
         return np.pad(v, (0, pad), constant_values=n_t - 1)
     widths = ((0, pad),) + ((0, 0),) * (v.ndim - 1)
     return np.pad(v, widths)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_unrolled_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    pos_weight: float,
+) -> Callable:
+    """Jitted whole-unroll update for TGN, cached per (cfg, optimizer,
+    pos_weight) so repeated unrolled training runs (the eval matrix
+    sweeps models per seed; scenario suites re-train per scenario) share
+    one trace cache. The window count and shape bucket ride the jit's
+    own cache key through the pytree structure of ``prepped``."""
+    from alaz_tpu.models import tgn
+
+    @jax.jit
+    def unrolled_step(params, opt_state, prepped, memory0):
+        def loss_fn(p):
+            total = 0.0
+            for graphs, labels in prepped:
+                mem = memory0
+                seq_total = 0.0
+                for g, lbl in zip(graphs, labels):
+                    out, mem = tgn.step(p, g, mem, cfg)
+                    seq_total = seq_total + edge_bce_loss(
+                        out["edge_logits"],
+                        lbl,
+                        g["edge_mask"].astype(jnp.float32),
+                        pos_weight,
+                    )
+                total = total + seq_total / len(graphs)
+            return total / len(prepped)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return unrolled_step
 
 
 def train_tgn_unrolled(
@@ -127,7 +177,9 @@ def train_tgn_unrolled(
         else [seq_input]
     )
     params = tgn.init(jax.random.PRNGKey(seed), cfg)
-    optimizer = optax.adamw(lr, weight_decay=1e-4)
+    # a schedule `lr` is a fresh callable per call — _adamw just misses
+    # its cache then, which is no worse than building adamw inline
+    optimizer = _adamw(lr)
     opt_state = optimizer.init(params)
     # the unroll is one program, so every window is padded up to the
     # largest bucket present (Poisson traffic routinely straddles bucket
@@ -152,30 +204,7 @@ def train_tgn_unrolled(
         return graphs, labels
 
     prepped = [prep_seq(s) for s in sequences]
-
-    @jax.jit
-    def unrolled_step(params, opt_state, prepped, memory0):
-        def loss_fn(p):
-            total = 0.0
-            for graphs, labels in prepped:
-                mem = memory0
-                seq_total = 0.0
-                for g, lbl in zip(graphs, labels):
-                    out, mem = tgn.step(p, g, mem, cfg)
-                    seq_total = seq_total + edge_bce_loss(
-                        out["edge_logits"],
-                        lbl,
-                        g["edge_mask"].astype(jnp.float32),
-                        pos_weight,
-                    )
-                total = total + seq_total / len(graphs)
-            return total / len(prepped)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
+    unrolled_step = _make_unrolled_step(cfg, optimizer, pos_weight)
     memory0 = tgn.init_memory(cfg, max_nodes)
     losses: List[float] = []
     for _ in range(epochs):

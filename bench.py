@@ -890,6 +890,24 @@ def bench_ingest(args) -> dict:
     except Exception:  # repo layout unavailable (installed wheel): skip
         nat_findings = -1
 
+    # and the device-plane contract (ISSUE 19): the alazjit pass over
+    # the tree (jit-surface discovery, retrace/host-sync/dtype hazards,
+    # golden surface + retrace-budget coverage) must report 0, or the
+    # measured pipeline's compile-cache behavior is one no spec pins.
+    # Wall-clock reported like race's so the `make test` budget stays
+    # visible as the jit surface grows.
+    try:
+        from tools.alazjit.driver import (
+            DEFAULT_PATHS as JIT_PATHS,
+            jit_paths,
+        )
+
+        _jit_t0 = time.perf_counter()
+        jit_findings = len(jit_paths(list(JIT_PATHS), tree_mode=True))
+        jit_runtime_s = round(time.perf_counter() - _jit_t0, 2)
+    except Exception:  # repo layout unavailable (installed wheel): skip
+        jit_findings, jit_runtime_s = -1, -1.0
+
     metric, unit = _metric_for(args)
     out = {
         "metric": metric,
@@ -906,6 +924,8 @@ def bench_ingest(args) -> dict:
         "race_findings": race_findings,
         "race_runtime_s": race_runtime_s,
         "nat_findings": nat_findings,
+        "jit_findings": jit_findings,
+        "jit_runtime_s": jit_runtime_s,
         "stage_latency": stage_latency,
         "trace_overhead_pct": round(trace_overhead_pct, 2),
         # score-plane cost + clean-trace drift silence (ISSUE 13): the

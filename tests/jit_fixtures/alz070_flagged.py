@@ -1,0 +1,59 @@
+"""ALZ070 flagged: retrace hazards — uncached construction in a method
+body, an uncached maker re-invoked per loop iteration (both the
+syntactic loop and the transitive loop-tainted shape that produced the
+real trainstep finding), and a shape-valued scalar fed to a static arg.
+"""
+import functools
+
+import jax
+
+CFG = {"d": 8}
+
+
+def _apply(params, batch):
+    return params
+
+
+class Scorer:
+    def score(self, params, batch):
+        fn = jax.jit(_apply)  # alz-expect: ALZ070
+        return fn(params, batch)
+
+
+def make_step(cfg):
+    @jax.jit
+    def step(params, batch):
+        return params
+
+    return step
+
+
+def make_leg_step(cfg):
+    @jax.jit
+    def leg_step(params, batch):
+        return params
+
+    return leg_step
+
+
+def run_leg(cfg):
+    step = make_leg_step(cfg)  # alz-expect: ALZ070
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def make_pad(d):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def pad(x, n):
+        return x
+
+    return pad
+
+
+def main(params, batches, x):
+    for cfg in [CFG, CFG]:
+        step = make_step(cfg)  # alz-expect: ALZ070
+        run_leg(cfg)
+        step(params, batches)
+    pad = make_pad(8)
+    return pad(x, x.shape[0])  # alz-expect: ALZ070

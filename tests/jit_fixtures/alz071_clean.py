@@ -1,0 +1,25 @@
+"""ALZ071 clean twin: helpers branch on shapes and None-ness (static
+under tracing) or select with ``jnp.where`` — no concretization."""
+import jax
+import jax.numpy as jnp
+
+
+def _select(x):
+    return jnp.where(x > 0, x, -x)
+
+
+def _by_shape(x):
+    if x.shape[0] > 4:
+        return x[:4]
+    return x
+
+
+def _maybe(x, bias):
+    if bias is None:
+        return x
+    return x + bias
+
+
+@jax.jit
+def score_fn(params, x, bias):
+    return _select(x) + _by_shape(x) + _maybe(x, bias)

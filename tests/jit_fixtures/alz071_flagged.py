@@ -1,0 +1,22 @@
+"""ALZ071 flagged: helpers reached from a traced function branch on a
+device value — the interprocedural ConcretizationTypeError shape the
+per-file rules cannot see (the ``if``/``while`` live two calls away
+from the ``jax.jit``)."""
+import jax
+
+
+def _select(x):
+    if x > 0:  # alz-expect: ALZ071
+        return x
+    return -x
+
+
+def _norm(y):
+    while y > 1.0:  # alz-expect: ALZ071
+        y = y / 2.0
+    return y
+
+
+@jax.jit
+def score_fn(params, x):
+    return _select(x) + _norm(x)

@@ -1,0 +1,35 @@
+"""ALZ072 flagged: host-sync discipline violations — a hard sync buried
+in a helper reachable from the staging path, plus a readback and an
+implicit ``__bool__`` on a jitted result inside the dispatch loop
+(§3n: sync at staging and finish only)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def score_fn(x):
+    return x
+
+
+def _pull(y):
+    return y.block_until_ready()  # alz-expect: ALZ072
+
+
+def stage_scores(b):
+    y = score_fn(b)
+    return _pull(y)
+
+
+def finish_all(ys):
+    return ys
+
+
+def drive(batches):
+    outs = []
+    for b in batches:
+        t = stage_scores(b)
+        host = np.asarray(t)  # alz-expect: ALZ072
+        r = score_fn(b)
+        if r:  # alz-expect: ALZ072
+            outs.append(host)
+    return finish_all(outs)

@@ -1,0 +1,22 @@
+"""ALZ073 clean twin: explicit f32 everywhere inside the traced
+closure; bare ``float`` only in host scope (accounting code outside the
+closure is f64-fine)."""
+import jax
+import numpy as np
+
+
+def _mask(n):
+    return np.zeros(n, dtype=np.float32)
+
+
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+@jax.jit
+def score_fn(x):
+    return _cast(x, np.float32) * _mask(len(x))
+
+
+def summarize(losses):
+    return float(sum(losses))  # host scope: not in the traced closure

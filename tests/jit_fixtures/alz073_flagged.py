@@ -1,0 +1,23 @@
+"""ALZ073 flagged: f64 entering the traced closure through helpers —
+a dtype-less numpy constructor, an ``.astype(float)`` (Python float IS
+float64), and an explicit ``np.float64`` — each one a silent upcast
+the TPU will pay for."""
+import jax
+import numpy as np
+
+
+def _mask(n):
+    return np.zeros(n)  # alz-expect: ALZ073
+
+
+def _cast(x):
+    return x.astype(float)  # alz-expect: ALZ073
+
+
+def _bias(n):
+    return np.ones(n, dtype=np.float64)  # alz-expect: ALZ073
+
+
+@jax.jit
+def score_fn(x):
+    return _cast(x) * _mask(len(x)) + _bias(len(x))

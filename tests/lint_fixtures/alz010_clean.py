@@ -23,3 +23,16 @@ class Table:
 
     def peek(self):
         return len(self._rows)  # alazlint: disable=ALZ010 -- racy size gauge is advisory only
+
+    def flush(self, timeout_s):
+        # bounded-acquire region (acquire before try, release in
+        # finally) counts as holding the lock — the `with`-only
+        # precision bound, closed by ISSUE 19
+        if not self._lock.acquire(timeout=timeout_s):  # alazlint: disable=ALZ012 -- bounded acquire (`with` can't express timeout=); released in the finally
+            return False
+        try:
+            self._rows.append("flush")
+            self._count += 1
+        finally:
+            self._lock.release()
+        return True

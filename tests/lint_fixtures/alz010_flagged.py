@@ -19,3 +19,10 @@ class Table:
     def register(self, metrics):
         with self._lock:
             metrics.gauge("rows", lambda: self._count)  # alz-expect: ALZ010
+
+    def drain(self):
+        self._lock.acquire()  # alazlint: disable=ALZ012 -- fixture: exercising the manual region; released two lines down
+        rows = list(self._rows)  # inside the manual region: held
+        self._lock.release()
+        self._count -= len(rows)  # alz-expect: ALZ010
+        return rows

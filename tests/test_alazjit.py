@@ -1,0 +1,256 @@
+"""alazjit — device-plane static analysis (ISSUE 19 tentpole).
+
+Four halves, mirroring the other tier-1 analysis heads:
+
+1. Fixture corpus — every hazard rule (ALZ070-ALZ073) proven by a
+   flagged fixture (expected findings marked inline with
+   ``# alz-expect: ALZ07x``, asserted by code AND line) and a clean
+   twin exercising the legal counterpart. The flagged ALZ070 fixture
+   regression-locks the TRUE-finding shape this PR fixed in
+   ``train/trainstep.py``: an uncached maker reached transitively from
+   a scenario-sweep loop.
+
+2. Golden surface (ALZ074) — the committed
+   ``resources/specs/jit_surface.json`` must be a byte-fixpoint of
+   discovery over the real tree, drift must anchor at the REAL site
+   that moved (not at the JSON), and every ``STEADY_STATE_BUDGETS``
+   key must name a discovered wrapped fn.
+
+3. Self-enforcement — ``jit_paths(DEFAULT_PATHS, tree_mode=True)``
+   (exactly what ``make jit`` runs) must be clean.
+
+4. Runtime regression locks — the jit-cache identities the ALZ070
+   fixes established in trainstep (cached optimizer, cached makers)
+   hold at import time, so a revert re-fails tier-1 even if the
+   analyzer itself is disarmed.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from tools.alazjit import jit_paths, jit_source
+from tools.alazjit.driver import DEFAULT_PATHS, main as alazjit_main
+from tools.alazjit import jitgolden
+from tools.alazjit.jitmodel import JitModel
+from tools.alazlint.core import parse_files
+from tools.alazlint.rules import RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "jit_fixtures"
+
+_EXPECT_RE = re.compile(r"alz-expect:\s*(ALZ\d{3})")
+
+PAIRED_CODES = ["ALZ070", "ALZ071", "ALZ072", "ALZ073"]
+
+
+def _expected(path: Path) -> set:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _EXPECT_RE.finditer(line):
+            out.add((i, m.group(1)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def tree_model():
+    """ONE discovery pass over the real tree (the expensive part) shared
+    by the golden-surface tests."""
+    ctxs, parse_findings = parse_files(list(DEFAULT_PATHS))
+    assert parse_findings == []
+    return JitModel(ctxs)
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("code", PAIRED_CODES)
+    def test_flagged_fixture_findings_match_exactly(self, code):
+        path = FIXTURES / f"{code.lower()}_flagged.py"
+        expected = _expected(path)
+        assert expected, f"{path.name} carries no alz-expect markers"
+        got = {
+            (f.line, f.code)
+            for f in jit_source(str(path), path.read_text())
+        }
+        assert got == expected
+
+    @pytest.mark.parametrize("code", PAIRED_CODES)
+    def test_clean_fixture_is_clean(self, code):
+        path = FIXTURES / f"{code.lower()}_clean.py"
+        findings = jit_source(str(path), path.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+    def test_transitive_loop_taint_anchors_at_the_maker_call(self):
+        """The true-finding shape from trainstep: `run_leg` calls an
+        uncached maker ONCE syntactically, but `main` loops over
+        `run_leg`, so the maker is re-invoked (and the jit cache
+        re-missed) per iteration. The finding must anchor inside
+        `run_leg`, where the fix (lru_cache the maker) goes."""
+        path = FIXTURES / "alz070_flagged.py"
+        src = path.read_text()
+        findings = jit_source(str(path), src)
+        lines = src.splitlines()
+        in_run_leg = [
+            f
+            for f in findings
+            if f.code == "ALZ070"
+            and "make_leg_step" in lines[f.line - 1]
+        ]
+        assert len(in_run_leg) == 1
+        assert "loop" in in_run_leg[0].message
+
+    def test_rule_catalog_registers_the_jit_head(self):
+        for code in PAIRED_CODES + ["ALZ074"]:
+            assert code in RULES, f"fixture pair exists for unregistered {code}"
+
+
+class TestGoldenSurface:
+    def test_committed_surface_is_a_byte_fixpoint(self, tree_model):
+        live = jitgolden.render(jitgolden.compute_surface(tree_model))
+        assert live == jitgolden.SURFACE_GOLDEN.read_text(), (
+            "jit_surface.json is stale — regenerate with `make specs` "
+            "and review the diff"
+        )
+
+    def test_surface_covers_every_budgeted_fn(self, tree_model):
+        # STEADY_STATE_BUDGETS parsed straight out of sanitize/retrace.py
+        assert tree_model.budgets, "budget dict not discovered"
+        missing = set(tree_model.budgets) - tree_model.site_fn_names()
+        assert missing == set(), (
+            f"budgeted fns with no discovered jit site: {sorted(missing)}"
+        )
+
+    def test_stale_budget_key_is_a_finding(self, tree_model):
+        tree_model.budgets["ghost_fn_never_traced"] = 4
+        try:
+            findings = list(jitgolden.check_budget_coverage(tree_model))
+        finally:
+            del tree_model.budgets["ghost_fn_never_traced"]
+        assert [f.code for f in findings] == ["ALZ074"]
+        assert "ghost_fn_never_traced" in findings[0].message
+        # anchored at the budget dict itself, not at some jit site
+        assert findings[0].path.endswith("retrace.py")
+        assert findings[0].line == tree_model.budget_line
+
+    def test_dropped_golden_site_anchors_at_the_real_site(
+        self, tree_model, tmp_path
+    ):
+        golden = json.loads(jitgolden.SURFACE_GOLDEN.read_text())
+        dropped = sorted(golden["sites"])[0]
+        site = tree_model.by_key[dropped]
+        del golden["sites"][dropped]
+        p = tmp_path / "jit_surface.json"
+        p.write_text(json.dumps(golden))
+        findings = [
+            f
+            for f in jitgolden.check_alz074(tree_model, golden_path=p)
+            if dropped in f.message
+        ]
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.code == "ALZ074" and "not in the golden" in f.message
+        assert (f.path, f.line) == (site.ctx.path, site.line)
+
+    def test_static_arg_drift_anchors_at_the_real_site(
+        self, tree_model, tmp_path
+    ):
+        golden = json.loads(jitgolden.SURFACE_GOLDEN.read_text())
+        # mutate a site's recorded static-arg set — the compile-cache
+        # key family — so golden and live disagree on exactly that field
+        key = sorted(golden["sites"])[0]
+        golden["sites"][key]["static_args"] = ["not_the_real_static_set"]
+        p = tmp_path / "jit_surface.json"
+        p.write_text(json.dumps(golden))
+        findings = [
+            f
+            for f in jitgolden.check_alz074(tree_model, golden_path=p)
+            if f.code == "ALZ074" and key in f.message
+        ]
+        assert len(findings) == 1
+        f = findings[0]
+        assert "static_args" in f.message and "drifted" in f.message
+        site = tree_model.by_key[key]
+        assert (f.path, f.line) == (site.ctx.path, site.line)
+
+    def test_stale_golden_site_and_missing_golden(self, tree_model, tmp_path):
+        golden = json.loads(jitgolden.SURFACE_GOLDEN.read_text())
+        golden["sites"]["ghost.mod:gone/fn"] = {"fn": "fn"}
+        p = tmp_path / "jit_surface.json"
+        p.write_text(json.dumps(golden))
+        findings = [
+            f
+            for f in jitgolden.check_alz074(tree_model, golden_path=p)
+            if "ghost.mod:gone/fn" in f.message
+        ]
+        assert len(findings) == 1
+        assert "no longer exists" in findings[0].message
+        # a stale entry anchors at the golden file (nothing in the tree
+        # to point at), line 1
+        assert (findings[0].path, findings[0].line) == (str(p), 1)
+        missing = [
+            f
+            for f in jitgolden.check_alz074(
+                tree_model, golden_path=tmp_path / "nope.json"
+            )
+            if "missing or unreadable" in f.message
+        ]
+        assert [f.code for f in missing] == ["ALZ074"]
+
+
+class TestSelfEnforcement:
+    def test_default_tree_is_jit_clean(self):
+        # exactly what `make jit` runs: hazard rules + golden drift
+        findings = jit_paths(list(DEFAULT_PATHS), tree_mode=True)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_json_mode_and_exit_codes(self, capsys):
+        clean = FIXTURES / "alz070_clean.py"
+        rc = alazjit_main([str(clean), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["count"] == 0 and out["findings"] == []
+        flagged = FIXTURES / "alz070_flagged.py"
+        rc = alazjit_main([str(flagged), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and out["count"] == len(out["findings"]) > 0
+        assert {"code", "message", "path", "line", "col"} <= set(
+            out["findings"][0]
+        )
+
+
+class TestTrainstepCacheIdentity:
+    """Runtime regression locks for the two TRUE ALZ070 findings fixed
+    in this PR: the scenario sweep used to rebuild its optimizer and
+    train-step per leg, defeating jit caching across the whole sweep."""
+
+    def test_optimizer_is_cached_by_hyperparams(self):
+        from alaz_tpu.train.trainstep import _adamw
+
+        assert _adamw(3e-3) is _adamw(3e-3)
+
+    def test_train_step_maker_is_cached(self):
+        from alaz_tpu.config import ModelConfig
+        from alaz_tpu.train.trainstep import _adamw, make_train_step
+
+        cfg = ModelConfig(model="gat")
+        opt = _adamw(3e-3)
+        assert make_train_step(cfg, opt) is make_train_step(cfg, opt)
+
+    def test_unrolled_step_maker_is_cached(self):
+        from alaz_tpu.config import ModelConfig
+        from alaz_tpu.train.trainstep import _adamw, _make_unrolled_step
+
+        cfg = ModelConfig(model="tgn", hidden_dim=32, use_pallas=False)
+        opt = _adamw(3e-3)
+        assert _make_unrolled_step(cfg, opt, 10.0) is _make_unrolled_step(
+            cfg, opt, 10.0
+        )
+
+    def test_score_fn_maker_is_cached(self):
+        from alaz_tpu.config import ModelConfig
+        from alaz_tpu.train.trainstep import make_score_fn
+
+        cfg = ModelConfig(model="gat")
+        assert make_score_fn(cfg) is make_score_fn(cfg)

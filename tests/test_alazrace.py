@@ -292,6 +292,71 @@ class TestMutatingCallWrites:
         assert got == {(4, "ALZ053")}
 
 
+class TestManualAcquireRegions:
+    """The v1 `with`-only precision bound, closed (ISSUE 19 satellite):
+    bare bounded ``acquire()`` regions count in the lockset walk. The
+    close-wave merge shape — ``if not lock.acquire(timeout=...):
+    return`` before a ``try``, mutate inside, ``release()`` in the
+    ``finally`` — reads as locked: the field comes out CONSISTENTLY
+    guarded, so the surviving finding is ALZ052's "annotate it" (the
+    exact outcome the real ``batches`` field produced), not a phantom
+    ALZ051. A touch AFTER the release statement is back outside the
+    region and races for real."""
+
+    _HEAD = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.d = {}\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._worker_loop).start()\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return dict(self.d)\n"
+    )
+    _MAIN = (
+        "def main():\n"
+        "    c = C()\n"
+        "    c.start()\n"
+        "    c.read()\n"
+    )
+
+    def test_bounded_acquire_region_counts_as_locked(self):
+        src = self._HEAD + (
+            "    def _worker_loop(self):\n"
+            "        if not self._lock.acquire(timeout=1.0):  # alazlint: disable=ALZ012 -- bounded acquire; released in the finally\n"
+            "            return\n"
+            "        try:\n"
+            "            self.d.update({'k': 1})\n"
+            "        finally:\n"
+            "            self._lock.release()\n"
+        ) + self._MAIN
+        got = {(f.line, f.code) for f in race_source("t.py", src)}
+        assert got == {(5, "ALZ052")}  # consistently guarded -> annotate
+
+    def test_bare_unbounded_acquire_region_counts_too(self):
+        src = self._HEAD + (
+            "    def _worker_loop(self):\n"
+            "        self._lock.acquire()  # alazlint: disable=ALZ012 -- fixture: manual region, released below\n"
+            "        self.d.update({'k': 1})\n"
+            "        self._lock.release()\n"
+        ) + self._MAIN
+        got = {(f.line, f.code) for f in race_source("t.py", src)}
+        assert got == {(5, "ALZ052")}  # consistently guarded -> annotate
+
+    def test_touch_after_release_is_outside_the_region(self):
+        src = self._HEAD + (
+            "    def _worker_loop(self):\n"
+            "        self._lock.acquire()  # alazlint: disable=ALZ012 -- fixture: manual region, released below\n"
+            "        self.d.update({'k': 1})\n"
+            "        self._lock.release()\n"
+            "        self.d.update({'k': 2})\n"
+        ) + self._MAIN
+        got = {(f.line, f.code) for f in race_source("t.py", src)}
+        assert got == {(15, "ALZ051")}
+
+
 _MOD_A = (
     "class Tally:\n"
     "    def __init__(self):\n"

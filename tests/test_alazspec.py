@@ -180,16 +180,22 @@ class TestSpecRegeneration:
         """`make specs` must be a fixpoint on a clean tree — any diff a
         regen produces IS a contract change that needs review."""
         out = specfiles.write_specs(tmp_path / "specs")
-        # metrics.json / threads.json / nat_offsets.json sit beside the
-        # spec set but are alazflow's / alazrace's / alaznat's goldens
-        # (`--write-metrics` / `--write-threads` / `--write-offsets` own
-        # them), so the spec regen doesn't emit them
+        # metrics.json / threads.json / nat_offsets.json /
+        # jit_surface.json sit beside the spec set but are alazflow's /
+        # alazrace's / alaznat's / alazjit's goldens (`--write-metrics`
+        # / `--write-threads` / `--write-offsets` / `--write-surface`
+        # own them), so the spec regen doesn't emit them
         assert len(out) == len(
             [
                 p
                 for p in SPECS.glob("*.json")
                 if p.name
-                not in ("metrics.json", "threads.json", "nat_offsets.json")
+                not in (
+                    "metrics.json",
+                    "threads.json",
+                    "nat_offsets.json",
+                    "jit_surface.json",
+                )
             ]
         )
         for fresh in out:

@@ -267,6 +267,7 @@ class TestSelfEnforcement:
                 str(REPO / "tools" / "alazflow"),
                 str(REPO / "tools" / "alazrace"),
                 str(REPO / "tools" / "alaznat"),
+                str(REPO / "tools" / "alazjit"),
             ]
         )
         assert findings == [], "\n".join(f.render() for f in findings)

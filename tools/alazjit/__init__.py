@@ -1,0 +1,12 @@
+"""alazjit: device-plane static analysis — the seventh tier-1 head.
+
+Discovers the whole jitted surface (every jit/vmap/pmap/shard_map
+construction site) over the shared project model, lints the
+retrace/host-sync/dtype hazards the CompileEventPlane can only report
+after they bite (ALZ070-ALZ073), and pins the discovered surface as a
+reviewed golden (resources/specs/jit_surface.json, ALZ074).
+"""
+
+from tools.alazjit.driver import jit_paths, jit_source, main
+
+__all__ = ["jit_paths", "jit_source", "main"]

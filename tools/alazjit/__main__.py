@@ -1,0 +1,8 @@
+"""``python -m tools.alazjit`` — the `make jit` entry point."""
+
+import sys
+
+from tools.alazjit.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""alazjit driver: parse → jit-surface discovery → device-plane rules →
+suppression → report. Mirrors the alazrace/alaznat driver contract
+(same Finding type, same ``# alazlint: disable=ALZ07x -- why`` escape
+hatch, same exit codes) so `make jit` and tier-1 read one uniform
+finding stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.alazlint.core import (
+    FileContext,
+    Finding,
+    filter_disables,
+    parse_context,
+    parse_files,
+)
+from tools.alazjit import jitgolden, jitrules
+from tools.alazjit.jitmodel import JitModel
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# what `make jit` / bench's jit_findings sweep: the device plane plus
+# the analyzer itself (self-enforcement, the alazlint precedent)
+DEFAULT_PATHS = (
+    str(REPO / "alaz_tpu"),
+    str(REPO / "tools" / "alazjit"),
+)
+
+_parse = parse_files  # the shared driver front end (tools.alazlint.core)
+
+
+def _run_rules(ctxs: List[FileContext], tree_mode: bool) -> List[Finding]:
+    """The four rule passes over ONE shared jit model (discovery + the
+    reachability closure are the expensive part of a run). ``tree_mode``
+    arms the golden-surface drift check (ALZ074), which only makes sense
+    over the full tree — fixture/single-file runs skip it so a fixture
+    pair proves exactly its own rule."""
+    jm = JitModel(ctxs)
+    raw: List[Finding] = []
+    raw.extend(jitrules.check_alz070(jm))
+    raw.extend(jitrules.check_alz071(jm))
+    raw.extend(jitrules.check_alz072(jm))
+    raw.extend(jitrules.check_alz073(jm))
+    if tree_mode:
+        raw.extend(jitgolden.check_alz074(jm))
+    return filter_disables(raw, ctxs)
+
+
+def jit_paths(paths: Sequence[str], tree_mode: bool = False) -> List[Finding]:
+    ctxs, findings = _parse(paths)
+    findings.extend(_run_rules(ctxs, tree_mode))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def jit_source(path: str, source: str) -> List[Finding]:
+    """Analyze one file's source (fixture tests); the whole-program
+    rules run scoped to this single file, golden-surface drift off."""
+    ctx = parse_context(path, source)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    return _run_rules([ctx], tree_mode=False)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if "--write-surface" in argv:
+        argv = [a for a in argv if a != "--write-surface"]
+        # regen MUST parse the same tree the drift check scans, or an
+        # ALZ074 finding in the analyzer's own package could prescribe
+        # a regen command that cannot clear it
+        ctxs, _ = _parse(argv or list(DEFAULT_PATHS))
+        path = jitgolden.write_surface_golden(JitModel(ctxs))
+        print(f"wrote {path}")
+        return 0
+    # the golden-surface drift check is a statement about the WHOLE
+    # tree — it runs on the default invocation (`make jit`); explicit
+    # paths get the hazard rules only, so scanning a fixture doesn't
+    # re-litigate the tree-global golden
+    paths = argv or list(DEFAULT_PATHS)
+    findings = jit_paths(paths, tree_mode=not argv)
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"alazjit: {len(findings)} finding(s)")
+    return 1 if findings else 0

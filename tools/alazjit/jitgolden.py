@@ -1,0 +1,158 @@
+"""The golden jit-surface spec: ``resources/specs/jit_surface.json``
+and ALZ074 (surface drift + retrace-budget coverage).
+
+The spec pins what discovery FOUND — site key → (wrapped fn, transform
+chain, static args, maker caching, entry-surface reachability, in-dtype
+policy, cache-key family, retrace budget) — the same way threads.json
+pins the thread topology: regenerated deterministically (``make specs``
+/ ``python -m tools.alazjit --write-surface``), committed, byte-fixpoint
+under regen. A new jit entry point, a static-arg set change, or a maker
+losing its cache shows up as a one-line JSON diff in the PR that caused
+it — not as a silent growth of the compile cache discovered in
+BENCH_HISTORY three PRs later.
+
+ALZ074 also closes the loop on ``sanitize/retrace.py``'s
+``STEADY_STATE_BUDGETS``: every budgeted fn name must match a
+discovered site's wrapped fn, which retires that hand-maintained dict
+as a drift risk — renaming a traced fn without updating the budget (or
+the budget outliving the fn) is now a finding, not a silently-ignored
+watch entry.
+
+Site keys are position-free (module:enclosing_fn/wrapped_fn), so the
+committed golden does not churn when unrelated edits move lines.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, List
+
+from tools.alazlint.core import Finding
+from tools.alazjit.jitmodel import JitModel
+
+REPO = Path(__file__).resolve().parent.parent.parent
+SURFACE_GOLDEN = REPO / "resources" / "specs" / "jit_surface.json"
+
+_REGEN = "`python -m tools.alazjit --write-surface` (or `make specs`)"
+
+
+def compute_surface(jm: JitModel) -> dict:
+    sites = {}
+    for s in jm.sites:
+        sites[s.key] = {
+            "fn": s.fn_name,
+            "transforms": list(s.transforms),
+            "static_args": list(s.static_args),
+            "cached_maker": s.cached_maker,
+            "reachable": s.reachable,
+            "in_dtypes": s.in_dtypes(),
+            "cache_key": s.cache_key_family(),
+            "budget": jm.budgets.get(s.fn_name),
+        }
+    return {"sites": dict(sorted(sites.items()))}
+
+
+def render(surface: dict) -> str:
+    return json.dumps(surface, indent=2, sort_keys=True) + "\n"
+
+
+def write_surface_golden(jm: JitModel, path: Path = SURFACE_GOLDEN) -> Path:
+    path.write_text(render(compute_surface(jm)))
+    return path
+
+
+def check_budget_coverage(jm: JitModel) -> Iterable[Finding]:
+    """Every STEADY_STATE_BUDGETS key must name a discovered wrapped fn
+    — the static coverage that retires the dict as a drift risk."""
+    if not jm.budgets:
+        return
+    fn_names = jm.site_fn_names()
+    ctx = jm.budget_ctx
+    for bkey in sorted(jm.budgets):
+        if bkey not in fn_names:
+            yield Finding(
+                "ALZ074",
+                f"STEADY_STATE_BUDGETS names `{bkey}` but jit-surface "
+                "discovery found no site wrapping a fn of that name — "
+                "the budget dict is stale (fn renamed/retired) or "
+                "discovery regressed; fix the dict or the traced fn "
+                "name (CompileWatcher attributes compiles by name)",
+                ctx.path if ctx is not None else "<budgets>",
+                jm.budget_line or 1,
+                0,
+            )
+
+
+def check_alz074(
+    jm: JitModel,
+    golden_path: Path = SURFACE_GOLDEN,
+) -> Iterable[Finding]:
+    out: List[Finding] = []
+    out.extend(check_budget_coverage(jm))
+    live = compute_surface(jm)["sites"]
+    try:
+        golden = json.loads(golden_path.read_text()).get("sites", {})
+    except (OSError, json.JSONDecodeError):
+        out.append(
+            Finding(
+                "ALZ074",
+                f"golden jit-surface spec {golden_path.name} missing or "
+                f"unreadable — regenerate with {_REGEN} and commit",
+                str(golden_path),
+                1,
+                0,
+            )
+        )
+        return out
+    for key in sorted(set(live) - set(golden)):
+        site = jm.by_key[key]
+        out.append(
+            Finding(
+                "ALZ074",
+                f"jit site `{key}` is not in the golden surface spec "
+                f"({golden_path.name}) — the jit surface grew; "
+                f"regenerate with {_REGEN} and REVIEW the diff (a new "
+                "entry point is a compile-cache design event, not a "
+                "drive-by)",
+                site.ctx.path,
+                site.line,
+                site.col,
+            )
+        )
+    for key in sorted(set(golden) - set(live)):
+        out.append(
+            Finding(
+                "ALZ074",
+                f"golden jit site `{key}` no longer exists in the tree "
+                f"— the committed surface is stale; regenerate with "
+                f"{_REGEN} and review what retired it",
+                str(golden_path),
+                1,
+                0,
+            )
+        )
+    for key in sorted(set(golden) & set(live)):
+        if golden[key] != live[key]:
+            site = jm.by_key[key]
+            drifted = sorted(
+                f
+                for f in set(golden[key]) | set(live[key])
+                if golden[key].get(f) != live[key].get(f)
+            )
+            out.append(
+                Finding(
+                    "ALZ074",
+                    f"surface entry for `{key}` drifted in "
+                    f"{', '.join(drifted)}: golden "
+                    f"{ {f: golden[key].get(f) for f in drifted} } vs live "
+                    f"{ {f: live[key].get(f) for f in drifted} } — a "
+                    "static-arg set, transform chain, or caching change "
+                    f"moves the compile-cache key; regenerate with {_REGEN} "
+                    "and review",
+                    site.ctx.path,
+                    site.line,
+                    site.col,
+                )
+            )
+    return out

@@ -1,0 +1,677 @@
+"""The jit-surface model: discovery of every transform construction
+site, entry-surface reachability, and the retrace-budget link.
+
+Discovery is *total*: every ``jax.jit`` / ``jax.vmap`` / ``jax.pmap`` /
+``shard_map`` construction in the scanned tree becomes one ``JitSite``,
+whether or not the entry surface reaches it — the golden surface spec
+(jitgolden) then records reachability as a per-site fact instead of
+silently narrowing the scan. Nested transform chains collapse onto one
+site (``jax.jit(jax.vmap(f))`` is a single site with transforms
+``["jit", "vmap"]``), and a by-name wrap whose resolved def carries its
+own transform decorators extends the chain (``jax.jit(run)`` where
+``run`` is ``@partial(shard_map, ...)``-decorated is
+``["jit", "shard_map"]``).
+
+Site keys are **position-free** (``module:enclosing_fn/wrapped_fn``,
+with a ``#N`` ordinal only on collision) so the committed golden does
+not churn when unrelated edits move line numbers.
+
+Reachability mirrors alazflow: a worklist closure from the entry
+surface — ``cmd_*`` / ``main`` functions, every method of a
+``*Service`` class, and the ``train*`` / ``bench*`` families — through
+resolved calls, callback references (``target=self._worker``), project
+constructor calls, and nested defs. The closure is deliberately
+conservative (a reachable function's nested defs are all reachable).
+
+The budget link: ``sanitize/retrace.py``'s ``STEADY_STATE_BUDGETS``
+keys are traced-fn *names* (CompileWatcher attributes compile events by
+name). ``parse_budgets`` lifts that dict out of the scanned AST so the
+ALZ074 coverage check can retire it as a hand-maintained drift risk:
+every budgeted name must match a discovered site's wrapped fn.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext
+from tools.alazlint.jax_rules import (
+    _call_transform_name,
+    _establishes_compute_dtype,
+    _static_names_from_call,
+    _str_literals,
+)
+from tools.alazlint.program import (
+    FunctionInfo,
+    ProgramModel,
+    _has_caching_decorator,
+)
+
+# the jit *surface*: transforms that stage a callable for the device.
+# checkpoint/remat rewrite an already-traced region and never form a
+# standalone entry, so they stay out of the surface (jax_rules still
+# treats them as tracing scopes for the per-file rules).
+SURFACE_TRANSFORMS = ("jit", "vmap", "pmap", "shard_map")
+
+# jit/pmap are the compile-cache owners: a fresh construction of one of
+# these is a fresh empty cache (ALZ070); a bare vmap/shard_map only
+# costs a retrace of itself
+_CACHE_OWNERS = ("jit", "pmap")
+
+# loop contexts for the per-iteration taint: comprehensions included —
+# `[run(n) for n in names]` re-invokes exactly like a for body
+_LOOP_NODES = (
+    ast.For,
+    ast.While,
+    ast.AsyncFor,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+def _walk_shallow(fn: ast.AST) -> Iterable[ast.AST]:
+    """Body nodes of ``fn`` without descending into nested def/lambda
+    bodies (the alazflow walk convention)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # a nested def's body runs in its own scope
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _surface_name(call: ast.Call) -> Optional[str]:
+    name = _call_transform_name(call)
+    return name if name in SURFACE_TRANSFORMS else None
+
+
+def _wrapped_expr(call: ast.Call) -> Optional[ast.AST]:
+    """The fn-expression a transform call wraps (one step, no
+    flattening): first positional arg, or the second for
+    ``functools.partial(transform, fn)``."""
+    fn_name = getattr(call.func, "attr", getattr(call.func, "id", None))
+    args = call.args
+    if fn_name == "partial":
+        return args[1] if len(args) > 1 else None
+    return args[0] if args else None
+
+
+def _decorator_transforms(fn: ast.AST) -> List[Tuple[str, Optional[ast.Call]]]:
+    """(transform name, decorator call | None) per surface-transform
+    decorator on ``fn``, in source order."""
+    out: List[Tuple[str, Optional[ast.Call]]] = []
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            name = _surface_name(dec)
+            if name is not None:
+                out.append((name, dec))
+        elif isinstance(dec, (ast.Attribute, ast.Name)):
+            nm = dec.attr if isinstance(dec, ast.Attribute) else dec.id
+            if nm in SURFACE_TRANSFORMS:
+                out.append((nm, None))
+    return out
+
+
+@dataclass
+class JitSite:
+    """One transform construction site: the unit the golden pins."""
+
+    key: str  # "<module>:<enclosing fn>/<wrapped fn>" (+"#N" on collision)
+    mod: str
+    fn_name: str  # wrapped fn name ("<lambda>" for lambdas)
+    transforms: List[str]  # outermost-first, e.g. ["jit", "vmap"]
+    ctx: FileContext = field(repr=False)
+    line: int = 0
+    col: int = 0
+    call: Optional[ast.Call] = field(default=None, repr=False)
+    fn_node: Optional[ast.AST] = field(default=None, repr=False)  # resolved def
+    static_args: List[str] = field(default_factory=list)
+    cached_maker: bool = False
+    reachable: bool = False
+    encl_qualname: Optional[str] = None  # None for module-level sites
+
+    @property
+    def is_entry(self) -> bool:
+        """Does this site own a compile cache (jit/pmap in the chain)?"""
+        return any(t in _CACHE_OWNERS for t in self.transforms)
+
+    def in_dtypes(self) -> str:
+        """Dtype policy of the wrapped fn: 'polymorphic' when it works
+        against a compute dtype (dtype param / compute_dtype() /
+        .astype(dtype)), 'inherited' otherwise (dtypes ride in on the
+        arguments), 'opaque' when the wrapped fn did not resolve."""
+        node = self.fn_node
+        if node is None:
+            return "opaque"
+        if isinstance(node, ast.FunctionDef) and _establishes_compute_dtype(node):
+            return "polymorphic"
+        return "inherited"
+
+    def cache_key_family(self) -> str:
+        """The compile-cache key family the site implies: 'cfg×shape'
+        when a cached maker closes config into the trace (one cache per
+        distinct config), plain 'shape' otherwise; static argnames ride
+        the key too and are listed in their own golden field."""
+        return "cfg×shape" if self.cached_maker else "shape"
+
+
+def parse_budgets(
+    ctxs: Sequence[FileContext],
+) -> Tuple[Dict[str, int], Optional[FileContext], int]:
+    """Lift STEADY_STATE_BUDGETS out of the scanned sanitize/retrace.py
+    AST: {traced fn name -> budget}, plus the declaring ctx and line for
+    finding anchors. Empty when the module isn't in the scan (fixtures)."""
+    for ctx in ctxs:
+        if not ctx.path.endswith("retrace.py"):
+            continue
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                names = [stmt.target.id]
+            else:
+                continue
+            if "STEADY_STATE_BUDGETS" not in names:
+                continue
+            if not isinstance(stmt.value, ast.Dict):
+                continue
+            out: Dict[str, int] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                ):
+                    out[k.value] = v.value
+            return out, ctx, stmt.lineno
+    return {}, None, 0
+
+
+class JitModel:
+    """Sites + reachability + budgets over one invocation's files."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.ctxs = list(ctxs)
+        self.model = ProgramModel(ctxs)
+        self.budgets, self.budget_ctx, self.budget_line = parse_budgets(ctxs)
+        self.reachable: Set[str] = self._close_reachable()
+        self.loop_tainted: Set[str] = self._close_loop_taint()
+        self.sites: List[JitSite] = self._discover()
+        self.by_key: Dict[str, JitSite] = {s.key: s for s in self.sites}
+
+    # -- reachability -------------------------------------------------------
+
+    def _is_root(self, qn: str, info: FunctionInfo) -> bool:
+        final = qn.split(":", 1)[-1].split(".")[-1]
+        if final.startswith("cmd_") or final == "main":
+            return True
+        if final.startswith("train") or final.startswith("bench"):
+            return True
+        return info.cls is not None and info.cls.name.endswith("Service")
+
+    def _resolve_ref(
+        self,
+        ref: ast.AST,
+        mod: str,
+        info: FunctionInfo,
+        local_prefix: str,
+    ) -> Optional[str]:
+        """Function qualname a bare callback reference resolves to
+        (``target=self._worker`` / ``submit(stage_fn, ...)``)."""
+        if isinstance(ref, ast.Name):
+            for cand in (f"{local_prefix}{ref.id}", f"{mod}:{ref.id}"):
+                if cand in self.model.functions:
+                    return cand
+            target = self.model.imports.get(mod, {}).get(ref.id)
+            if target and target in self.model.functions:
+                return target
+            return None
+        if (
+            isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Name)
+            and ref.value.id == "self"
+            and info.cls is not None
+        ):
+            cinfo = self.model.classes.get(f"{mod}:{info.cls.name}")
+            if cinfo is not None:
+                return cinfo.methods.get(ref.attr)
+        return None
+
+    def _resolve_module_attr_call(
+        self, node: ast.Call, mod: str
+    ) -> Optional[str]:
+        """`tgn.make_step_fn(...)` where ``tgn`` arrived via
+        ``from alaz_tpu.models import tgn`` — the from-imported-MODULE
+        form ProgramModel.resolve_call does not chase (its import map
+        records it as `alaz_tpu.models:tgn`)."""
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)):
+            return None
+        target = self.model.imports.get(mod, {}).get(fn.value.id)
+        if target is None or ":" not in target:
+            return None
+        qn = f"{target.replace(':', '.')}:{fn.attr}"
+        return qn if qn in self.model.functions else None
+
+    def _resolve_reexport_call(
+        self, node: ast.Call, mod: str
+    ) -> Optional[str]:
+        """`train_on_batches(...)` imported via a package re-export
+        (``from alaz_tpu.train import train_on_batches`` where
+        ``train/__init__.py`` re-exports it from ``trainstep``) —
+        ProgramModel.resolve_call stops at the package's import target;
+        chase the re-export chain a few hops to the defining module."""
+        fn = node.func
+        if not isinstance(fn, ast.Name):
+            return None
+        target = self.model.imports.get(mod, {}).get(fn.id)
+        for _ in range(3):
+            if target is None or target in self.model.functions:
+                return target
+            if ":" not in target:
+                return None
+            pkg, name = target.split(":", 1)
+            target = self.model.imports.get(pkg, {}).get(name)
+        return target if target in self.model.functions else None
+
+    def resolve_call_ext(
+        self,
+        node: ast.Call,
+        mod: str,
+        cls,
+        local_prefix: str,
+    ) -> Optional[str]:
+        """ProgramModel.resolve_call plus the from-imported-module and
+        package-re-export forms — the one resolver every alazjit pass
+        shares, so the traced closure and the reachability closure see
+        the same call graph."""
+        return (
+            self.model.resolve_call(node, mod, cls, local_prefix)
+            or self._resolve_module_attr_call(node, mod)
+            or self._resolve_reexport_call(node, mod)
+        )
+
+    def _fn_edges(self, qn: str, info: FunctionInfo) -> Set[str]:
+        mod = self.model.module_of[id(info.ctx)]
+        local_prefix = qn + "."
+        out: Set[str] = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self.resolve_call_ext(node, mod, info.cls, local_prefix)
+            if target is not None:
+                out.add(target)
+            else:
+                cls_qn = self.model.resolve_class(mod, node.func)
+                if cls_qn is not None:
+                    ctor = self.model.classes[cls_qn].methods.get("__init__")
+                    if ctor is not None:
+                        out.add(ctor)
+            for ref in list(node.args) + [kw.value for kw in node.keywords]:
+                t = self._resolve_ref(ref, mod, info, local_prefix)
+                if t is not None:
+                    out.add(t)
+        # a reachable function's nested defs run on its behalf
+        out.update(
+            other for other in self.model.functions if other.startswith(local_prefix)
+        )
+        return out
+
+    def _close_reachable(self) -> Set[str]:
+        roots = {
+            qn
+            for qn, info in self.model.functions.items()
+            if self._is_root(qn, info)
+        }
+        reached = set(roots)
+        work = list(roots)
+        while work:
+            qn = work.pop()
+            info = self.model.functions.get(qn)
+            if info is None:
+                continue
+            for nxt in self._fn_edges(qn, info):
+                if nxt not in reached:
+                    reached.add(nxt)
+                    work.append(nxt)
+        return reached
+
+    def _close_loop_taint(self) -> Set[str]:
+        """Functions that run O(iterations) from the entry surface: the
+        callee of any loop-resident call site in a *reachable* function
+        is loop-called, and so (transitively) is everything it calls —
+        ``main`` looping ``run_scenario(name)`` makes the whole
+        detection leg per-iteration, three frames down. ALZ070 uses
+        this to see an uncached maker re-invoked per iteration even
+        when no loop is syntactically in sight at the maker call."""
+        seeds: Set[str] = set()
+        for qn, info in self.model.functions.items():
+            if qn not in self.reachable:
+                continue
+            mod = self.model.module_of[id(info.ctx)]
+            local_prefix = qn + "."
+            for node in _walk_shallow(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                in_loop = False
+                for anc in info.ctx.ancestors(node):
+                    if anc is info.node:
+                        break  # this function's own scope only
+                    if isinstance(anc, _LOOP_NODES):
+                        in_loop = True
+                        break
+                if not in_loop:
+                    continue
+                target = self.resolve_call_ext(node, mod, info.cls, local_prefix)
+                if target is not None:
+                    seeds.add(target)
+        tainted: Set[str] = set()
+        work = list(seeds)
+        while work:
+            qn = work.pop()
+            if qn in tainted:
+                continue
+            tainted.add(qn)
+            info = self.model.functions.get(qn)
+            if info is None:
+                continue
+            mod = self.model.module_of[id(info.ctx)]
+            local_prefix = qn + "."
+            for node in _walk_shallow(info.node):
+                if isinstance(node, ast.Call):
+                    t = self.resolve_call_ext(node, mod, info.cls, local_prefix)
+                    if t is not None and t not in tainted:
+                        work.append(t)
+            # nested defs run on the tainted fn's behalf
+            work.extend(
+                other
+                for other in self.model.functions
+                if other.startswith(local_prefix) and other not in tainted
+            )
+        return tainted
+
+    # -- discovery ----------------------------------------------------------
+
+    def _discover(self) -> List[JitSite]:
+        raw: List[JitSite] = []
+        for ctx in self.ctxs:
+            raw.extend(self._discover_file(ctx))
+        raw.sort(key=lambda s: (s.ctx.path, s.line, s.col))
+        # ordinal suffix only on key collision, in (path, line) order
+        counts: Dict[str, int] = {}
+        for s in raw:
+            counts[s.key] = counts.get(s.key, 0) + 1
+        seen: Dict[str, int] = {}
+        for s in raw:
+            if counts[s.key] > 1:
+                n = seen.get(s.key, 0) + 1
+                seen[s.key] = n
+                s.key = f"{s.key}#{n}"
+        return raw
+
+    def _discover_file(self, ctx: FileContext) -> Iterable[JitSite]:
+        mod = self.model.module_of[id(ctx)]
+
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(n.name, []).append(n)
+
+        def enclosing_fn(node: ast.AST) -> Optional[ast.AST]:
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    return anc
+            return None
+
+        def resolve_def(name: str, call: ast.Call) -> Optional[ast.AST]:
+            # same-name defs are common (every sharded maker nests a
+            # `run`): prefer the candidate sharing the call's enclosing
+            # function (the jax_rules.traced_functions convention)
+            candidates = defs_by_name.get(name, [])
+            if len(candidates) == 1:
+                return candidates[0]
+            if not candidates:
+                return None
+            home = enclosing_fn(call)
+            local = [d for d in candidates if enclosing_fn(d) is home]
+            return (local or candidates)[0]
+
+        decorator_ids: Set[int] = set()
+        for n in ast.walk(ctx.tree):
+            for dec in getattr(n, "decorator_list", []):
+                if isinstance(dec, ast.Call):
+                    decorator_ids.add(id(dec))
+
+        surface_calls = [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Call) and _surface_name(n) is not None
+        ]
+        consumed: Set[int] = set()
+        for c in surface_calls:
+            w = _wrapped_expr(c)
+            if isinstance(w, ast.Call) and _surface_name(w) is not None:
+                consumed.add(id(w))
+
+        folded_defs: Set[int] = set()  # defs whose decorators a call site absorbed
+        sites: List[JitSite] = []
+
+        for c in surface_calls:
+            if id(c) in consumed or id(c) in decorator_ids:
+                continue
+            transforms: List[str] = []
+            chain_calls: List[ast.Call] = []
+            cur: ast.AST = c
+            while isinstance(cur, ast.Call) and _surface_name(cur) is not None:
+                transforms.append(_surface_name(cur))  # type: ignore[arg-type]
+                chain_calls.append(cur)
+                cur = _wrapped_expr(cur)
+            if (
+                isinstance(cur, ast.Call)
+                and getattr(cur.func, "attr", getattr(cur.func, "id", None))
+                == "partial"
+                and cur.args
+            ):
+                # jit(partial(step, cfg=cfg)): the surface fn is step
+                cur = cur.args[0]
+            fn_node: Optional[ast.AST] = None
+            fn_name = "<unresolved>"
+            if isinstance(cur, ast.Lambda):
+                fn_node, fn_name = cur, "<lambda>"
+            elif isinstance(cur, ast.Name):
+                fn_name = cur.id
+                fn_node = resolve_def(cur.id, c)
+            elif isinstance(cur, ast.Attribute):
+                fn_name = cur.attr
+            if fn_node is not None and not isinstance(fn_node, ast.Lambda):
+                for tname, dcall in _decorator_transforms(fn_node):
+                    transforms.append(tname)
+                    if dcall is not None:
+                        chain_calls.append(dcall)
+                folded_defs.add(id(fn_node))
+            sites.append(
+                self._make_site(
+                    ctx, mod, c, fn_node, fn_name, transforms, chain_calls
+                )
+            )
+
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.FunctionDef) or id(n) in folded_defs:
+                continue
+            decs = _decorator_transforms(n)
+            if not decs:
+                continue
+            transforms = [t for t, _ in decs]
+            chain_calls = [dc for _, dc in decs if dc is not None]
+            sites.append(
+                self._make_site(ctx, mod, n, n, n.name, transforms, chain_calls)
+            )
+        return sites
+
+    def _make_site(
+        self,
+        ctx: FileContext,
+        mod: str,
+        anchor: ast.AST,
+        fn_node: Optional[ast.AST],
+        fn_name: str,
+        transforms: List[str],
+        chain_calls: List[ast.Call],
+    ) -> JitSite:
+        static: Set[str] = set()
+        static_call: Optional[ast.Call] = None
+        for cc in chain_calls:
+            if any(
+                kw.arg in ("static_argnums", "static_argnames")
+                for kw in cc.keywords
+            ):
+                static_call = cc
+                break
+        if static_call is not None:
+            if fn_node is not None:
+                static = _static_names_from_call(static_call, fn_node)
+            else:
+                for kw in static_call.keywords:
+                    if kw.arg == "static_argnames":
+                        static.update(_str_literals(kw.value))
+
+        encl_parts: List[str] = []
+        cached = False
+        for anc in ctx.ancestors(anchor):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _has_caching_decorator(anc):
+                    cached = True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                encl_parts.append(anc.name)
+        encl_parts.reverse()
+        # the wrapped def itself can carry the cache decorator too
+        if fn_node is not None and _has_caching_decorator(fn_node):
+            cached = True
+
+        encl_qualname = f"{mod}:{'.'.join(encl_parts)}" if encl_parts else None
+        if encl_qualname is None:
+            reachable = True  # module level: constructed at import time
+        else:
+            reachable = encl_qualname in self.reachable
+        encl_disp = ".".join(encl_parts) if encl_parts else "<module>"
+        return JitSite(
+            key=f"{mod}:{encl_disp}/{fn_name}",
+            mod=mod,
+            fn_name=fn_name,
+            transforms=transforms,
+            ctx=ctx,
+            line=anchor.lineno,
+            col=anchor.col_offset,
+            call=chain_calls[0] if chain_calls else None,
+            fn_node=fn_node,
+            static_args=sorted(static),
+            cached_maker=cached,
+            reachable=reachable,
+            encl_qualname=encl_qualname,
+        )
+
+    # -- shared lookups for the rules ---------------------------------------
+
+    def site_fn_names(self) -> Set[str]:
+        return {s.fn_name for s in self.sites}
+
+    def maker_functions(self) -> Dict[str, JitSite]:
+        """Enclosing-fn qualname -> its jit-bearing site, for every
+        cache-owning site built inside a function (the maker pattern);
+        the index ALZ070's caller-side checks dispatch on."""
+        out: Dict[str, JitSite] = {}
+        for s in self.sites:
+            if s.is_entry and s.encl_qualname is not None:
+                out.setdefault(s.encl_qualname, s)
+        return out
+
+
+# -- device-taint helpers shared by ALZ071/ALZ072 ---------------------------
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SHAPE_CALLS = {"len"}
+
+
+def device_names(node: ast.AST) -> Set[str]:
+    """Names in ``node`` whose value would be a *device* tracer — the
+    shape-aware twin of jax_rules._names_in. Subtrees that only read
+    trace-time-static facts are skipped: ``x.shape`` / ``x.ndim`` /
+    ``x.dtype`` / ``x.size`` attribute reads, ``len(x)``, and
+    ``x is None`` / ``x is not None`` comparisons (branching on those is
+    shape-safe Python, not data-dependent control flow)."""
+    out: Set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+            return
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Name) and fn.id in _SHAPE_CALLS:
+                return
+        if isinstance(n, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+        ):
+            others = [n.left] + list(n.comparators)
+            if any(
+                isinstance(o, ast.Constant) and o.value is None for o in others
+            ):
+                return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def local_device_taint(fn: ast.AST, seed: Set[str]) -> Set[str]:
+    """Propagate device taint from ``seed`` params through simple
+    assignments to a fixpoint, shape-aware: ``n = x.shape[0]`` does NOT
+    taint ``n`` even when ``x`` is tainted."""
+    tainted = set(seed)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for _ in range(10):
+        before = len(tainted)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    # `for i, x in enumerate(params)`: the index is a
+                    # Python int even when the iterable is tainted
+                    tgt: ast.AST = node.target
+                    if (
+                        isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "enumerate"
+                        and isinstance(tgt, ast.Tuple)
+                        and len(tgt.elts) == 2
+                    ):
+                        tgt = tgt.elts[1]
+                    targets, value = [tgt], node.iter
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is not None and (device_names(value) & tainted):
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+        if len(tainted) == before:
+            break
+    return tainted

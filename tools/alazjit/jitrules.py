@@ -1,0 +1,571 @@
+"""ALZ070-ALZ073: the retrace / host-sync / dtype hazard rules over the
+discovered jit surface.
+
+Scope split against the existing per-file heads (no double findings):
+
+- ALZ070 is the *whole-program* fresh-wrapper/caller-side complement of
+  ALZ006 (which already flags jit-in-loop, jit-of-fresh-lambda, and
+  literal type variance per invocation): uncached constructions inside
+  method bodies, uncached makers invoked from loops, and shape-valued
+  Python scalars flowing into *static* positions of a maker-produced
+  jit callable (one compile-cache entry per distinct value).
+- ALZ071 is interprocedural ALZ002: data-dependent Python control flow
+  on device values inside *helpers* reached from a traced fn — the
+  wrapped fn itself stays ALZ002's (per-file) territory. The taint is
+  shape-aware: ``x.shape[0]``, ``len(x)``, ``x.ndim`` and
+  ``x is None`` checks never carry device taint.
+- ALZ072 is interprocedural ALZ005 plus the §3n dispatch-loop
+  contract: unambiguous syncs (``block_until_ready`` /
+  ``jax.device_get`` / ``.item()``) in helpers transitively reachable
+  from a ``stage_*`` function; device readbacks in the *shallow* body
+  of a dispatch-loop driver (a fn that both stages and finishes —
+  sync belongs in the ``finish*`` scopes, never between dispatch and
+  finish); and implicit ``__bool__`` on a jit-call result in a driver.
+- ALZ073 is the interprocedural dtype-discipline complement of ALZ004
+  (jnp f32 ctors near a compute dtype, per-file) and ALZ024 (explicit
+  float64 in *directly* traced fns, per-file): numpy f64-defaulting
+  constructors anywhere in the traced closure, and f64 spellings —
+  including bare ``float``, which IS float64 — in helpers the per-file
+  rules cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.alazlint.core import FileContext, Finding, callee as _callee
+from tools.alazlint.jax_rules import _NUMPY_MODULES, _param_names
+from tools.alazjit.jitmodel import (
+    JitModel,
+    JitSite,
+    _LOOP_NODES,
+    _walk_shallow,
+    device_names,
+    local_device_taint,
+)
+
+# numpy constructors whose default dtype is float64 — each one inside a
+# traced closure bakes an f64 constant into the trace (promotion, or a
+# silent downcast under disabled x64 — either way not what bf16/int8
+# arms want to inherit)
+_NP_F64_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "linspace", "eye"}
+_F64_SPELLINGS = {"float64", "f64", "double"}
+# syncs that are unambiguous on any value (no host-side-numpy false
+# positive possible, unlike np.asarray in a helper)
+_HARD_SYNCS = ("block_until_ready", "device_get", "item")
+
+
+def _final_name(qualname: str) -> str:
+    return qualname.split(":", 1)[-1].split(".")[-1]
+
+
+def _callee_params(jm: JitModel, target: str, call: ast.Call) -> List[str]:
+    info = jm.model.functions[target]
+    params = _param_names(info.node)
+    if (
+        params
+        and params[0] in ("self", "cls")
+        and isinstance(call.func, ast.Attribute)
+    ):
+        params = params[1:]  # bound call: positionals start after self
+    return params
+
+
+def _tainted_callee_params(
+    jm: JitModel, target: str, call: ast.Call, tainted: Set[str]
+) -> "frozenset[str]":
+    params = _callee_params(jm, target, call)
+    out: Set[str] = set()
+    for i, arg in enumerate(call.args):
+        if i < len(params) and (device_names(arg) & tainted):
+            out.add(params[i])
+    for kw in call.keywords:
+        if kw.arg and kw.arg in params and (device_names(kw.value) & tainted):
+            out.add(kw.arg)
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# ALZ070 — whole-program fresh-wrapper / caller-side cache-key hazards
+# ---------------------------------------------------------------------------
+
+
+def _shape_valued(expr: ast.AST) -> Optional[str]:
+    """A spelling when ``expr`` is evidently a per-shape Python scalar:
+    ``len(x)``, ``x.shape[i]``, ``x.shape`` / ``x.size`` / ``x.ndim``."""
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            return "len(...)"
+    node = expr
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in ("shape", "size", "ndim"):
+        return f".{node.attr}"
+    return None
+
+
+def check_alz070(jm: JitModel) -> Iterable[Finding]:
+    model = jm.model
+
+    # (a) uncached construction inside a method body: a fresh compile
+    # cache per method call (ALZ006 only sees loops and lambdas)
+    for site in jm.sites:
+        if not site.is_entry or site.cached_maker:
+            continue
+        if site.encl_qualname is None:
+            continue
+        info = model.functions.get(site.encl_qualname)
+        if info is None or info.cls is None:
+            continue
+        if _final_name(site.encl_qualname) == "__init__":
+            continue  # once per instance: a legal construction point
+        yield Finding(
+            "ALZ070",
+            f"jit surface `{site.key}` is constructed inside method "
+            f"`{_final_name(site.encl_qualname)}` without a cache — every "
+            "call builds a fresh traced callable with an empty compile "
+            "cache (one retrace per call); construct it in __init__ or "
+            "cache the maker (functools.lru_cache keyed on the config)",
+            site.ctx.path,
+            site.line,
+            site.col,
+        )
+
+    # (b) uncached maker invoked per loop iteration: same pathology one
+    # or more calls further out, where the per-file ALZ006 loop check
+    # cannot see it. Two shapes: the call sits in a loop syntactically,
+    # or the calling function is loop-tainted — transitively called
+    # from a loop in the reachable entry surface (`main` sweeping
+    # scenarios re-invokes the whole detection leg per iteration, and
+    # an uncached maker three frames down re-traces every time).
+    uncached_makers = {
+        qn: s
+        for qn, s in jm.maker_functions().items()
+        if not s.cached_maker
+    }
+    if uncached_makers:
+        for qn, info in model.functions.items():
+            mod = model.module_of[id(info.ctx)]
+            local_prefix = qn + "."
+            for node in _walk_shallow(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = jm.resolve_call_ext(node, mod, info.cls, local_prefix)
+                site = uncached_makers.get(target or "")
+                if site is None:
+                    continue
+                in_loop = any(
+                    isinstance(anc, _LOOP_NODES)
+                    for anc in info.ctx.ancestors(node)
+                )
+                if in_loop:
+                    yield Finding(
+                        "ALZ070",
+                        f"uncached jit maker `{_final_name(target)}` called "
+                        "inside a loop — each iteration re-builds "
+                        f"`{site.key}` and re-traces from an empty cache; "
+                        "hoist the maker call out of the loop or "
+                        "lru_cache the maker",
+                        info.ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                elif qn in jm.loop_tainted:
+                    yield Finding(
+                        "ALZ070",
+                        f"uncached jit maker `{_final_name(target)}` is "
+                        f"re-invoked per loop iteration: `{_final_name(qn)}` "
+                        "is loop-called from the entry surface, so every "
+                        f"iteration re-builds `{site.key}` and re-traces "
+                        "from an empty compile cache; lru_cache the maker "
+                        "(keyed on the config) so same-config iterations "
+                        "share one trace cache",
+                        info.ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+
+    # (c) shape-valued Python scalars into a STATIC position of a
+    # maker-produced jit callable: one compile-cache entry per distinct
+    # runtime value — unbounded unless routed through the bucket table
+    makers = jm.maker_functions()
+    # binding -> site, per module: `step = make_step_fn(cfg)` and
+    # `self._fn = make_score_fn(cfg)` both index the returned callable
+    bindings: Dict[Tuple[str, str], JitSite] = {}
+    for qn, info in model.functions.items():
+        mod = model.module_of[id(info.ctx)]
+        local_prefix = qn + "."
+        for node in _walk_shallow(info.node):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            target = jm.resolve_call_ext(node.value, mod, info.cls, local_prefix)
+            site = makers.get(target or "")
+            if site is None or not site.static_args:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bindings[(mod, t.id)] = site
+                elif (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    bindings[(mod, f"self.{t.attr}")] = site
+    if bindings:
+        for qn, info in model.functions.items():
+            mod = model.module_of[id(info.ctx)]
+            for node in _walk_shallow(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = None
+                if isinstance(fn, ast.Name):
+                    name = fn.id
+                elif (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                ):
+                    name = f"self.{fn.attr}"
+                site = bindings.get((mod, name or ""))
+                if site is None or site.fn_node is None:
+                    continue
+                params = _param_names(site.fn_node)
+                for i, arg in enumerate(node.args):
+                    spelled = _shape_valued(arg)
+                    if spelled is None or i >= len(params):
+                        continue
+                    if params[i] in site.static_args:
+                        yield Finding(
+                            "ALZ070",
+                            f"shape-valued scalar ({spelled}) flows into "
+                            f"static arg `{params[i]}` of jit surface "
+                            f"`{site.key}` — every distinct value is a "
+                            "separate compile-cache entry; quantize it "
+                            "through the bucket table before the call",
+                            info.ctx.path,
+                            arg.lineno,
+                            arg.col_offset,
+                        )
+
+
+# ---------------------------------------------------------------------------
+# ALZ071 — interprocedural data-dependent control flow on device values
+# ---------------------------------------------------------------------------
+
+
+def check_alz071(jm: JitModel) -> Iterable[Finding]:
+    model = jm.model
+    node_to_qn = {id(info.node): qn for qn, info in model.functions.items()}
+    memo: Set[Tuple[str, frozenset]] = set()
+    out: List[Finding] = []
+
+    def analyze(qn: str, seed: "frozenset[str]", report_here: bool) -> None:
+        key = (qn, seed)
+        if key in memo or len(memo) > 4000:
+            return
+        memo.add(key)
+        info = model.functions[qn]
+        mod = model.module_of[id(info.ctx)]
+        local_prefix = qn + "."
+        tainted = local_device_taint(info.node, set(seed))
+        for node in _walk_shallow(info.node):
+            if (
+                report_here
+                and isinstance(node, (ast.If, ast.While))
+                and (device_names(node.test) & tainted)
+            ):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(
+                    Finding(
+                        "ALZ071",
+                        f"Python `{kind}` in helper `{_final_name(qn)}` "
+                        "branches on a device value that rides in from a "
+                        "traced caller (ConcretizationTypeError once "
+                        "jitted); use jnp.where / lax.cond, branch on "
+                        "shapes only, or hoist the decision to the caller",
+                        info.ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+                )
+            if isinstance(node, ast.Call):
+                target = jm.resolve_call_ext(node, mod, info.cls, local_prefix)
+                if target is None or target not in model.functions:
+                    continue
+                tp = _tainted_callee_params(jm, target, node, tainted)
+                if tp:
+                    analyze(target, tp, report_here=True)
+
+    for site in jm.sites:
+        fn = site.fn_node
+        if fn is None or isinstance(fn, ast.Lambda):
+            continue
+        qn = node_to_qn.get(id(fn))
+        if qn is None:
+            continue
+        seed = frozenset(
+            p for p in _param_names(fn) if p not in site.static_args
+        )
+        # the wrapped fn itself is ALZ002's territory (per-file); only
+        # its helpers report here
+        analyze(qn, seed, report_here=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ALZ072 — host-sync discipline on the scorer dispatch paths (§3n)
+# ---------------------------------------------------------------------------
+
+
+def _closure_from(
+    jm: JitModel, roots: Sequence[str]
+) -> Dict[str, str]:
+    """fn qualname -> root qualname for everything transitively called
+    from ``roots`` (resolved calls only, shallow walk per fn so a
+    nested finisher def doesn't leak its scope into the closure)."""
+    model = jm.model
+    owner: Dict[str, str] = {}
+    work: List[Tuple[str, str]] = [(r, r) for r in roots]
+    while work:
+        qn, root = work.pop()
+        if qn in owner or qn not in model.functions:
+            continue
+        owner[qn] = root
+        info = model.functions[qn]
+        mod = model.module_of[id(info.ctx)]
+        local_prefix = qn + "."
+        for node in _walk_shallow(info.node):
+            if isinstance(node, ast.Call):
+                target = jm.resolve_call_ext(node, mod, info.cls, local_prefix)
+                if target is not None and target not in owner:
+                    work.append((target, root))
+    return owner
+
+
+def _hard_sync(node: ast.Call) -> Optional[str]:
+    mod, name = _callee(node)
+    if name == "block_until_ready":
+        return ".block_until_ready()"
+    if mod == "jax" and name == "device_get":
+        return "jax.device_get()"
+    if name == "item" and isinstance(node.func, ast.Attribute):
+        return ".item()"
+    return None
+
+
+def _readback(node: ast.Call) -> Optional[str]:
+    hit = _hard_sync(node)
+    if hit is not None:
+        return hit
+    mod, name = _callee(node)
+    if mod in _NUMPY_MODULES and name in ("asarray", "array"):
+        return f"{mod}.{name}()"
+    return None
+
+
+def check_alz072(jm: JitModel) -> Iterable[Finding]:
+    model = jm.model
+
+    # (1) interprocedural ALZ005: a helper transitively reachable from a
+    # stage_* function must not hard-sync (the stage fn's own body is
+    # per-file ALZ005 territory)
+    stage_roots = [
+        qn for qn in model.functions if _final_name(qn).startswith("stage_")
+    ]
+    owner = _closure_from(jm, stage_roots)
+    for qn, root in sorted(owner.items()):
+        if qn in stage_roots:
+            continue
+        info = model.functions[qn]
+        for node in _walk_shallow(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _hard_sync(node)
+            if hit is not None:
+                yield Finding(
+                    "ALZ072",
+                    f"{hit} blocks inside `{_final_name(qn)}`, which is "
+                    f"reachable from staging function "
+                    f"`{_final_name(root)}` — staging must dispatch async "
+                    "and let the finisher block, or host work stops "
+                    "overlapping device compute",
+                    info.ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+
+    # (2)+(3) dispatch-loop drivers: a fn that both stages and finishes
+    # is the §3n loop — its shallow body may sync at staging and finish
+    # scopes ONLY, and must not read back (or truth-test) device values
+    # between dispatch and finish
+    for qn, info in model.functions.items():
+        stages = False
+        finishes = False
+        for node in _walk_shallow(info.node):
+            if isinstance(node, ast.Call):
+                _, name = _callee(node)
+                if name and name.startswith("stage"):
+                    stages = True
+                if name and name.startswith("finish"):
+                    finishes = True
+        if not (stages and finishes):
+            continue
+        # pass 1: names bound from jitted calls (the shallow walk is
+        # not in source order, so collect before checking truth-tests)
+        jit_results: Set[str] = set()
+        for node in _walk_shallow(info.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                fn = node.value.func
+                name = None
+                if isinstance(fn, ast.Attribute):
+                    name = fn.attr
+                elif isinstance(fn, ast.Name):
+                    name = fn.id
+                if name in jm.site_fn_names() or (
+                    name is not None
+                    and isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                    and ("score" in name or "jit" in name or "step" in name)
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jit_results.add(t.id)
+        for node in _walk_shallow(info.node):
+            if isinstance(node, ast.Call):
+                hit = _readback(node)
+                if hit is not None:
+                    yield Finding(
+                        "ALZ072",
+                        f"{hit} in the dispatch loop of "
+                        f"`{_final_name(qn)}` — the §3n staging contract "
+                        "allows sync at staging and finish only, never "
+                        "between dispatch and finish; move the readback "
+                        "into the finish scope",
+                        info.ctx.path,
+                        node.lineno,
+                        node.col_offset,
+                    )
+            if (
+                isinstance(node, (ast.If, ast.While))
+                and isinstance(node.test, ast.Name)
+                and node.test.id in jit_results
+            ):
+                yield Finding(
+                    "ALZ072",
+                    f"truth-test on `{node.test.id}` — the result of a "
+                    "jitted call — in the dispatch loop of "
+                    f"`{_final_name(qn)}`: implicit __bool__ on a device "
+                    "value is a hidden host sync between dispatch and "
+                    "finish; test `is not None` or move it to the finish "
+                    "scope",
+                    info.ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+
+
+# ---------------------------------------------------------------------------
+# ALZ073 — dtype discipline in the traced closure
+# ---------------------------------------------------------------------------
+
+
+def _f64_spelling(node: ast.AST) -> Optional[str]:
+    """'float64'-meaning spelling of a dtype expression, or None."""
+    if isinstance(node, ast.Attribute) and node.attr in _F64_SPELLINGS:
+        return f".{node.attr}"
+    if isinstance(node, ast.Name):
+        if node.id in _F64_SPELLINGS:
+            return node.id
+        if node.id == "float":
+            return "float (Python float IS float64)"
+    if isinstance(node, ast.Constant) and node.value in ("float64", "f64", "double"):
+        return repr(node.value)
+    return None
+
+
+def check_alz073(jm: JitModel) -> Iterable[Finding]:
+    model = jm.model
+    node_to_qn = {id(info.node): qn for qn, info in model.functions.items()}
+
+    # the traced closure: wrapped fns + transitively resolved callees
+    wrapped: List[str] = []
+    for site in jm.sites:
+        if site.fn_node is None:
+            continue
+        qn = node_to_qn.get(id(site.fn_node))
+        if qn is not None:
+            wrapped.append(qn)
+    owner = _closure_from(jm, wrapped)
+    wrapped_set = set(wrapped)
+
+    seen: Set[Tuple[str, int, int]] = set()
+    for qn in sorted(owner):
+        info = model.functions[qn]
+        in_wrapped = qn in wrapped_set
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            anchor = (info.ctx.path, node.lineno, node.col_offset)
+            if anchor in seen:
+                continue
+            mod, name = _callee(node)
+            # numpy f64-defaulting constructor inside the traced closure
+            if (
+                mod in _NUMPY_MODULES
+                and name in _NP_F64_CONSTRUCTORS
+                and not any(kw.arg == "dtype" for kw in node.keywords)
+            ):
+                if name in ("zeros", "ones", "empty") and len(node.args) >= 2:
+                    continue  # dtype passed positionally
+                if name == "full" and len(node.args) >= 3:
+                    continue
+                seen.add(anchor)
+                yield Finding(
+                    "ALZ073",
+                    f"{mod}.{name}() without a dtype inside the traced "
+                    "closure defaults to float64 — the constant enters "
+                    "the jit body as f64 (promotion, or a silent cast "
+                    "under disabled x64); pass dtype= or build it with "
+                    "jnp",
+                    info.ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )
+                continue
+            # f64 spellings: helpers only for float64/f64 (ALZ024 owns
+            # the directly-traced fn), but bare `float` everywhere (no
+            # other rule sees it)
+            hits: List[str] = []
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    sp = _f64_spelling(kw.value)
+                    if sp is not None:
+                        hits.append(f"dtype={sp}")
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+            ):
+                sp = _f64_spelling(node.args[0])
+                if sp is not None:
+                    hits.append(f".astype({sp})")
+            for hit in hits:
+                if in_wrapped and "Python float" not in hit:
+                    continue  # ALZ024's per-file territory
+                seen.add(anchor)
+                yield Finding(
+                    "ALZ073",
+                    f"{hit} requests float64 inside the traced closure "
+                    f"(helper `{_final_name(qn)}`) — f64 never belongs "
+                    "on the scorer device plane; use the compute dtype "
+                    "or an explicit f32",
+                    info.ctx.path,
+                    node.lineno,
+                    node.col_offset,
+                )

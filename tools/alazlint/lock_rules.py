@@ -130,6 +130,58 @@ def _iter_classes(ctx: FileContext) -> Iterable[ast.ClassDef]:
             yield node
 
 
+def _manual_ops(model: _ClassModel, stmt: ast.AST) -> List[tuple]:
+    """Source-ordered bare ``self.<lock>.acquire(...)`` / ``.release()``
+    calls inside ONE statement (shallow — deferred bodies run later).
+    Feeds the suite walk so the bounded-acquire region (acquire before
+    ``try``, release in ``finally``) counts as holding the lock."""
+    ops: List[tuple] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("acquire", "release")
+        ):
+            attr = _self_attr(node.func.value)
+            if attr is not None and model.is_lockish(attr):
+                ops.append(
+                    (node.lineno, node.col_offset, node.func.attr, model.canon(attr))
+                )
+        stack.extend(ast.iter_child_nodes(node))
+    ops.sort()
+    return ops
+
+
+def _walk_suite(
+    ctx: FileContext,
+    model: _ClassModel,
+    stmts: Iterable[ast.stmt],
+    held: FrozenSet[str],
+    in_while: bool,
+    findings: List[Finding],
+) -> None:
+    """Walk a statement list in SOURCE ORDER, tracking manual lock
+    regions: after a statement that bare-acquires a known lock (incl.
+    the bounded ``if not self._lock.acquire(timeout=...): return``
+    shape), subsequent sibling statements count as holding it until a
+    statement releases it — the close-wave merge region reads as locked
+    instead of tripping ALZ010 on every guarded touch (the `with`-only
+    precision bound, closed by ISSUE 19). ALZ012 still flags the bare
+    acquire itself; the pairing discipline stays reviewable there."""
+    manual: FrozenSet[str] = frozenset()
+    for stmt in stmts:
+        _walk_method(ctx, model, stmt, held | manual, in_while, findings)
+        for _, _, op, lock in _manual_ops(model, stmt):
+            if op == "acquire":
+                manual = manual | {lock}
+            else:
+                manual = manual - {lock}
+
+
 def _walk_method(
     ctx: FileContext,
     model: _ClassModel,
@@ -146,21 +198,18 @@ def _walk_method(
             if attr is not None and model.is_lockish(attr):
                 newly.add(model.canon(attr))
             _walk_method(ctx, model, expr, held, in_while, findings)
-        for stmt in node.body:
-            _walk_method(
-                ctx, model, stmt, held | frozenset(newly), in_while, findings
-            )
+        _walk_suite(
+            ctx, model, node.body, held | frozenset(newly), in_while, findings
+        )
         return
     if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
         # deferred body: the enclosing `with` will NOT be held at run time
         body = node.body if isinstance(node.body, list) else [node.body]
-        for stmt in body:
-            _walk_method(ctx, model, stmt, frozenset(), False, findings)
+        _walk_suite(ctx, model, body, frozenset(), False, findings)
         return
     if isinstance(node, ast.While):
         _walk_method(ctx, model, node.test, held, True, findings)
-        for stmt in node.body + node.orelse:
-            _walk_method(ctx, model, stmt, held, True, findings)
+        _walk_suite(ctx, model, node.body + node.orelse, held, True, findings)
         return
 
     attr = _self_attr(node)
@@ -231,8 +280,20 @@ def _walk_method(
                     )
                 )
 
-    for child in ast.iter_child_nodes(node):
-        _walk_method(ctx, model, child, held, in_while, findings)
+    # statement-list fields (try/if/for bodies, orelse, finalbody,
+    # except-handler bodies) recurse through the suite walk so manual
+    # acquire regions see source order; expression children recurse
+    # plainly
+    for _fname, value in ast.iter_fields(node):
+        if isinstance(value, list):
+            if value and isinstance(value[0], ast.stmt):
+                _walk_suite(ctx, model, value, held, in_while, findings)
+            else:
+                for v in value:
+                    if isinstance(v, ast.AST):
+                        _walk_method(ctx, model, v, held, in_while, findings)
+        elif isinstance(value, ast.AST):
+            _walk_method(ctx, model, value, held, in_while, findings)
 
 
 def check_lock_discipline(ctx: FileContext) -> Iterable[Finding]:
@@ -247,6 +308,5 @@ def check_lock_discipline(ctx: FileContext) -> Iterable[Finding]:
                 continue
             if item.name == "__init__":
                 continue
-            for stmt in item.body:
-                _walk_method(ctx, model, stmt, frozenset(), False, findings)
+            _walk_suite(ctx, model, item.body, frozenset(), False, findings)
     return findings

@@ -239,6 +239,49 @@ _ALL = [
         "parity divergence on a corpus case (make sanitize-native)",
         lambda ctx: (),  # emitted by tools.alaznat.fuzz
     ),
+    # -- alazjit family (tools/alazjit): device-plane static analysis —
+    # the seventh head. Discovers the whole jit surface (every jit /
+    # vmap / pmap / shard_map construction reachable from the entry
+    # surface), pins it to resources/specs/jit_surface.json, and lints
+    # retrace / host-sync / dtype hazards interprocedurally over the
+    # traced closure — the whole-program complement of the per-file
+    # ALZ002/004/005/006/024 checks. Registered here so codes stay
+    # append-only and disable comments parse uniformly.
+    Rule(
+        "ALZ070",
+        "whole-program retrace hazard: uncached jit construction in a "
+        "method body, an uncached maker re-invoked per loop iteration "
+        "(syntactic or via the reachable call graph), or a shape-valued "
+        "scalar flowing into a static jit argument",
+        lambda ctx: (),  # emitted by tools.alazjit.jitrules
+    ),
+    Rule(
+        "ALZ071",
+        "Python control flow on a device value inside a helper reached "
+        "from a traced fn (interprocedural ConcretizationTypeError)",
+        lambda ctx: (),  # emitted by tools.alazjit.jitrules
+    ),
+    Rule(
+        "ALZ072",
+        "host-sync discipline: hard sync in a helper reachable from "
+        "staging, or a readback / implicit __bool__ between dispatch "
+        "and finish in a dispatch-loop driver (§3n)",
+        lambda ctx: (),  # emitted by tools.alazjit.jitrules
+    ),
+    Rule(
+        "ALZ073",
+        "dtype discipline in the traced closure: numpy float64-default "
+        "constructor, or an f64 spelling (incl. bare `float`) a "
+        "per-file rule cannot see",
+        lambda ctx: (),  # emitted by tools.alazjit.jitrules
+    ),
+    Rule(
+        "ALZ074",
+        "jit surface drifted from the golden spec, or a retrace-budget "
+        "key no longer names a discovered traced fn "
+        "(resources/specs/jit_surface.json; --write-surface regenerates)",
+        lambda ctx: (),  # emitted by tools.alazjit.jitgolden
+    ),
 ]
 
 RULES: Dict[str, Rule] = {r.code: r for r in _ALL}

@@ -736,6 +736,58 @@ class RaceModel:
                         return True
             return False
 
+        def manual_ops(stmt: ast.AST) -> List[Tuple[int, int, str, str]]:
+            """Source-ordered bare ``<lock>.acquire(...)`` /
+            ``<lock>.release()`` calls inside ONE statement (shallow —
+            nested defs carry their own summaries). Resolving the
+            receiver through ``_lock_id_for`` keeps this to known locks:
+            a semaphore-ish ``.acquire`` on an untyped object is not a
+            lock region."""
+            ops: List[Tuple[int, int, str, str]] = []
+            stack: List[ast.AST] = [stmt]
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")
+                ):
+                    lock = _lock_id_for(self.model, mod, cls, node.func.value)
+                    if lock is not None:
+                        ops.append(
+                            (node.lineno, node.col_offset, node.func.attr, lock)
+                        )
+                stack.extend(ast.iter_child_nodes(node))
+            ops.sort()
+            return ops
+
+        def walk_suite(stmts: Sequence[ast.stmt], held: Tuple[str, ...]) -> None:
+            """Walk a statement list in SOURCE ORDER, tracking bare
+            ``acquire()``/``release()`` regions: after a statement that
+            acquires a known lock (``self._merge_lock.acquire()``, or the
+            bounded ``if not lock.acquire(timeout=...): return`` shape),
+            the following sibling statements count as holding it until a
+            statement releases it — the close-wave merge region
+            (acquire-before-try, mutate inside, release-in-finally) reads
+            as locked instead of bare (the v1 "only ``with`` blocks
+            count" precision bound, closed by ISSUE 19). An acquire
+            buried under a non-exiting conditional still marks the tail
+            of the suite held — same maybe-held over-approximation a
+            conditional ``with`` would get if Python had one."""
+            manual: Tuple[str, ...] = ()
+            for stmt in stmts:
+                walk(stmt, held + manual)
+                for _, _, op, lock in manual_ops(stmt):
+                    if op == "acquire":
+                        if lock not in held and lock not in manual:
+                            manual = manual + (lock,)
+                    else:
+                        manual = tuple(l for l in manual if l != lock)
+
         def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
             if isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
@@ -748,9 +800,7 @@ class RaceModel:
                     walk(item.context_expr, held)
                     if lock is not None and lock not in held:
                         newly.append(lock)
-                inner = held + tuple(newly)
-                for stmt in node.body:
-                    walk(stmt, inner)
+                walk_suite(node.body, held + tuple(newly))
                 return
             if isinstance(node, ast.Call):
                 target = resolve_any_call(node)
@@ -813,12 +863,23 @@ class RaceModel:
                 cls_qn = receiver_class(node.value)
                 if cls_qn is not None:
                     field_site(cls_qn, node.attr, node, False, False, held)
-            for child in ast.iter_child_nodes(node):
-                walk(child, held)
+            # statement-list fields (try/if/for/while bodies, orelse,
+            # finalbody, except-handler bodies) recurse through
+            # walk_suite so manual acquire regions see suite order;
+            # expression children recurse plainly
+            for _fname, value in ast.iter_fields(node):
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        walk_suite(value, held)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.AST):
+                                walk(v, held)
+                elif isinstance(value, ast.AST):
+                    walk(value, held)
 
         body = info.node.body if isinstance(info.node.body, list) else [info.node.body]
-        for stmt in body:
-            walk(stmt, ())
+        walk_suite(body, ())
         self.calls[qn] = calls
 
     def _local_types(

@@ -359,12 +359,18 @@ def check_specs(specs_dir: Optional[Path] = None) -> List[Finding]:
             )
         )
     for stray in sorted(specs_dir.glob("*.json")):
-        if stray.name in ("metrics.json", "threads.json", "nat_offsets.json"):
+        if stray.name in (
+            "metrics.json",
+            "threads.json",
+            "nat_offsets.json",
+            "jit_surface.json",
+        ):
             continue  # alazflow's golden metric registry (ALZ044),
-            # alazrace's golden concurrency map (ALZ054), and alaznat's
-            # golden native offset map (ALZ062) live beside the spec set
-            # but are owned by --write-metrics / --write-threads /
-            # --write-offsets
+            # alazrace's golden concurrency map (ALZ054), alaznat's
+            # golden native offset map (ALZ062), and alazjit's golden
+            # jit surface (ALZ074) live beside the spec set but are
+            # owned by --write-metrics / --write-threads /
+            # --write-offsets / --write-surface
         if stray.name not in live:
             out.append(
                 Finding(
