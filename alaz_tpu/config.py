@@ -353,6 +353,16 @@ class ModelConfig:
     # or "banded" Pallas windowed kernel (after graph/builder.py's
     # cluster_renumber pass narrows per-chunk src id bands — §3b residual)
     src_gather: str = "xla"
+    # edge-buffer layout the aggregation ops consume (ISSUE 20,
+    # ARCHITECTURE §3v): "coo" is the flat dst-sorted edge list scored
+    # as-is; "blocked" additionally ships per-128-dst-row block extents
+    # (blocked-CSR row starts computed at window close over the REAL
+    # edge prefix) and routes segment reductions through the extent-
+    # aware paths — the Pallas kernel skips its on-device binary search
+    # and the XLA fallback reduces tile-trimmed instead of rung-padded.
+    # Bit-exact vs "coo" by construction (pad edges contribute exactly
+    # 0.0); selection is a Python-level branch, so no retraces.
+    edge_layout: str = "coo"
     remat: bool = False  # jax.checkpoint each GNN layer (FLOPs for memory)
     # tgn only: pre-size node memory to the largest expected bucket so a
     # growing fleet doesn't pay a serving-time recompile per
@@ -376,6 +386,7 @@ class ModelConfig:
             num_layers=env_int("NUM_LAYERS", 2),
             use_pallas=env_bool("USE_PALLAS", True),
             src_gather=env_str("SRC_GATHER", "xla"),
+            edge_layout=env_str("EDGE_LAYOUT", "coo"),
             expert_dispatch=env_str("EXPERT_DISPATCH", "table"),
             edge_feat_znorm=env_bool("EDGE_FEAT_ZNORM", True),
             remat=env_bool("REMAT", False),
@@ -479,6 +490,16 @@ class RuntimeConfig:
     engine_backend: str = field(
         default_factory=lambda: env_str("ENGINE_BACKEND", "python")
     )
+    # edge-buffer layout at window close (ISSUE 20, ARCHITECTURE §3v):
+    # "coo" ships the flat dst-sorted list; "blocked" additionally
+    # emits per-128-dst-row block extents consumed by the extent-aware
+    # aggregation paths. Must match ModelConfig.edge_layout on the
+    # scorer side. env-reading DEFAULT (not just from_env) so spawned
+    # shard processes and chaos pipelines that build a plain
+    # RuntimeConfig() still honor EDGE_LAYOUT=blocked.
+    edge_layout: str = field(
+        default_factory=lambda: env_str("EDGE_LAYOUT", "coo")
+    )
     # shm ring geometry (process backend only; alazspec pins the layout
     # in wire_layouts.json `shm_ring`): bytes per fixed slot and slots
     # per ring. A scattered chunk must fit in ring_slots - 1 slots;
@@ -551,6 +572,7 @@ class RuntimeConfig:
             ingest_workers=env_int("INGEST_WORKERS", 1),
             ingest_backend=env_str("INGEST_BACKEND", "thread"),
             engine_backend=env_str("ENGINE_BACKEND", "python"),
+            edge_layout=env_str("EDGE_LAYOUT", "coo"),
             shm_slot_bytes=env_int("SHM_SLOT_BYTES", 65_536),
             shm_ring_slots=env_int("SHM_RING_SLOTS", 512),
             tenants=env_int("TENANTS", 1),

@@ -21,11 +21,12 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from alaz_tpu.config import env_str
 from alaz_tpu.datastore.interface import BaseDataStore
 from alaz_tpu.events.intern import Interner
 from alaz_tpu.events.k8s import EventType, ResourceType
 from alaz_tpu.graph.snapshot import GraphBatch
-from alaz_tpu.obs.device import pad_waste_pct_from
+from alaz_tpu.obs.device import blocked_pad_waste_pct_from, pad_waste_pct_from
 from alaz_tpu.obs.spans import SpanTracer
 
 NODE_FEATURE_DIM = 32
@@ -642,10 +643,22 @@ class GraphBuilder:  # role-private: every instance is owned by one store and it
         sample_seed: int = 0,
         ledger=None,
         tracer: Optional[SpanTracer] = None,
+        edge_layout: Optional[str] = None,
     ):
         self.nodes = nodes if nodes is not None else NodeTable()
         self.window_s = window_s
         self.renumber = renumber
+        # edge-buffer layout this builder emits (ISSUE 20): "blocked"
+        # computes the per-128-dst-row extents eagerly at window close
+        # (assembly is the host's staging decision — the scoring thread
+        # must never pay the searchsorted) and feeds the block-slot
+        # ledger. Defaults from EDGE_LAYOUT so every construction site
+        # (service, bench, sharded merge, replay) honors the env switch
+        # without threading a parameter through each one.
+        self.edge_layout = (
+            edge_layout if edge_layout is not None
+            else env_str("EDGE_LAYOUT", "coo")
+        )
         # per-dst fan-in bound at window close (0 = unlimited — the
         # bit-identical legacy path). Sampled-away edges attribute their
         # request rows to the ledger's closed `sampled` cause.
@@ -668,6 +681,7 @@ class GraphBuilder:  # role-private: every instance is owned by one store and it
         # for it
         self.assembled_edge_rows = 0  # real (masked-in) edge slots
         self.assembled_pad_slots = 0  # pad-tail slots shipped anyway
+        self.assembled_block_slots = 0  # blocked-layout tile slots
 
     @property
     def pad_waste_pct(self) -> float:
@@ -677,6 +691,19 @@ class GraphBuilder:  # role-private: every instance is owned by one store and it
         definition (obs/device.py pad_waste_pct_from)."""
         return pad_waste_pct_from(
             self.assembled_edge_rows, self.assembled_pad_slots
+        )
+
+    @property
+    def block_fill_pct(self) -> float:
+        """Fill percentage of the blocked layout's tile slots, cumulative
+        over every blocked batch — the host-side twin of the device
+        plane's ``device.block_fill_pct`` gauge, through the same shared
+        definition (obs/device.py blocked_pad_waste_pct_from). 0.0 until
+        a blocked batch was assembled (COO builders never feed it)."""
+        if not self.assembled_block_slots:
+            return 0.0
+        return 100.0 - blocked_pad_waste_pct_from(
+            self.assembled_edge_rows, self.assembled_block_slots
         )
 
     def build(
@@ -887,6 +914,14 @@ class GraphBuilder:  # role-private: every instance is owned by one store and it
         )
         self.assembled_edge_rows += batch.n_edges
         self.assembled_pad_slots += batch.pad_edge_slots
+        if self.edge_layout == "blocked":
+            # eager extent fill AT CLOSE: block_starts caches into the
+            # batch, so staging/scoring consume the window invariant
+            # without recomputing the searchsorted, and the telemetry
+            # plane reads `edge_block_starts is not None` as the
+            # blocked-window signal (obs/device.py observe_staged)
+            batch.block_starts()
+            self.assembled_block_slots += batch.blocked_edge_slots
         if tr is not None:
             tr.observe(window_start_ms, "sample", sample_s)
             tr.observe(
@@ -913,6 +948,7 @@ class WindowedGraphStore(BaseDataStore):
         degree_cap: int = 0,
         sample_seed: int = 0,
         tracer: Optional[SpanTracer] = None,
+        edge_layout: Optional[str] = None,
     ):
         self.interner = interner
         self.window_s = window_s
@@ -934,7 +970,7 @@ class WindowedGraphStore(BaseDataStore):
         self.builder = GraphBuilder(
             window_s=window_s, renumber=renumber,
             degree_cap=degree_cap, sample_seed=sample_seed, ledger=ledger,
-            tracer=tracer,
+            tracer=tracer, edge_layout=edge_layout,
         )
         self.batches: List[GraphBatch] = []
         self.request_count = 0  # guarded-by: self._lock

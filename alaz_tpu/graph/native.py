@@ -439,6 +439,7 @@ class NativeIngest:
         degree_cap: int = 0,
         sample_seed: int = 0,
         ledger=None,
+        edge_layout: Optional[str] = None,
     ):
         lib = _load()
         if lib is None:
@@ -458,6 +459,21 @@ class NativeIngest:
         self.degree_cap = int(degree_cap)
         self.sample_seed = int(sample_seed)
         self.ledger = ledger
+        # blocked-extent REFUSAL surface (ISSUE 20, pinned in
+        # resources/specs/wire_layouts.json `edge_blocks`): the C export
+        # does NOT ship block extents — alz_close_window_feats' signature
+        # is frozen (ALZ030 offsets golden) and the extents are a pure
+        # function of the dst-sorted columns it already emits, so the
+        # python side derives them instead: one np.searchsorted over the
+        # int32 dst prefix (~µs/window, next to the close pass's ms).
+        # Growing the C ABI for a value the host recomputes for free
+        # would buy nothing and cost an offsets/parity churn.
+        from alaz_tpu.config import env_str
+
+        self.edge_layout = (
+            edge_layout if edge_layout is not None
+            else env_str("EDGE_LAYOUT", "coo")
+        )
         self.sampled_edges = 0
         self.sampled_rows = 0
         self._h = ctypes.c_void_p(
@@ -625,7 +641,7 @@ class NativeIngest:
                 perm, es[:n], ed[:n], nf[:n_nodes], node_type[:n_nodes],
                 uids[:n_nodes],
             )
-            return GraphBatch.build(
+            return self._finish(GraphBatch.build(
                 node_feats=rnf,
                 node_type=rnt,
                 edge_src=src,
@@ -635,11 +651,22 @@ class NativeIngest:
                 node_uids=ruids,
                 window_start_ms=window_start_ms,
                 window_end_ms=window_start_ms + self.window_ms,
-            )
+            ))
 
-        return GraphBatch.from_presorted(
+        return self._finish(GraphBatch.from_presorted(
             nf, node_type, es, ed, et, ef, n_nodes, n,
             node_uids=uids,
             window_start_ms=window_start_ms,
             window_end_ms=window_start_ms + self.window_ms,
-        )
+        ))
+
+    def _finish(self, batch: GraphBatch) -> GraphBatch:
+        """Post-close layout step shared by both close paths: under the
+        blocked layout, derive the extents python-side at close time
+        (the refusal surface documented in __init__ — the C core emits
+        dst-sorted columns, which is all the searchsorted needs) so
+        downstream staging/telemetry see the same eager window invariant
+        the numpy builder ships."""
+        if self.edge_layout == "blocked":
+            batch.block_starts()
+        return batch

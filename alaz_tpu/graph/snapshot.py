@@ -28,6 +28,51 @@ import numpy as np
 
 _BUCKET_STEPS = (128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536, 131072, 262144, 524288, 1048576)
 
+# dst-block geometry of the blocked edge layout (ISSUE 20, ARCHITECTURE
+# §3v): one block per 128 node rows — the MXU/VPU lane width and the
+# Pallas scatter kernel's one-hot row chunk (ops/pallas_segment.py).
+# Every bucket rung is a multiple of this, so extents always tile.
+EDGE_BLOCK_ROWS = 128
+
+
+def edge_block_starts_from(
+    edge_dst: np.ndarray, n_edges: int, n_pad: int
+) -> np.ndarray:
+    """Blocked-CSR row starts over the REAL edge prefix: entry ``b`` is
+    the first edge whose dst lands at or past node row 128·b, so dst
+    block ``b`` owns edges ``[starts[b], starts[b+1])`` and
+    ``starts[-1] == n_edges`` is the live-edge frontier the blocked
+    aggregation paths trim to. THE one extent definition — the builder
+    close path, the native close path and the per-batch lazy field all
+    route through it, so the `edge_blocks` wire contract
+    (resources/specs/wire_layouts.json) cannot drift per producer.
+    Precondition: ``edge_dst[:n_edges]`` dst-sorted (the GraphBatch
+    layout invariant). The pad tail (dst pinned to n_pad-1 past
+    n_edges) is excluded by the prefix slice, so pad edges are invisible
+    to the extents and contribute exactly 0.0 under masking — blocked
+    and COO reductions are bit-exact, not merely close."""
+    bounds = np.arange(0, n_pad + 1, EDGE_BLOCK_ROWS, dtype=np.int64)
+    return np.searchsorted(edge_dst[:n_edges], bounds, side="left").astype(
+        np.int32
+    )
+
+
+def blocked_edge_slots_from(block_starts: np.ndarray) -> int:
+    """Edge-tile slots the blocked aggregation paths actually touch:
+    each NONEMPTY dst block costs its extent rounded out to whole
+    128-edge tiles (a tile straddled by two blocks is charged to both —
+    the ELL cost model); empty blocks cost nothing. The numerator of
+    ``block_fill_pct`` (obs/device.py) beside ``pad_waste_pct``'s
+    bucket-rung denominator."""
+    bs = block_starts.astype(np.int64)
+    lo, hi = bs[:-1], bs[1:]
+    tiles = np.where(
+        hi > lo,
+        -(-hi // EDGE_BLOCK_ROWS) - lo // EDGE_BLOCK_ROWS,
+        0,
+    )
+    return int(tiles.sum()) * EDGE_BLOCK_ROWS
+
 
 def pad_to_bucket(n: int, minimum: int = 128) -> int:
     """Next bucket ≥ n: powers of two with 1.5× midpoints (from 256 up, so
@@ -67,6 +112,13 @@ class GraphBatch:
     # r03 trace — hoisted out of the bench loop by LICM but paid by
     # EVERY serve-side window). Lazily filled by device_arrays.
     node_deg: Optional[np.ndarray] = field(default=None, repr=False)
+    # [N_pad//128 + 1] i32 blocked-CSR row starts over the real edge
+    # prefix (ISSUE 20) — a WINDOW INVARIANT like node_deg, computed
+    # once on the host (one searchsorted over the dst-sorted prefix)
+    # and shipped only when the blocked layout is selected. Lazily
+    # filled by block_starts(); the builder/native close paths fill it
+    # eagerly under EDGE_LAYOUT=blocked so close-time accounting sees it.
+    edge_block_starts: Optional[np.ndarray] = field(default=None, repr=False)
 
     @property
     def n_pad(self) -> int:
@@ -108,8 +160,26 @@ class GraphBatch:
             np.rint(np.expm1(self.edge_feats[: self.n_edges, 0])).sum()
         )
 
-    def device_arrays(self) -> dict:
-        """The pytree the jit'd model consumes (static shapes only)."""
+    def block_starts(self) -> np.ndarray:
+        """The blocked layout's per-128-dst-row extents (lazy window
+        invariant, see ``edge_block_starts_from``)."""
+        if self.edge_block_starts is None:
+            self.edge_block_starts = edge_block_starts_from(
+                self.edge_dst, self.n_edges, self.n_pad
+            )
+        return self.edge_block_starts
+
+    @property
+    def blocked_edge_slots(self) -> int:
+        """Edge-tile slots the blocked paths touch for this window."""
+        return blocked_edge_slots_from(self.block_starts())
+
+    def device_arrays(self, edge_layout: str = "coo") -> dict:
+        """The pytree the jit'd model consumes (static shapes only).
+        ``edge_layout="blocked"`` adds the ``edge_block_starts`` extents
+        — a DIFFERENT pytree structure, so the two layouts compile (and
+        cache) as separate programs; per layout the structure is fixed,
+        so selection costs zero retraces (alazjit-pinned)."""
         if self.node_deg is None:
             # pad edges sit masked on the last node slot and are excluded
             # by the [:n_edges] slice, so this equals the in-model
@@ -117,7 +187,7 @@ class GraphBatch:
             self.node_deg = np.bincount(
                 self.edge_dst[: self.n_edges], minlength=self.n_pad
             ).astype(np.float32)
-        return {
+        out = {
             "node_feats": self.node_feats,
             "node_type": self.node_type,
             "node_mask": self.node_mask,
@@ -128,6 +198,9 @@ class GraphBatch:
             "edge_feats": self.edge_feats,
             "edge_mask": self.edge_mask,
         }
+        if edge_layout == "blocked":
+            out["edge_block_starts"] = self.block_starts()
+        return out
 
     @staticmethod
     def from_presorted(

@@ -109,6 +109,27 @@ def maybe_znorm_graph(graph: dict, cfg: ModelConfig, axis: str | None = None) ->
     )
 
 
+def graph_block_starts(graph: dict, cfg: ModelConfig) -> jnp.ndarray | None:
+    """The blocked layout's per-128-dst extents for this batch, or None
+    under COO — the ONE model-entry selection point (ISSUE 20). A plain
+    Python branch on the config string plus a dict-key lookup, so the
+    choice is static under jit: per layout the traced pytree is fixed
+    and selection costs zero retraces (alazjit-pinned). A blocked
+    config over a batch that never shipped extents raises instead of
+    silently scoring the COO path — a quiet fallback would poison every
+    '[blocked]'-tagged benchmark series."""
+    if cfg.edge_layout != "blocked":
+        return None
+    bs = graph.get("edge_block_starts")
+    if bs is None:
+        raise ValueError(
+            "edge_layout='blocked' but the graph carries no "
+            "edge_block_starts — ship batches via "
+            "GraphBatch.device_arrays(edge_layout='blocked')"
+        )
+    return bs
+
+
 def scatter_messages(
     msgs: jnp.ndarray,
     edge_dst: jnp.ndarray,
@@ -116,10 +137,13 @@ def scatter_messages(
     num_nodes: int,
     use_pallas: bool | str,
     deg: jnp.ndarray | None = None,
+    block_starts: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Masked message scatter → (sum [N,H], degree [N]). Dispatches like
     ``segment_sum_sorted_dispatch`` (Pallas dst-sorted kernel on TPU /
-    forced ``"interpret"``, XLA segment_sum elsewhere)."""
+    forced ``"interpret"``, XLA segment_sum elsewhere); ``block_starts``
+    routes both paths through the blocked layout's extent-aware
+    variants (bit-exact — ops/segment.py blocked_segment_sum)."""
     mask_col = edge_mask[:, None].astype(msgs.dtype)
     m = msgs * mask_col
     if deg is None and pallas_enabled(use_pallas) and msgs.shape[1] % 128 != 0:
@@ -129,10 +153,13 @@ def scatter_messages(
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
         out = scatter_sum_sorted(
-            jnp.concatenate([m, mask_col], axis=1), edge_dst, num_nodes
+            jnp.concatenate([m, mask_col], axis=1), edge_dst, num_nodes,
+            None, block_starts,
         )
         return out[:, :-1], out[:, -1]
-    agg = segment_sum_sorted_dispatch(m, edge_dst, num_nodes, use_pallas)
+    agg = segment_sum_sorted_dispatch(
+        m, edge_dst, num_nodes, use_pallas, block_starts=block_starts
+    )
     if deg is None:
         # models hoist this via masked_degree (edge_dst/edge_mask are
         # layer-invariant); recomputed here only for direct callers

@@ -26,6 +26,7 @@ from alaz_tpu.models.common import (
     dense_init,
     edge_head,
     edge_head_init,
+    graph_block_starts,
     layernorm,
     layernorm_init,
     maybe_znorm_graph,
@@ -88,6 +89,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
     # slots 7..15 (builder.py), learned through edge_proj — no per-edge
     # embedding gather (row-op bound on TPU)
     ef = graph["edge_feats"].astype(dtype)
+    # blocked layout: the host-shipped dst-block extents (None under COO)
+    block_starts = graph_block_starts(graph, cfg)
 
     def layer_fn(layer, h32):
         h = h32.astype(dtype)
@@ -141,7 +144,9 @@ def apply(params: Params, graph: dict, cfg: ModelConfig) -> dict:
         # in f32 (a bf16 running sum stagnates at hub fan-in ~256); the
         # kernel path still DMAs bf16 and accumulates f32 on the MXU
         fused = jnp.concatenate([msgs, w.astype(msgs.dtype)], axis=1)
-        agg_all = segment_sum_accurate(fused, dst, n, cfg.use_pallas)
+        agg_all = segment_sum_accurate(
+            fused, dst, n, cfg.use_pallas, block_starts=block_starts
+        )
         num = agg_all[:, : nh * hd].reshape(n, nh, hd)
         denom = agg_all[:, nh * hd :]  # [N, nh]
         # double-where: nodes with no unmasked in-edges (pad slot, loners)

@@ -26,6 +26,7 @@ from alaz_tpu.models.common import (
     edge_head_init,
     layernorm,
     layernorm_init,
+    graph_block_starts,
     maybe_znorm_graph,
     mlp,
     graph_degree,
@@ -89,6 +90,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
     # the batch (host bincount) — the in-graph fallback covers
     # hand-built graph dicts (models/common.py graph_degree)
     deg = graph_degree(graph, jnp.float32, n)
+    # blocked layout: the host-shipped dst-block extents (None under COO)
+    block_starts = graph_block_starts(graph, cfg)
 
     def layer_fn(layer, h32):
         h = h32.astype(dtype)
@@ -99,7 +102,8 @@ def apply(params: Params, graph: dict, cfg: ModelConfig, h_bias=None) -> dict:
             dense(layer["msg"], h), graph["edge_src"], n, cfg.src_gather
         ) + dense(layer["edge_proj"], ef)
         agg, _ = scatter_messages(
-            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas, deg=deg
+            msgs, graph["edge_dst"], edge_mask, n, cfg.use_pallas, deg=deg,
+            block_starts=block_starts,
         )
         agg = agg / jnp.maximum(deg, 1.0)[:, None]
         h_new = dense(layer["self"], h) + dense(layer["neigh"], agg.astype(dtype))

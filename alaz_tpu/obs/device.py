@@ -80,6 +80,16 @@ def batch_pad_waste_pct(batches) -> float:
     return pad_waste_pct_from(real, slots - real)
 
 
+def blocked_pad_waste_pct_from(real_slots: int, block_slots: int) -> float:
+    """The blocked layout's waste through the SAME definition: under
+    ``EDGE_LAYOUT=blocked`` the aggregation paths process the per-block
+    tile slots (graph/snapshot.blocked_edge_slots_from), not the bucket
+    rung, so waste = the tile slots that aren't real edges. Feeding
+    :func:`pad_waste_pct_from` keeps the two layouts' numbers directly
+    comparable — same formula, different slot denominator (ISSUE 20)."""
+    return pad_waste_pct_from(real_slots, max(block_slots - real_slots, 0))
+
+
 # occupancy is a LINEAR 0..1 ratio, not a latency: on the default 2x
 # geometric ladder a 55% and a 100% window land in the same bucket and
 # interpolation can report >100%. A 5%-step linear ladder gives
@@ -91,13 +101,18 @@ OCCUPANCY_BOUNDS = tuple(round(0.05 * i, 2) for i in range(1, 21))
 
 class _BucketStats:
     """Per-bucket telemetry cell: score latency + occupancy histograms
-    and exact staged/scored counters."""
+    and exact staged/scored counters. ``block_fill_hist`` is created
+    lazily on the first BLOCKED window (the batch shipped extents) —
+    a COO-only deployment never registers the series (sparse, absent
+    not zero)."""
 
-    __slots__ = ("score_hist", "occupancy_hist", "staged", "scored")
+    __slots__ = ("score_hist", "occupancy_hist", "block_fill_hist",
+                 "staged", "scored")
 
     def __init__(self, score_hist: Histogram, occupancy_hist: Histogram):
         self.score_hist = score_hist
         self.occupancy_hist = occupancy_hist
+        self.block_fill_hist: Optional[Histogram] = None
         self.staged = 0  # windows staged (occupancy observations)
         self.scored = 0  # windows scored (score_hist observations)
 
@@ -128,6 +143,11 @@ class DeviceTelemetry:
         self.staged_edges = 0  # real (masked-in) edge slots  # guarded-by: self._lock
         self.padded_edge_slots = 0  # pad tail slots  # guarded-by: self._lock
         self.transfer_bytes = 0  # host→device bytes dispatched  # guarded-by: self._lock
+        # blocked-layout twin ledger (ISSUE 20): real edges vs the tile
+        # slots the blocked reduce touches, accumulated only for windows
+        # that shipped extents — a COO deployment leaves both at 0
+        self.blocked_staged_edges = 0  # guarded-by: self._lock
+        self.blocked_edge_slots = 0  # tile slots  # guarded-by: self._lock
         if metrics is not None and enabled:
             self.arena_hist = metrics.histogram("latency.stage_arena_s")
             self.transfer_hist = metrics.histogram("latency.stage_transfer_s")
@@ -138,6 +158,7 @@ class DeviceTelemetry:
                 "device.padded_edge_slots", lambda: self.padded_edge_slots
             )
             metrics.gauge("device.pad_waste_pct", lambda: self.pad_waste_pct)
+            metrics.gauge("device.block_fill_pct", lambda: self.block_fill_pct)
         else:
             # disabled (or registry-less): keep private histograms and
             # register NOTHING — a killed plane must be absent from the
@@ -183,12 +204,36 @@ class DeviceTelemetry:
         with self._lock:
             return self._buckets.setdefault(key, nb)
 
+    def _block_fill_hist(self, key: str, b: _BucketStats) -> Histogram:
+        # same ABBA discipline as _bucket: the registry registration
+        # runs with the device lock RELEASED; double-checked, racers
+        # both build and one wins (the histogram is registry-shared
+        # under a Metrics registry either way)
+        with self._lock:
+            h = b.block_fill_hist
+        if h is not None:
+            return h
+        if self.metrics is not None:
+            nh = self.metrics.histogram(
+                f"device.block_fill.{key}", sparse=True,
+                bounds=OCCUPANCY_BOUNDS,
+            )
+        else:
+            nh = Histogram(f"device.block_fill.{key}", bounds=OCCUPANCY_BOUNDS)
+        with self._lock:
+            if b.block_fill_hist is None:
+                b.block_fill_hist = nh
+            return b.block_fill_hist
+
     # -- staging side --------------------------------------------------------
 
     def observe_staged(self, batch) -> None:
         """One window entered the staging path: occupancy (rows vs
         bucket capacity) + the pad-waste ledger. Called once per REAL
-        window — group-padding duplicates are not re-counted."""
+        window — group-padding duplicates are not re-counted. A window
+        that shipped blocked extents additionally feeds the block-fill
+        ledger and its per-bucket histogram (the blocked layout's
+        occupancy twin)."""
         if not self.enabled:
             return
         key = bucket_key(batch)
@@ -196,11 +241,22 @@ class DeviceTelemetry:
         n_edges = int(batch.n_edges)
         b = self._bucket(key)
         b.occupancy_hist.observe(float(batch.edge_occupancy))
+        block_slots = 0
+        if getattr(batch, "edge_block_starts", None) is not None:
+            block_slots = int(batch.blocked_edge_slots)
+            if block_slots > 0:
+                # fill ratio over the slots the blocked reduce touches
+                # (real <= slots by construction, so the 0..1 linear
+                # occupancy ladder applies unchanged)
+                self._block_fill_hist(key, b).observe(n_edges / block_slots)
         with self._lock:
             b.staged += 1
             self.staged_windows += 1
             self.staged_edges += n_edges
             self.padded_edge_slots += e_pad - n_edges
+            if block_slots > 0:
+                self.blocked_staged_edges += n_edges
+                self.blocked_edge_slots += block_slots
 
     def observe_transfer(
         self, n_bytes: int, arena_s: float, transfer_s: float
@@ -240,6 +296,17 @@ class DeviceTelemetry:
         padded = self.padded_edge_slots  # alazlint: disable=ALZ010 -- same intentionally racy read as the line above
         return pad_waste_pct_from(staged, padded)
 
+    @property
+    def block_fill_pct(self) -> float:
+        # LOCKLESS for the same ABBA reason as pad_waste_pct (this backs
+        # a registered gauge); 0.0 until the first blocked window —
+        # mirrors pad_waste_pct's never-NaN empty reading
+        real = self.blocked_staged_edges  # alazlint: disable=ALZ010 -- intentionally racy gauge read, see pad_waste_pct
+        slots = self.blocked_edge_slots  # alazlint: disable=ALZ010 -- same intentionally racy read as the line above
+        if not slots:
+            return 0.0
+        return 100.0 - blocked_pad_waste_pct_from(real, slots)
+
     def snapshot(self) -> dict:
         """The ``/stats`` per-bucket breakdown (next to the span plane's
         ``stage_latency``): occupancy + score percentiles per bucket,
@@ -258,6 +325,17 @@ class DeviceTelemetry:
                 "padded_edge_slots": self.padded_edge_slots,
                 "transfer_bytes": self.transfer_bytes,
             }
+            if self.blocked_edge_slots:
+                # blocked ledger rides /stats only once a blocked window
+                # staged (the sparse absent-not-zero discipline)
+                out["block_fill_pct"] = round(
+                    100.0
+                    - blocked_pad_waste_pct_from(
+                        self.blocked_staged_edges, self.blocked_edge_slots
+                    ),
+                    3,
+                )
+                out["blocked_edge_slots"] = self.blocked_edge_slots
         # histogram walks take the stripe locks — outside the plane lock
         arena, transfer = self.arena_hist.snapshot(), self.transfer_hist.snapshot()
         out["stage_split_ms"] = {
@@ -285,6 +363,11 @@ class DeviceTelemetry:
                 "occupancy_p50_pct": round(occ["p50"] * 100.0, 2),
                 "occupancy_p99_pct": round(occ["p99"] * 100.0, 2),
             }
+            if b.block_fill_hist is not None:
+                fill = b.block_fill_hist.snapshot()
+                per_bucket[key]["block_fill_p50_pct"] = round(
+                    fill["p50"] * 100.0, 2
+                )
         out["buckets"] = per_bucket
         return out
 

@@ -113,16 +113,35 @@ def _scatter_kernel(row_start_ref, msgs_hbm, dst_hbm, out_ref, msg_scratch, dst_
         jax.lax.fori_loop(c0, c1, body, 0)
 
 
-def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, interpret: bool = False) -> jnp.ndarray:
+def _scatter_sorted(
+    msgs: jnp.ndarray,
+    edge_dst: jnp.ndarray,
+    num_nodes: int,
+    interpret: bool = False,
+    block_starts: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """msgs may be float32 or bfloat16 — bf16 halves the DMA bytes (the
-    kernel's bound) while the MXU accumulates in f32 either way."""
+    kernel's bound) while the MXU accumulates in f32 either way.
+
+    ``block_starts`` (the blocked layout's host-precomputed per-128-dst
+    extents, graph/snapshot.py ``edge_block_starts_from``) replaces the
+    on-device binary search: the SAME scalar-prefetch vector, computed
+    once at window close instead of per dispatch. Entries agree with
+    the searchsorted values on every real edge; the one difference is
+    the final sentinel (``n_edges``, not ``e_pad``), so the kernel's
+    last dst block skips the chunks holding only bucket padding — pad
+    edges stop accumulating into the masked last node row, exactly the
+    blocked XLA fallback's frontier discipline."""
     e, f = msgs.shape
     assert e % 128 == 0 and num_nodes % TILE_N == 0, (
         f"pad edges/nodes to 128/{TILE_N} multiples (GraphBatch buckets do)"
     )
     n_blocks = num_nodes // TILE_N
-    boundaries = jnp.arange(0, num_nodes + 1, TILE_N, dtype=jnp.int32)
-    row_start = jnp.searchsorted(edge_dst, boundaries).astype(jnp.int32)
+    if block_starts is None:
+        boundaries = jnp.arange(0, num_nodes + 1, TILE_N, dtype=jnp.int32)
+        row_start = jnp.searchsorted(edge_dst, boundaries).astype(jnp.int32)
+    else:
+        row_start = block_starts.astype(jnp.int32)
     if e % TILE_E != 0:
         # bucket sizes are 128-multiples; round the edge axis up to TILE_E
         pad = TILE_E - e % TILE_E
@@ -162,17 +181,19 @@ def _scatter_sorted(msgs: jnp.ndarray, edge_dst: jnp.ndarray, num_nodes: int, in
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def scatter_sum_sorted(msgs, edge_dst, num_nodes, out_dtype=None):
+def scatter_sum_sorted(msgs, edge_dst, num_nodes, out_dtype=None, block_starts=None):
     """out[d] = Σ_{e: dst[e]=d} msgs[e] for arbitrary per-edge messages
     (models add edge features/type embeddings before scattering).
     ``out_dtype=None`` returns the input dtype (one rounding of the f32
     MXU accumulator for bf16 inputs); pass ``jnp.float32`` where the sum
     feeds a normalization and that rounding matters
-    (``segment_sum_accurate``)."""
-    return _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype)
+    (``segment_sum_accurate``). ``block_starts`` feeds the blocked
+    layout's precomputed extents straight into the scalar prefetch —
+    see ``_scatter_sorted``."""
+    return _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype, block_starts)
 
 
-def _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype=None):
+def _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype=None, block_starts=None):
     dtype = msgs.dtype if out_dtype is None else jnp.dtype(out_dtype)
     if msgs.dtype not in (jnp.float32, jnp.bfloat16):
         msgs = msgs.astype(jnp.float32)
@@ -181,21 +202,24 @@ def _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype=None):
     if f_pad != f:
         msgs = jnp.pad(msgs, ((0, 0), (0, f_pad - f)))
     interpret = jax.default_backend() != "tpu"
-    out = _scatter_sorted(msgs, edge_dst, num_nodes, interpret=interpret)
+    out = _scatter_sorted(
+        msgs, edge_dst, num_nodes, interpret=interpret, block_starts=block_starts
+    )
     return out[:, :f].astype(dtype)
 
 
-def _scatter_vjp_fwd(msgs, edge_dst, num_nodes, out_dtype):
+def _scatter_vjp_fwd(msgs, edge_dst, num_nodes, out_dtype, block_starts=None):
     # residuals must be jax types: carry the input dtype as a 0-size token
     return (
-        _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype),
+        _scatter_fwd_impl(msgs, edge_dst, num_nodes, out_dtype, block_starts),
         (edge_dst, jnp.zeros((0,), msgs.dtype)),
     )
 
 
 def _scatter_vjp_bwd(num_nodes, out_dtype, residuals, g):
     edge_dst, dtype_token = residuals
-    return (g[edge_dst].astype(dtype_token.dtype), None)
+    # the extents are integer metadata — no cotangent, like edge_dst
+    return (g[edge_dst].astype(dtype_token.dtype), None, None)
 
 
 scatter_sum_sorted.defvjp(_scatter_vjp_fwd, _scatter_vjp_bwd)

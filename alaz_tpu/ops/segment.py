@@ -10,14 +10,77 @@ dispatches between them.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
+from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS
+
+# Warn-once latches for the dispatch fallbacks below. The bare
+# module-global check-then-act ("if not WARNED: WARNED = True; log")
+# was the exact race shape PR 18 closed elsewhere: two threads hitting
+# the first fallback concurrently both observe False and both log.
+# The flip is double-checked under _WARN_LOCK; the log call itself runs
+# OUTSIDE the lock (lock-order discipline — get_logger may take the
+# logging module's own lock, and nothing else may nest under ours).
+_WARN_LOCK = threading.Lock()
 _FALLBACK_WARNED = False
+
+
+def _warn_once_fallback() -> bool:
+    """Atomically claim the pallas-fallback warning; True for the one
+    caller that should emit it."""
+    global _FALLBACK_WARNED
+    with _WARN_LOCK:
+        claimed = not _FALLBACK_WARNED
+        _FALLBACK_WARNED = True
+    return claimed
 
 
 def segment_sum(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def blocked_segment_sum(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    block_starts: jnp.ndarray,
+    num_segments: int,
+    out_dtype=None,
+) -> jnp.ndarray:
+    """[E, F] → [N, F] sum using the precomputed dst-block extents — the
+    blocked layout's XLA fallback (ISSUE 20, ARCHITECTURE §3v).
+
+    ``block_starts`` is the blocked-CSR row-start vector the host emits
+    at window close (graph/snapshot.py ``edge_block_starts_from``):
+    entry b is the first edge of dst block b, and ``block_starts[-1]``
+    is the live-edge FRONTIER — every slot at or past it is bucket
+    padding. The edge axis is viewed as 128-row tiles, slots past the
+    frontier are zeroed (so pad slots contribute exactly 0.0 — the COO
+    path's edge_mask discipline, enforced here by construction), and
+    the result is the plain sorted segment reduce over the masked
+    tiles. Bit-exact vs the COO path on every real node row: masking
+    only ever ADDS exact zeros, and f32 addition of 0.0 is the
+    identity. The CPU win comes from the caller dispatching at the
+    TILE-TRIMMED shape (``ceil(n_edges/128)·128`` rows instead of the
+    bucket rung — bench.py ``layout_ab`` measures it); inside a
+    fixed-bucket jit the same code is the bit-exact parity surface the
+    Pallas extent kernel is tested against."""
+    e = data.shape[0]
+    assert e % EDGE_BLOCK_ROWS == 0, f"edge axis {e} not tile-aligned"
+    n_tiles = e // EDGE_BLOCK_ROWS
+    pos = (
+        jax.lax.broadcasted_iota(jnp.int32, (n_tiles, EDGE_BLOCK_ROWS), 0)
+        * EDGE_BLOCK_ROWS
+        + jax.lax.broadcasted_iota(jnp.int32, (n_tiles, EDGE_BLOCK_ROWS), 1)
+    )
+    live = (pos < block_starts[-1]).reshape(e)
+    if data.ndim > 1:
+        live = live.reshape((e,) + (1,) * (data.ndim - 1))
+    masked = jnp.where(live, data, jnp.zeros((), dtype=data.dtype))
+    out = jax.ops.segment_sum(masked, segment_ids, num_segments=num_segments)
+    return out if out_dtype is None else out.astype(out_dtype)
 
 
 def segment_mean(
@@ -25,19 +88,25 @@ def segment_mean(
     segment_ids: jnp.ndarray,
     num_segments: int,
     weights: jnp.ndarray | None = None,
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Mean with masked counts: ``weights`` (0/1 per edge) excludes padding
-    edges from both numerator and denominator."""
+    edges from both numerator and denominator. ``block_starts`` routes
+    the three reductions through the blocked fallback (frontier-masked
+    tiles) — bit-exact, since pad edges carry weight 0 either way."""
+    if block_starts is not None:
+        def _sum(d):
+            return blocked_segment_sum(d, segment_ids, block_starts, num_segments)
+    else:
+        def _sum(d):
+            return jax.ops.segment_sum(d, segment_ids, num_segments=num_segments)
+
     if weights is not None:
         data = data * weights[:, None]
-        counts = jax.ops.segment_sum(weights, segment_ids, num_segments=num_segments)
+        counts = _sum(weights)
     else:
-        counts = jax.ops.segment_sum(
-            jnp.ones(segment_ids.shape[0], dtype=data.dtype),
-            segment_ids,
-            num_segments=num_segments,
-        )
-    totals = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+        counts = _sum(jnp.ones(segment_ids.shape[0], dtype=data.dtype))
+    totals = _sum(data)
     return totals / jnp.maximum(counts, 1.0)[:, None]
 
 
@@ -96,17 +165,27 @@ def segment_sum_sorted_dispatch(
     num_segments: int,
     use_pallas: bool | str = False,
     out_dtype=None,
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """[E, F] → [N, F] sum over dst-SORTED segment ids, dispatched like
     ``expand_dst``: Pallas one-hot scatter on TPU (DMA-bound, ~2× the
     XLA scatter's row-op-bound rate — ARCHITECTURE.md §3b table),
     interpret mode when forced, XLA ``segment_sum`` elsewhere.
     ``out_dtype`` requests the kernel path emit that dtype straight from
-    its f32 accumulator (no input-dtype rounding); the XLA path casts."""
+    its f32 accumulator (no input-dtype rounding); the XLA path casts.
+    ``block_starts`` (the blocked layout's precomputed extents) hands
+    the kernel its per-block row starts — no on-device binary search —
+    and routes the fallback through ``blocked_segment_sum``."""
     if pallas_enabled(use_pallas):
         from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
 
-        return scatter_sum_sorted(data, segment_ids, num_segments, out_dtype)
+        return scatter_sum_sorted(
+            data, segment_ids, num_segments, out_dtype, block_starts
+        )
+    if block_starts is not None:
+        return blocked_segment_sum(
+            data, segment_ids, block_starts, num_segments, out_dtype
+        )
     out = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
     return out if out_dtype is None else out.astype(out_dtype)
 
@@ -125,6 +204,7 @@ def segment_sum_accurate(
     segment_ids: jnp.ndarray,
     num_segments: int,
     use_pallas: bool | str = False,
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """``segment_sum_sorted_dispatch`` with guaranteed f32 ACCUMULATION
     and a LOSSLESS f32 result. The Pallas kernel accumulates f32 on the
@@ -139,12 +219,24 @@ def segment_sum_accurate(
     if not pallas_enabled(use_pallas):
         data = data.astype(jnp.float32)
     return segment_sum_sorted_dispatch(
-        data, segment_ids, num_segments, use_pallas, out_dtype=jnp.float32
+        data, segment_ids, num_segments, use_pallas,
+        out_dtype=jnp.float32, block_starts=block_starts,
     )
 
 
 _SRC_GATHER_MODES = ("xla", "banded", "banded-interpret")
+# same double-checked latch discipline as _FALLBACK_WARNED (top of file)
 _banded_fallback_warned = False
+
+
+def _warn_once_banded() -> bool:
+    """Atomically claim the banded-off-TPU warning; True for the one
+    caller that should emit it."""
+    global _banded_fallback_warned
+    with _WARN_LOCK:
+        claimed = not _banded_fallback_warned
+        _banded_fallback_warned = True
+    return claimed
 
 
 def gather_src(
@@ -171,9 +263,7 @@ def gather_src(
 
         return gather_rows_banded(v, src_ids, num_nodes)
     if mode == "banded":
-        global _banded_fallback_warned
-        if not _banded_fallback_warned:
-            _banded_fallback_warned = True
+        if _warn_once_banded():
             from alaz_tpu.logging import get_logger
 
             get_logger("alaz_tpu.ops").warning(
@@ -231,12 +321,17 @@ def gather_scatter_sum(
     num_nodes: int,
     edge_weight: jnp.ndarray | None = None,
     use_pallas: bool | None = None,
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """out[d] = Σ_{e: dst[e]=d} w[e] · x[src[e]] — the GNN hot loop.
 
     Dispatches to the Pallas TPU kernel when edges are dst-sorted (the
     GraphBatch layout guarantees this) and a TPU backend is active;
-    otherwise the XLA gather + segment_sum path.
+    otherwise the XLA gather + segment_sum path. ``block_starts`` (the
+    blocked layout's precomputed extents) routes the scatter half
+    through ``blocked_segment_sum``; the Pallas kernel path ignores it
+    here because ``pallas_gather_scatter_sum`` fuses gather+scatter in
+    one grid and carries its own row-start prefetch.
     """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -246,9 +341,7 @@ def gather_scatter_sum(
 
             return pallas_gather_scatter_sum(x, edge_src, edge_dst, num_nodes, edge_weight)
         except Exception as exc:  # pragma: no cover - lowering issues
-            global _FALLBACK_WARNED
-            if not _FALLBACK_WARNED:
-                _FALLBACK_WARNED = True
+            if _warn_once_fallback():
                 from alaz_tpu.logging import get_logger
 
                 get_logger("alaz_tpu.ops").warning(
@@ -258,4 +351,6 @@ def gather_scatter_sum(
     msgs = x[edge_src]
     if edge_weight is not None:
         msgs = msgs * edge_weight[:, None]
+    if block_starts is not None:
+        return blocked_segment_sum(msgs, edge_dst, block_starts, num_nodes)
     return jax.ops.segment_sum(msgs, edge_dst, num_segments=num_nodes)

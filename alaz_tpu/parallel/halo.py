@@ -25,9 +25,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from alaz_tpu.ops.segment import ATTENTION_LOGIT_CLAMP
+from alaz_tpu.ops.segment import ATTENTION_LOGIT_CLAMP, blocked_segment_sum
 from alaz_tpu.parallel.collectives import axis_size, ring_shift
 from alaz_tpu.parallel.mesh import shard_map
+
+
+def _hop_segment_sum(data, edge_dst_local, n_loc, block_starts):
+    """The per-hop local reduce both ring aggregators share: the plain
+    sorted segment sum under COO, the extent-aware tiled reduce when the
+    blocked layout ships shard-local ``block_starts`` (ISSUE 20) —
+    bit-exact either way, since every hop's messages are already
+    sel-masked to zero on non-live edges."""
+    if block_starts is not None:
+        return blocked_segment_sum(data, edge_dst_local, block_starts, n_loc)
+    return jax.ops.segment_sum(data, edge_dst_local, num_segments=n_loc)
 
 
 def ring_gather_scatter(
@@ -36,12 +47,15 @@ def ring_gather_scatter(
     edge_dst_local: jnp.ndarray,  # [e_loc] LOCAL dst ids (dst - my_offset)
     edge_mask: jnp.ndarray,  # [e_loc]
     axis: str = "sp",
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """out[d_local] = Σ_{e: dst=d} h[src[e]] with h sharded over ``axis``.
 
     Must run inside shard_map over ``axis``. D ring steps; at step k this
     device holds the block owned by (my_idx - k) mod D and processes the
-    edges whose src falls in it.
+    edges whose src falls in it. ``block_starts`` (shard-local blocked
+    extents, sharded_model.shard_block_starts) routes each hop's reduce
+    through the blocked layout's tiled path.
     """
     n_loc = h_local.shape[0]
     d = axis_size(axis)
@@ -55,7 +69,7 @@ def ring_gather_scatter(
         owner = jax.lax.rem(my_idx - k + d, d)
         sel = (src_owner == owner) & edge_mask
         msgs = blk[src_local] * sel[:, None].astype(blk.dtype)
-        acc = acc + jax.ops.segment_sum(msgs, edge_dst_local, num_segments=n_loc)
+        acc = acc + _hop_segment_sum(msgs, edge_dst_local, n_loc, block_starts)
         blk = ring_shift(blk, axis, shift=1)
         return acc, blk
 
@@ -109,6 +123,7 @@ def ring_attention_aggregate(
     edge_mask: jnp.ndarray,  # [e_loc]
     axis: str = "sp",
     logit_clamp: float = ATTENTION_LOGIT_CLAMP,
+    block_starts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """**Ring attention for graphs**: the fused GAT softmax-aggregate
     (models/gat.py layer_fn) over a node-sharded graph. Per ring hop this
@@ -163,7 +178,7 @@ def ring_attention_aggregate(
             (kv_src + e_feat).astype(jnp.float32) * w[:, :, None]
         ).reshape(-1, nh * hd)
         fused = jnp.concatenate([msgs, w], axis=1)
-        acc = acc + jax.ops.segment_sum(fused, edge_dst_local, num_segments=n_loc)
+        acc = acc + _hop_segment_sum(fused, edge_dst_local, n_loc, block_starts)
         blk = ring_shift(blk, axis, shift=1)
         return acc, blk
 

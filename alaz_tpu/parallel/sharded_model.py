@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from alaz_tpu.config import ModelConfig
-from alaz_tpu.graph.snapshot import GraphBatch
+from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS, GraphBatch
 from alaz_tpu.models.common import (
     compute_dtype,
     dense,
@@ -114,6 +114,29 @@ def shard_graph_batch(batch: GraphBatch, n_shards: int) -> tuple[dict, np.ndarra
     return out, perm
 
 
+def shard_block_starts(
+    dst_local: jnp.ndarray, edge_mask: jnp.ndarray, n_loc: int
+) -> jnp.ndarray | None:
+    """Shard-local twin of graph/snapshot.edge_block_starts_from: the
+    per-128-dst-row extents over this shard's live edge prefix, derived
+    in-body (the host wire format — SHARDED_GRAPH_KEYS — is unchanged).
+
+    Valid because ``edge_dst_local`` is globally sorted: the live prefix
+    is dst-sorted by partition_edges_by_dst and the pad fill (n_loc - 1)
+    is >= every live value. Interior extents from searchsorted therefore
+    agree with the host definition; only the final sentinel would land
+    at e_budget instead of n_live (pads share dst n_loc - 1), so the
+    minimum clamps every entry to the live-edge frontier. Requires
+    n_loc % EDGE_BLOCK_ROWS == 0 — callers gate (n_loc can be 64 at the
+    smallest bucket over 4 shards; e_budget is always 128-rounded)."""
+    if n_loc % EDGE_BLOCK_ROWS != 0:
+        return None
+    n_live = jnp.sum(edge_mask.astype(jnp.int32))
+    bounds = jnp.arange(0, n_loc + 1, EDGE_BLOCK_ROWS, dtype=jnp.int32)
+    starts = jnp.searchsorted(dst_local.astype(jnp.int32), bounds)
+    return jnp.minimum(starts.astype(jnp.int32), n_live)
+
+
 def _sharded_heads(params, h, ef, src, dst_local, edge_mask, dtype, axis):
     """The split edge head + node head over one node shard (shared by
     both node-sharded forwards so the serving paths cannot drift):
@@ -188,12 +211,20 @@ def make_node_sharded_graphsage(
         # degree is layer-invariant: one [E] scatter per forward (the
         # same hoist the single-device models carry)
         deg = masked_degree(edge_mask, dst_local, n_loc, jnp.float32)
+        # blocked layout: shard-local extents, derived once per forward
+        # (layer-invariant like deg); static cfg branch = zero retraces
+        bs = (
+            shard_block_starts(dst_local, edge_mask, n_loc)
+            if cfg.edge_layout == "blocked"
+            else None
+        )
 
         for layer in params["layers"]:
             # remote part: Σ_{dst local} (h W_msg)[src] via the ring
             hw = dense(layer["msg"], h.astype(dtype))
             ring_agg = ring_gather_scatter(
-                hw.astype(jnp.float32), src, dst_local, edge_mask, axis=axis
+                hw.astype(jnp.float32), src, dst_local, edge_mask, axis=axis,
+                block_starts=bs,
             )
             # local part: edge-feature messages scatter shard-locally,
             # through the Pallas kernel when the shard shapes qualify
@@ -203,7 +234,7 @@ def make_node_sharded_graphsage(
             ef_agg, _ = scatter_messages(
                 ef_msgs, dst_local, edge_mask, n_loc,
                 cfg.use_pallas if n_loc % 128 == 0 else False,
-                deg=deg,
+                deg=deg, block_starts=bs,
             )
             agg = (ring_agg + ef_agg) / jnp.maximum(deg, 1.0)[:, None]
             h_new = dense(layer["self"], h.astype(dtype)) + dense(
@@ -256,6 +287,13 @@ def make_node_sharded_gat(
         h = dense(params["embed"], g["node_feats"][0].astype(dtype))
         h = h.astype(jnp.float32) * node_mask[:, None]
 
+        # blocked layout: shard-local extents (see the graphsage maker)
+        bs = (
+            shard_block_starts(dst_local, edge_mask, n_loc)
+            if cfg.edge_layout == "blocked"
+            else None
+        )
+
         for layer in params["layers"]:
             attn = layer["attn"].astype(dtype)  # [nh, 3hd]
             a_q, a_k, a_e = attn[:, :hd], attn[:, hd : 2 * hd], attn[:, 2 * hd :]
@@ -267,7 +305,7 @@ def make_node_sharded_gat(
             e_part = jnp.einsum("ehd,hd->eh", e_feat, a_e)  # [e_loc, nh]
             agg = ring_attention_aggregate(
                 q_part, kv, e_part, e_feat, a_k,
-                src, dst_local, edge_mask, axis=axis,
+                src, dst_local, edge_mask, axis=axis, block_starts=bs,
             )
             h_new = dense(layer["out"], agg.astype(dtype))
             h = (

@@ -119,6 +119,10 @@ def graph_pspec(stacked: bool = True) -> dict:
         "edge_type": spec(1),
         "edge_feats": spec(2),
         "edge_mask": spec(1),
+        # blocked layout only: per-128-dst-row extent table. Absent from
+        # COO batches — consumers key off the data dict, so the extra
+        # entry here is inert under the default layout.
+        "edge_block_starts": spec(1),
     }
 
 

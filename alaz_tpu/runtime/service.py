@@ -907,7 +907,14 @@ class Service:
                 # share one arena fill and one dispatch
                 if len({int(getattr(b, "tenant", 0)) for b in batches}) > 1:
                     self.multi_tenant_groups += 1
-                cols = [b.device_arrays() for b in batches]
+                # layout selection (ISSUE 20): the scorer's ModelConfig
+                # decides the pytree — under "blocked" every window ships
+                # its (already close-time-computed) extents, and the
+                # arenas pick the column up generically from cols[0]
+                cols = [
+                    b.device_arrays(self.config.model.edge_layout)
+                    for b in batches
+                ]
                 target = 1
                 while target < len(cols):
                     target *= 2
@@ -1045,7 +1052,7 @@ class Service:
                     # dispatch: the serial path's arena analog is the
                     # device_arrays() call — same decomposition the
                     # group path gets from its arena fill
-                    cols = batch.device_arrays()
+                    cols = batch.device_arrays(self.config.model.edge_layout)
                     t_arena = time_module.perf_counter()
                     with self._bucket_ctx(batch):
                         if self._host_score:

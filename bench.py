@@ -361,6 +361,11 @@ def append_bench_history(out: dict, history_path: str = BENCH_HISTORY) -> None:
         # ISSUE 16 acceptance record: the same-run python/native
         # seconds-per-500k A/B for the per-shard process_l7 body
         entry["l7_engine_ab"] = out["l7_engine_ab"]
+    if out.get("layout_ab"):
+        # ISSUE 20 acceptance record: the same-run coo/blocked
+        # aggregation A/B + both layouts' slot-waste numbers
+        entry["layout_ab"] = out["layout_ab"]
+        entry["edge_layout"] = out.get("edge_layout")
     try:
         with open(history_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
@@ -415,6 +420,13 @@ def bench_ingest(args) -> dict:
     # env-reading RuntimeConfig default — export it so the [process]
     # arm's children run the same engine as the parent
     os.environ["ENGINE_BACKEND"] = engine
+    # same export idiom for the edge layout (ISSUE 20): the builders in
+    # this process AND spawned shard workers resolve EDGE_LAYOUT from
+    # the env-reading default. NOTE --layout is the node-id layout knob
+    # (random|clustered) — the edge-buffer layout is --edge-layout.
+    if getattr(args, "edge_layout", None):
+        os.environ["EDGE_LAYOUT"] = args.edge_layout
+    edge_layout = os.environ.get("EDGE_LAYOUT", "coo")
 
     n_rows = args.edges  # one L7 event per row
     windows = 8
@@ -755,6 +767,116 @@ def bench_ingest(args) -> dict:
             "# l7 engine A/B skipped: libalaz_ingest.so unavailable",
             file=sys.stderr,
         )
+    # edge-layout A/B (ISSUE 20): COO vs blocked assembly + aggregation
+    # over the SAME headline run's closed windows, on CPU XLA — the
+    # relay-dark acceptance story for the blocked layout (the Pallas
+    # extent variant is proven by interpret-mode parity tests, not
+    # here). Per window the COO arm reduces at the rung-padded shape;
+    # the blocked arm pays extent assembly (the close-time searchsorted)
+    # plus a tile-trimmed blocked_segment_sum dispatch — the trim is
+    # where the CPU win comes from, and it is exactly what the blocked
+    # wire table licenses: every edge past block_starts[-1] is pad.
+    # Bit-exactness of the arms is asserted in-run on the largest
+    # window. Compiles are warmed OUTSIDE the timed passes.
+    layout_ab = None
+    if importlib.util.find_spec("jax") is not None and closed_windows:
+        import jax
+        import jax.numpy as jnp
+
+        from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS
+        from alaz_tpu.obs.device import (
+            blocked_pad_waste_pct_from,
+            pad_waste_pct_from,
+        )
+        from alaz_tpu.ops.segment import blocked_segment_sum
+
+        coo_fn = jax.jit(
+            lambda d, i, n: jax.ops.segment_sum(d, i, num_segments=n),
+            static_argnums=(2,),
+        )
+        blk_fn = jax.jit(blocked_segment_sum, static_argnums=(3,))
+
+        def _trim(b):
+            # smallest 128-multiple covering the real prefix (>=1 tile)
+            return max(
+                -(-b.n_edges // EDGE_BLOCK_ROWS) * EDGE_BLOCK_ROWS,
+                EDGE_BLOCK_ROWS,
+            )
+
+        def agg_coo():
+            t0 = time.perf_counter()
+            for b in closed_windows:
+                coo_fn(b.edge_feats, b.edge_dst, b.n_pad).block_until_ready()
+            return time.perf_counter() - t0
+
+        def agg_blocked():
+            t0 = time.perf_counter()
+            for b in closed_windows:
+                # blocked assembly charged to this arm: the per-window
+                # extents, recomputed (not the cached close-time copy)
+                from alaz_tpu.graph.snapshot import edge_block_starts_from
+
+                bs = edge_block_starts_from(b.edge_dst, b.n_edges, b.n_pad)
+                e_trim = _trim(b)
+                blk_fn(
+                    b.edge_feats[:e_trim], b.edge_dst[:e_trim],
+                    jnp.asarray(bs), b.n_pad,
+                ).block_until_ready()
+            return time.perf_counter() - t0
+
+        agg_coo(), agg_blocked()  # warm: pin per-shape compiles
+        coo_s = blocked_s = float("inf")
+        for i in range(2):  # best-of-2, arms alternating (drift hits both)
+            if i % 2 == 0:
+                coo_s = min(coo_s, agg_coo())
+                blocked_s = min(blocked_s, agg_blocked())
+            else:
+                blocked_s = min(blocked_s, agg_blocked())
+                coo_s = min(coo_s, agg_coo())
+        big = max(closed_windows, key=lambda b: b.n_edges)
+        ref = coo_fn(big.edge_feats, big.edge_dst, big.n_pad)
+        e_trim = _trim(big)
+        got = blk_fn(
+            big.edge_feats[:e_trim], big.edge_dst[:e_trim],
+            jnp.asarray(big.block_starts()), big.n_pad,
+        )
+        if not bool((ref == got).all()):
+            raise RuntimeError(
+                "layout A/B arms disagree — the blocked reduce is not "
+                "bit-exact vs COO; the speedup number would be invalid"
+            )
+        real = sum(b.n_edges for b in closed_windows)
+        rung = sum(b.e_pad for b in closed_windows)
+        blk_slots = sum(b.blocked_edge_slots for b in closed_windows)
+        fill = (
+            100.0 - blocked_pad_waste_pct_from(real, blk_slots)
+            if blk_slots else 0.0
+        )
+        layout_ab = {
+            "coo_agg_s": round(coo_s, 4),
+            "blocked_agg_s": round(blocked_s, 4),
+            "speedup_x": round(coo_s / blocked_s, 2) if blocked_s > 0 else 0.0,
+            "pad_waste_pct_coo": round(
+                pad_waste_pct_from(real, rung - real), 2
+            ),
+            "pad_waste_pct_blocked": round(
+                blocked_pad_waste_pct_from(real, blk_slots), 2
+            ),
+            "block_fill_pct": round(fill, 2),
+        }
+        print(
+            f"# edge layout A/B (window aggregation): "
+            f"coo={coo_s:.3f}s blocked={blocked_s:.3f}s "
+            f"speedup={layout_ab['speedup_x']:.2f}x "
+            f"pad_waste coo={layout_ab['pad_waste_pct_coo']:.2f}% "
+            f"blocked={layout_ab['pad_waste_pct_blocked']:.2f}%",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            "# edge layout A/B skipped: jax unavailable or no windows",
+            file=sys.stderr,
+        )
     # score-plane A/B (ISSUE 13): replay the HEADLINE run's emitted
     # windows through the plane (deterministic feature-space scorer,
     # identical in both arms) with the plane armed vs killed — the arm
@@ -944,6 +1066,11 @@ def bench_ingest(args) -> dict:
         # ISSUE 16: python-vs-native seconds/500k-rows for the L7 body
         # of ONE shard worker, measured in this same run
         out["l7_engine_ab"] = l7_engine_ab
+    if layout_ab is not None:
+        # ISSUE 20: the same-run coo-vs-blocked aggregation A/B + both
+        # layouts' slot-waste over this run's windows
+        out["edge_layout"] = edge_layout
+        out["layout_ab"] = layout_ab
     if worker_scaling is not None:
         out["workers"] = args.workers
         out["worker_scaling"] = worker_scaling
@@ -990,6 +1117,33 @@ def bench_ingest(args) -> dict:
         except Exception as exc:  # a crashed leg is itself a finding
             print(f"# tenant serving leg crashed: {exc!r}", file=sys.stderr)
             out["tenant_serving"] = {"error": repr(exc)}
+    if layout_ab is not None:
+        # ISSUE 20 sub-series: the layout A/B speedup and the blocked
+        # fill pct each get their OWN comparability key in the ledger,
+        # judged against their own trailing medians BEFORE appending —
+        # no unjudged series. Fill is recorded as a fill percentage
+        # (higher = better) so the generic >10%-drop rule judges it the
+        # same way it judges rows/s; the COO headline series' key and
+        # semantics are untouched.
+        layout_regressions = 0
+        for sub_metric, sub_value, sub_unit in (
+            ("layout_ab_speedup", layout_ab["speedup_x"], "x"),
+            ("block_fill_pct[blocked]", layout_ab["block_fill_pct"], "%"),
+        ):
+            sub = {
+                "metric": sub_metric,
+                "value": sub_value,
+                "unit": sub_unit,
+                "rows": n_rows,
+            }
+            sub_findings = check_bench_history(sub, history_path)
+            for r in sub_findings:
+                print(f"# layout bench regression: {r}", file=sys.stderr)
+            if sub_findings:
+                sub["regression_findings"] = len(sub_findings)
+            layout_regressions += len(sub_findings)
+            append_bench_history(sub, history_path)
+        layout_ab["regression_findings"] = layout_regressions
     # bench regression ledger (ISSUE 11): judge this round against the
     # trailing median of prior comparable rounds, THEN append it — the
     # trajectory starts accumulating from this PR and every later round
@@ -1580,6 +1734,15 @@ def main() -> None:
                    help="node id layout: as-drawn or cluster_renumber'd")
     p.add_argument("--src-gather", default="xla", choices=["xla", "banded"],
                    help="src gather strategy (banded needs --layout clustered)")
+    p.add_argument("--edge-layout", default=None, choices=["coo", "blocked"],
+                   help="edge-buffer layout at window close (ISSUE 20): "
+                        "'coo' = flat dst-sorted list (default, headline "
+                        "series unchanged), 'blocked' = close-time "
+                        "per-128-dst-row extents + extent-aware "
+                        "aggregation. Exported as EDGE_LAYOUT so builder "
+                        "env defaults (incl. spawned shard processes) "
+                        "follow; --ingest ALSO publishes the same-run "
+                        "coo-vs-blocked aggregation A/B either way")
     p.add_argument("--watchdog-s", type=float, default=900.0,
                    help="(--direct) hard exit with an error JSON line after this long")
     p.add_argument("--budget-s", type=float, default=840.0,

@@ -254,3 +254,43 @@ class TestTrainstepCacheIdentity:
 
         cfg = ModelConfig(model="gat")
         assert make_score_fn(cfg) is make_score_fn(cfg)
+
+
+class TestEdgeLayoutSurface:
+    """ISSUE 20: layout selection must cost zero retraces — the blocked
+    path enters the jit'd fns as an extra pytree leaf under the same
+    cfg×shape cache key (a different pytree IS a different cache entry;
+    no new static args, no new jit sites)."""
+
+    SCORE_SITES = (
+        "alaz_tpu.runtime.service:_batched_score_fn/batched_score_apply",
+        "alaz_tpu.train.trainstep:make_score_fn/score_apply",
+    )
+
+    def test_layout_adds_no_static_args_to_the_score_surface(self):
+        golden = json.loads(jitgolden.SURFACE_GOLDEN.read_text())["sites"]
+        for key in self.SCORE_SITES:
+            site = golden[key]
+            assert site["static_args"] == [], (
+                f"{key} grew static args — layout selection must ride "
+                "the pytree, not the compile-cache key"
+            )
+            assert site["cache_key"] == "cfg×shape", key
+
+    def test_injected_layout_static_arg_is_alz074(self, tree_model, tmp_path):
+        golden = json.loads(jitgolden.SURFACE_GOLDEN.read_text())
+        key = self.SCORE_SITES[0]
+        golden["sites"][key]["static_args"] = ["edge_layout"]
+        p = tmp_path / "jit_surface.json"
+        p.write_text(json.dumps(golden))
+        findings = [
+            f
+            for f in jitgolden.check_alz074(tree_model, golden_path=p)
+            if f.code == "ALZ074" and key in f.message
+        ]
+        assert len(findings) == 1
+        assert "static_args" in findings[0].message
+        site = tree_model.by_key[key]
+        assert (findings[0].path, findings[0].line) == (
+            site.ctx.path, site.line,
+        )

@@ -499,3 +499,82 @@ class TestEnumRoundTrip:
                 )
         finally:
             ing.close()
+
+
+class TestEdgeBlocksContract:
+    """ISSUE 20: the blocked edge layout's extent geometry is wire-table
+    pinned (`edge_blocks`) and every model specfile carries the
+    per-layout input axis (`edge_layouts`) — drift on either side is an
+    ALZ021/ALZ023 finding, not a silent desync of the extent-aware
+    kernels against the host emitters."""
+
+    def test_golden_pins_the_block_geometry(self):
+        from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS
+
+        golden = json.loads((SPECS / "wire_layouts.json").read_text())
+        sec = golden["edge_blocks"]
+        assert sec["block_rows"] == EDGE_BLOCK_ROWS
+        assert sec["starts_dtype"] == "i32"
+        # the shipped default is pinned LITERALLY (not via RuntimeConfig,
+        # which reads the live env): a blocked bench run must not drift
+        # the wire table
+        assert sec["default"] == "coo"
+        assert sec["choices"] == ["coo", "blocked"]
+        assert sec["graph_key"] == "edge_block_starts"
+        assert "real edges only" in sec["extent_domain"]
+        # the native refusal is part of the contract: extents never
+        # cross the C ABI (alz_close_window_feats is frozen)
+        assert "native_extent_export" in sec["refusal_surface"]
+
+    def test_doctored_edge_blocks_section_is_alz021(self, tmp_path):
+        golden = json.loads((SPECS / "wire_layouts.json").read_text())
+        golden["edge_blocks"]["block_rows"] = 64
+        work = tmp_path / "wire_layouts.json"
+        work.write_text(json.dumps(golden))
+        findings = abirules.check_wire_layouts(golden_path=work)
+        assert any(
+            f.code == "ALZ021" and "edge_blocks" in f.message
+            for f in findings
+        ), [f.render() for f in findings]
+        # anchored where the extents are emitted, not at the json
+        assert any(
+            f.path.endswith("builder.py")
+            for f in findings
+            if "edge_blocks" in f.message
+        )
+
+    def test_specfiles_carry_the_edge_layouts_axis(self):
+        from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS
+
+        for name in ("graphsage_256x1024.json", "gat_1024x4096.json"):
+            spec = json.loads((SPECS / name).read_text())
+            axis = spec["edge_layouts"]
+            assert axis["coo"]["extra_inputs"] == {}
+            blocked = axis["blocked"]
+            assert blocked["block_rows"] == EDGE_BLOCK_ROWS
+            starts = blocked["extra_inputs"]["edge_block_starts"]
+            n_pad = spec["bucket"]["n_pad"]
+            assert starts["shape"] == [n_pad // EDGE_BLOCK_ROWS + 1]
+            assert starts["dtype"] == "int32"
+            assert spec["config"]["edge_layout"] == "coo"
+
+    def test_flipped_layout_axis_is_alz023(self, tmp_path):
+        work = tmp_path / "specs"
+        shutil.copytree(SPECS, work)
+        target = work / "graphsage_256x1024.json"
+        text = target.read_text()
+        assert '"block_rows": 128' in text
+        flipped = text.replace('"block_rows": 128', '"block_rows": 64', 1)
+        target.write_text(flipped)
+        flip_line = next(
+            i
+            for i, (a, b) in enumerate(
+                zip(text.splitlines(), flipped.splitlines()), start=1
+            )
+            if a != b
+        )
+        findings = specfiles.check_specs(work)
+        assert [(Path(f.path).name, f.line, f.code) for f in findings] == [
+            ("graphsage_256x1024.json", flip_line, "ALZ023")
+        ]
+        assert "block_rows" in findings[0].message or "128" in findings[0].message

@@ -1,0 +1,445 @@
+"""Blocked edge layout (ISSUE 20): dst-blocked sparse extents.
+
+The contract under test, end to end:
+
+1. Extent invariants — ``edge_block_starts_from`` is monotone, starts at
+   0, and its sentinel is the REAL edge frontier (``n_edges``, never
+   ``e_pad``); the slot accounting matches a brute-force tile count.
+2. Bit-exactness — blocked aggregation (XLA fallback AND the
+   extent-aware Pallas interpret kernel) equals the COO path exactly,
+   through the ops layer, both full models, and the node-sharded twins
+   (N ∈ {1, 2, 4}, including the n_loc % 128 != 0 graceful gate).
+3. Producer parity — serial WindowedGraphStore, thread ShardedIngest
+   (N ∈ {1, 2, 4}) and the process backend all close blocked batches
+   whose extents equal the one definition recomputed from their own dst
+   columns; COO batches never ship extents.
+4. Composition — the degree cap samples BEFORE blocking: the capped
+   selection is bit-identical across layouts and the extents describe
+   the post-cap edge list.
+5. Refusal — a blocked config over a COO graph raises instead of
+   silently falling back (a quiet fallback would poison every
+   '[blocked]' benchmark series).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from __graft_entry__ import _example_batch
+from alaz_tpu.config import ModelConfig
+from alaz_tpu.graph.snapshot import (
+    EDGE_BLOCK_ROWS,
+    GraphBatch,
+    blocked_edge_slots_from,
+    edge_block_starts_from,
+)
+from alaz_tpu.models.registry import get_model
+from alaz_tpu.ops.segment import blocked_segment_sum
+
+
+def _extents_brute(edge_dst, n_edges, n_pad):
+    """Independent O(N·B) re-derivation of the extent vector."""
+    dst = edge_dst[:n_edges]
+    out = [0]
+    for b in range(EDGE_BLOCK_ROWS, n_pad + 1, EDGE_BLOCK_ROWS):
+        out.append(int(np.sum(dst < b)))
+    return np.asarray(out, dtype=np.int32)
+
+
+class TestBlockExtents:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_brute_force_and_frontier(self, seed):
+        b = _example_batch(n_pods=140, n_svcs=30, n_edges=900, seed=seed)
+        starts = edge_block_starts_from(b.edge_dst, b.n_edges, b.n_pad)
+        np.testing.assert_array_equal(
+            starts, _extents_brute(b.edge_dst, b.n_edges, b.n_pad)
+        )
+        assert starts.dtype == np.int32
+        assert starts.shape == (b.n_pad // EDGE_BLOCK_ROWS + 1,)
+        assert starts[0] == 0
+        # the sentinel is the REAL frontier — pad tail excluded
+        assert starts[-1] == b.n_edges != b.e_pad
+        assert (np.diff(starts) >= 0).all()
+
+    def test_slot_accounting_matches_tile_walk(self):
+        b = _example_batch(n_pods=200, n_svcs=40, n_edges=1500, seed=7)
+        starts = b.block_starts()
+        slots = 0
+        bs = starts.astype(int)
+        for lo, hi in zip(bs[:-1], bs[1:]):
+            if hi > lo:
+                first, last = lo // EDGE_BLOCK_ROWS, (hi - 1) // EDGE_BLOCK_ROWS
+                slots += (last - first + 1) * EDGE_BLOCK_ROWS
+        assert blocked_edge_slots_from(starts) == slots == b.blocked_edge_slots
+
+    def test_lazy_field_caches_and_device_arrays_select(self):
+        b = _example_batch(n_pods=60, n_svcs=12, n_edges=300, seed=1)
+        assert b.edge_block_starts is None
+        coo = b.device_arrays()
+        assert "edge_block_starts" not in coo  # COO never ships extents
+        s1 = b.block_starts()
+        assert b.block_starts() is s1  # cached, one searchsorted per batch
+        blocked = b.device_arrays("blocked")
+        np.testing.assert_array_equal(blocked["edge_block_starts"], s1)
+        # the COO columns are byte-identical across layouts
+        for k, v in coo.items():
+            np.testing.assert_array_equal(blocked[k], v)
+
+    def test_empty_window(self):
+        starts = edge_block_starts_from(
+            np.zeros(0, dtype=np.int32), 0, 2 * EDGE_BLOCK_ROWS
+        )
+        assert (starts == 0).all() and blocked_edge_slots_from(starts) == 0
+
+
+class TestBlockedSegmentSum:
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_bit_exact_vs_coo(self, seed):
+        b = _example_batch(n_pods=180, n_svcs=40, n_edges=1100, seed=seed)
+        data = jnp.asarray(
+            np.random.default_rng(seed).normal(
+                size=(b.e_pad, 16)
+            ).astype(np.float32)
+            * np.asarray(b.edge_mask, np.float32)[:, None]
+        )
+        ids = jnp.asarray(b.edge_dst)
+        ref = jax.ops.segment_sum(data, ids, num_segments=b.n_pad)
+        got = blocked_segment_sum(
+            data, ids, jnp.asarray(b.block_starts()), b.n_pad
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_gradients_bit_exact(self):
+        b = _example_batch(n_pods=90, n_svcs=20, n_edges=400, seed=5)
+        data = jnp.asarray(
+            np.random.default_rng(0).normal(size=(b.e_pad, 8)).astype(np.float32)
+        )
+        ids = jnp.asarray(b.edge_dst)
+        bs = jnp.asarray(b.block_starts())
+        g_coo = jax.grad(
+            lambda d: jnp.sum(
+                jax.ops.segment_sum(d, ids, num_segments=b.n_pad) ** 2
+            )
+        )(data)
+        g_blk = jax.grad(
+            lambda d: jnp.sum(blocked_segment_sum(d, ids, bs, b.n_pad) ** 2)
+        )(data)
+        # pad-tail slots sit past the frontier: their gradient is 0 under
+        # blocked, and whatever the pad dst row accumulated under COO —
+        # compare the real prefix exactly, assert the blocked tail is 0
+        np.testing.assert_array_equal(
+            np.asarray(g_blk)[: b.n_edges], np.asarray(g_coo)[: b.n_edges]
+        )
+        np.testing.assert_array_equal(np.asarray(g_blk)[b.n_edges :], 0.0)
+
+    def test_pallas_interpret_matches_blocked_xla(self):
+        from alaz_tpu.ops.pallas_segment import scatter_sum_sorted
+
+        b = _example_batch(n_pods=150, n_svcs=30, n_edges=800, seed=9)
+        data = jnp.asarray(
+            np.random.default_rng(1).normal(
+                size=(b.e_pad, 32)
+            ).astype(np.float32)
+            * np.asarray(b.edge_mask, np.float32)[:, None]
+        )
+        ids = jnp.asarray(b.edge_dst)
+        bs = jnp.asarray(b.block_starts())
+        xla = blocked_segment_sum(data, ids, bs, b.n_pad)
+        pal = scatter_sum_sorted(data, ids, b.n_pad, None, bs)
+        np.testing.assert_allclose(
+            np.asarray(pal), np.asarray(xla), rtol=1e-5, atol=1e-5
+        )
+
+
+def _apply(name, batch, layout, params=None):
+    cfg = ModelConfig(
+        model=name, hidden_dim=32, num_heads=4, use_pallas=False,
+        dtype="float32", edge_layout=layout,
+    )
+    init, apply = get_model(name)
+    if params is None:
+        params = init(jax.random.PRNGKey(0), cfg)
+    return params, apply(params, {
+        k: jnp.asarray(v) for k, v in batch.device_arrays(layout).items()
+    }, cfg)
+
+
+@pytest.mark.parametrize("name", ["graphsage", "gat"])
+class TestModelParity:
+    # two shapes that land in different bucket rungs (256x1024, 1024x4096)
+    @pytest.mark.parametrize(
+        "shape", [(140, 30, 900), (700, 120, 3000)],
+        ids=["bucket256", "bucket1024"],
+    )
+    def test_blocked_equals_coo_bit_exact(self, name, shape):
+        pods, svcs, edges = shape
+        batch = _example_batch(n_pods=pods, n_svcs=svcs, n_edges=edges, seed=2)
+        params, out_coo = _apply(name, batch, "coo")
+        _, out_blk = _apply(name, batch, "blocked", params)
+        for key in ("edge_logits", "node_logits", "node_h"):
+            np.testing.assert_array_equal(
+                np.asarray(out_blk[key]), np.asarray(out_coo[key]), err_msg=key
+            )
+
+    def test_blocked_without_extents_refuses(self, name):
+        batch = _example_batch(n_pods=60, n_svcs=12, n_edges=300, seed=4)
+        cfg = ModelConfig(
+            model=name, hidden_dim=32, num_heads=4, use_pallas=False,
+            edge_layout="blocked",
+        )
+        init, apply = get_model(name)
+        params = init(jax.random.PRNGKey(0), cfg)
+        g = {k: jnp.asarray(v) for k, v in batch.device_arrays().items()}
+        with pytest.raises(ValueError, match="edge_block_starts"):
+            apply(params, g, cfg)
+
+
+class TestShardedTwinParity:
+    """The node-sharded twins under edge_layout='blocked' recompute
+    shard-local extents in-graph (sharded_model.shard_block_starts) —
+    same wire format, bit-exact outputs vs their own COO run."""
+
+    @pytest.mark.parametrize("name", ["graphsage", "gat"])
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    def test_blocked_equals_coo(self, name, n_shards):
+        from jax.sharding import Mesh
+
+        from alaz_tpu.parallel.sharded_model import (
+            make_node_sharded_gat,
+            make_node_sharded_graphsage,
+            shard_graph_batch,
+            unshard_edge_outputs,
+        )
+
+        maker = {
+            "graphsage": make_node_sharded_graphsage,
+            "gat": make_node_sharded_gat,
+        }[name]
+        init, _ = get_model(name)
+        # 220 pods + 36 svcs pads to n_pad=512: n_loc ∈ {512, 256, 128},
+        # always a multiple of 128 — extents active at every shard count
+        batch = _example_batch(n_pods=220, n_svcs=36, n_edges=1200, seed=6)
+        mesh = Mesh(np.asarray(jax.devices()[:n_shards]), ("sp",))
+        sharded, perm = shard_graph_batch(batch, n_shards)
+        g = {k: jnp.asarray(v) for k, v in sharded.items()}
+        outs = {}
+        for layout in ("coo", "blocked"):
+            cfg = ModelConfig(
+                model=name, hidden_dim=32, num_heads=4, use_pallas=False,
+                dtype="float32", edge_layout=layout,
+            )
+            params = init(jax.random.PRNGKey(0), cfg)
+            edge_logits, _ = maker(cfg, mesh, axis="sp")(params, g)
+            outs[layout] = unshard_edge_outputs(edge_logits, perm, batch.e_pad)
+        mask = batch.edge_mask.astype(bool)
+        np.testing.assert_array_equal(
+            outs["blocked"][mask], outs["coo"][mask]
+        )
+
+    def test_unaligned_n_loc_gracefully_gates_to_coo(self):
+        """n_pad=256 over 4 shards → n_loc=64, not a tile multiple:
+        shard_block_starts must return None (COO path) and the run must
+        still match the single-device blocked reference."""
+        from alaz_tpu.parallel.sharded_model import shard_block_starts
+
+        assert (
+            shard_block_starts(
+                jnp.zeros(128, jnp.int32), jnp.ones(128, bool), 64
+            )
+            is None
+        )
+
+    def test_shard_local_extents_match_host_definition(self):
+        """The in-graph searchsorted over a shard's dst_local equals the
+        host-side definition applied to that shard's live prefix."""
+        from alaz_tpu.parallel.sharded_model import (
+            shard_block_starts,
+            shard_graph_batch,
+        )
+
+        batch = _example_batch(n_pods=220, n_svcs=36, n_edges=1200, seed=8)
+        sharded, _ = shard_graph_batch(batch, 2)
+        n_loc = batch.n_pad // 2
+        for s in range(2):
+            dst = np.asarray(sharded["edge_dst_local"][s])
+            mask = np.asarray(sharded["edge_mask"][s]).astype(bool)
+            got = shard_block_starts(
+                jnp.asarray(dst), jnp.asarray(mask), n_loc
+            )
+            n_live = int(mask.sum())  # live edges are the dst-sorted prefix
+            want = edge_block_starts_from(dst[:n_live], n_live, n_loc)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestProducerParity:
+    """Every ingest path closes blocked batches with the ONE extent
+    definition; COO runs never pay for or ship extents."""
+
+    def _check_batches(self, batches, blocked):
+        assert batches, "no windows closed"
+        for b in batches:
+            if blocked:
+                assert b.edge_block_starts is not None
+                np.testing.assert_array_equal(
+                    b.edge_block_starts,
+                    edge_block_starts_from(b.edge_dst, b.n_edges, b.n_pad),
+                )
+            else:
+                assert b.edge_block_starts is None
+
+    @pytest.mark.parametrize("layout", ["coo", "blocked"])
+    def test_serial_store(self, layout):
+        from bench import make_ingest_trace
+        from tests.test_sharded_ingest import _run_serial
+
+        import alaz_tpu.graph.builder as builder_mod  # noqa: F401
+
+        ev, msgs = make_ingest_trace(8_000, pods=40, svcs=8, windows=3, seed=3)
+        import os
+
+        old = os.environ.get("EDGE_LAYOUT")
+        os.environ["EDGE_LAYOUT"] = layout
+        try:
+            _, closed, _ = _run_serial(ev, msgs, 8_000)
+        finally:
+            if old is None:
+                os.environ.pop("EDGE_LAYOUT", None)
+            else:
+                os.environ["EDGE_LAYOUT"] = old
+        self._check_batches(closed, layout == "blocked")
+
+    @pytest.mark.parametrize("n_workers", [1, 2, 4])
+    def test_thread_sharded(self, n_workers):
+        import os
+
+        from bench import make_ingest_trace
+        from tests.test_sharded_ingest import _run_sharded
+
+        ev, msgs = make_ingest_trace(8_000, pods=40, svcs=8, windows=3, seed=5)
+        old = os.environ.get("EDGE_LAYOUT")
+        os.environ["EDGE_LAYOUT"] = "blocked"
+        try:
+            _, closed, _ = _run_sharded(ev, msgs, 8_000, n_workers)
+        finally:
+            if old is None:
+                os.environ.pop("EDGE_LAYOUT", None)
+            else:
+                os.environ["EDGE_LAYOUT"] = old
+        self._check_batches(closed, True)
+
+    def test_process_backend(self):
+        import os
+
+        from bench import make_ingest_trace
+        from tests.test_process_ingest import _run_process
+
+        ev, msgs = make_ingest_trace(8_000, pods=40, svcs=8, windows=3, seed=7)
+        old = os.environ.get("EDGE_LAYOUT")
+        os.environ["EDGE_LAYOUT"] = "blocked"
+        try:
+            _, closed, _ = _run_process(ev, msgs, 8_000, 2)
+        finally:
+            if old is None:
+                os.environ.pop("EDGE_LAYOUT", None)
+            else:
+                os.environ["EDGE_LAYOUT"] = old
+        self._check_batches(closed, True)
+
+    def test_native_close_path(self):
+        from alaz_tpu.graph import native
+
+        if not native.available():
+            pytest.skip("libalaz_ingest.so unavailable (no toolchain)")
+        ing = native.NativeIngest(window_s=1.0, edge_layout="blocked")
+        try:
+            recs = np.zeros(64, dtype=native.NATIVE_RECORD_DTYPE)
+            rng = np.random.default_rng(0)
+            recs["start_time_ms"] = 500
+            recs["from_uid"] = rng.integers(1, 20, 64)
+            recs["to_uid"] = rng.integers(20, 40, 64)
+            recs["protocol"] = 1
+            ing.push_records(recs)
+            nxt = np.zeros(1, dtype=native.NATIVE_RECORD_DTYPE)
+            nxt["start_time_ms"] = 1500
+            ing.push_records(nxt)
+            batch = ing.poll()
+            assert batch is not None
+            self._check_batches([batch], True)
+        finally:
+            ing.close()
+
+
+class TestDegreeCapComposition:
+    def test_cap_selection_identical_across_layouts(self):
+        """The cap samples on the aggregated edge list BEFORE blocking:
+        both layouts keep the same edges (bit-identical columns) and the
+        blocked extents describe the post-cap list."""
+        from bench import make_ingest_trace
+        from alaz_tpu.aggregator.cluster import ClusterInfo
+        from alaz_tpu.aggregator.engine import Aggregator
+        from alaz_tpu.events.intern import Interner
+        from alaz_tpu.graph.builder import WindowedGraphStore
+
+        ev, msgs = make_ingest_trace(9_000, pods=30, svcs=4, windows=3, seed=11)
+        batches = {}
+        for layout in ("coo", "blocked"):
+            interner = Interner()
+            closed = []
+            store = WindowedGraphStore(
+                interner, window_s=1.0, on_batch=closed.append,
+                degree_cap=4, sample_seed=17, edge_layout=layout,
+            )
+            cluster = ClusterInfo(interner)
+            for m in msgs:
+                cluster.handle_msg(m)
+            agg = Aggregator(store, interner=interner, cluster=cluster)
+            agg.process_l7(ev, now_ns=10_000_000_000)
+            store.flush()
+            assert closed
+            batches[layout] = closed
+        for bc, bb in zip(batches["coo"], batches["blocked"]):
+            assert bc.n_edges == bb.n_edges
+            for col in ("edge_src", "edge_dst", "edge_type"):
+                np.testing.assert_array_equal(
+                    getattr(bc, col), getattr(bb, col), err_msg=col
+                )
+            np.testing.assert_array_equal(bc.edge_feats, bb.edge_feats)
+            np.testing.assert_array_equal(
+                bb.edge_block_starts,
+                edge_block_starts_from(bb.edge_dst, bb.n_edges, bb.n_pad),
+            )
+            assert bc.edge_block_starts is None
+
+
+class TestBuilderTelemetry:
+    def test_block_fill_pct_tracks_assembled_batches(self):
+        from alaz_tpu.graph.builder import GraphBuilder
+        from alaz_tpu.obs.device import blocked_pad_waste_pct_from
+
+        from alaz_tpu.datastore.dto import REQUEST_DTYPE
+
+        rng = np.random.default_rng(2)
+        rows = np.zeros(600, dtype=REQUEST_DTYPE)
+        rows["start_time_ms"] = 500
+        rows["from_uid"] = rng.integers(1, 60, 600)
+        rows["to_uid"] = rng.integers(60, 120, 600)
+        rows["from_type"] = 1
+        rows["to_type"] = 2
+        rows["protocol"] = 1
+        rows["completed"] = True
+        gb = GraphBuilder(edge_layout="blocked")
+        batch = gb.build(rows)
+        assert batch.edge_block_starts is not None  # eager at close
+        assert gb.assembled_block_slots == batch.blocked_edge_slots
+        want = 100.0 - blocked_pad_waste_pct_from(
+            gb.assembled_edge_rows, gb.assembled_block_slots
+        )
+        assert gb.block_fill_pct == pytest.approx(want)
+        # COO builder never pays: no extents, zero slot ledger
+        gb2 = GraphBuilder(edge_layout="coo")
+        b2 = gb2.build(rows)
+        assert b2.edge_block_starts is None
+        assert gb2.assembled_block_slots == 0 and gb2.block_fill_pct == 0.0

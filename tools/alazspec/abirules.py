@@ -506,6 +506,44 @@ def wire_layout_table() -> dict:
         # record-kind map, window/delta framing, geometry defaults)
         # anchors here at analysis time.
         "shm_ring": _shm_ring_section(),
+        # blocked edge layout contract (ISSUE 20): the per-128-dst-row
+        # extent table the blocked layout ships next to the COO columns.
+        # Geometry (block rows, starts length/dtype) and DOMAIN (extents
+        # cover the REAL edge prefix only — block_starts[-1] == n_edges,
+        # NOT e_pad: the pad tail is excluded so extent-aware reducers
+        # skip pad-only tiles) are what the extent-aware Pallas variant
+        # and the blocked XLA fallback both compile against; a drift on
+        # either side desyncs bit-exactness with COO silently.
+        "edge_blocks": _edge_blocks_section(),
+    }
+
+
+def _edge_blocks_section() -> dict:
+    from alaz_tpu.graph import snapshot as snap
+
+    return {
+        "block_rows": int(snap.EDGE_BLOCK_ROWS),
+        "starts_dtype": "i32",
+        "starts_length": "n_pad // block_rows + 1",
+        "extent_domain": "real edges only (starts[-1] == n_edges, pad tail excluded)",
+        "slots_formula": (
+            "sum over nonempty blocks of "
+            "(ceil(hi/block_rows) - floor(lo/block_rows)) * block_rows"
+        ),
+        "graph_key": "edge_block_starts",
+        "config_field": "edge_layout",
+        "env": ["EDGE_LAYOUT"],
+        # the SHIPPED default, pinned literally (like l7_engine's):
+        # RuntimeConfig() here would read the live env and make the
+        # table drift whenever a blocked bench/service runs the gate
+        "default": "coo",
+        "choices": ["coo", "blocked"],
+        # the native close path REFUSES to export extents over the C
+        # ABI: alz_close_window_feats' signature is frozen and the
+        # extents are a pure function of the dst-sorted columns it
+        # already emits — the python side derives them at close
+        # (graph/native.py NativeIngest._finish)
+        "refusal_surface": ["native_extent_export"],
     }
 
 
@@ -601,6 +639,7 @@ def check_wire_layouts(
                 REPO / "alaz_tpu" / "aggregator" / "native_l7.py",
             ),
             ("shm_ring", REPO / "alaz_tpu" / "shm" / "ring.py"),
+            ("edge_blocks", REPO / "alaz_tpu" / "graph" / "builder.py"),
         ):
             live_sec = live.get(section, {})
             gold_sec = golden.get(section)

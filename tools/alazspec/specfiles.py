@@ -99,6 +99,30 @@ def _eval_model(name: str, cfg, n_pad: int, e_pad: int):
     return params, outputs
 
 
+def _edge_layout_axis(n_pad: int) -> dict:
+    """The per-layout extra input surface (ISSUE 20): COO ships the
+    bare columns; blocked adds the per-128-dst-row extent table that
+    the extent-aware reducers consume. Pinned per bucket so a geometry
+    change (block rows, starts length/dtype) drifts every specfile."""
+    import numpy as np
+
+    from alaz_tpu.graph.snapshot import EDGE_BLOCK_ROWS
+    from alaz_tpu.parallel.sharding import graph_pspec
+
+    pspec = graph_pspec(stacked=True)["edge_block_starts"]
+    starts = dict(
+        _sds((n_pad // EDGE_BLOCK_ROWS + 1,), np.dtype(np.int32).name),
+        pspec=str(pspec),
+    )
+    return {
+        "coo": {"extra_inputs": {}},
+        "blocked": {
+            "block_rows": int(EDGE_BLOCK_ROWS),
+            "extra_inputs": {"edge_block_starts": starts},
+        },
+    }
+
+
 def _model_spec(name: str, cfg, n_pad: int, e_pad: int) -> dict:
     import jax
 
@@ -123,6 +147,7 @@ def _model_spec(name: str, cfg, n_pad: int, e_pad: int) -> dict:
         "mesh_axes": list(mesh_axis_names()),
         "param_sharding": {"tp": SPEC_TP, "ep": SPEC_EP},
         "config": _cfg_dict(cfg),
+        "edge_layouts": _edge_layout_axis(n_pad),
         "graph_inputs": _graph_shapes(cfg, n_pad, e_pad),
         "params": param_table,
         "outputs": out_table,
